@@ -1,0 +1,55 @@
+"""Spec system: the typed data contract every other layer builds on."""
+
+from tensor2robot_tpu.specs.algebra import (
+    add_sequence_length_specs,
+    assert_equal,
+    assert_equal_spec_or_tensor,
+    assert_required,
+    assert_valid_spec_structure,
+    copy_spec_structure,
+    copy_tensorspec,
+    filter_required_flat_tensor_spec,
+    filter_spec_structure_by_dataset,
+    flatten_spec_structure,
+    is_flat_spec_or_tensors_structure,
+    maybe_ignore_batch,
+    pack_flat_sequence_to_spec_structure,
+    pad_or_clip_to_spec_shape,
+    spec_names,
+    tensorspec_from_tensors,
+    validate_and_flatten,
+    validate_and_pack,
+)
+from tensor2robot_tpu.specs.assets import (
+    EXTRA_ASSETS_DIRECTORY,
+    T2R_ASSETS_FILENAME,
+    load_specs_from_export_dir,
+    load_t2r_assets_from_file,
+    make_t2r_assets,
+    write_assets_to_export_dir,
+    write_t2r_assets_to_file,
+)
+from tensor2robot_tpu.specs.dtypes import (
+    bfloat16_compute_policy,
+    cast_arrays_to_spec_dtypes,
+    cast_bfloat16_to_float32,
+    cast_float32_to_bfloat16,
+    replace_dtype,
+)
+from tensor2robot_tpu.specs.numpy_gen import (
+    make_constant_numpy,
+    make_placeholders,
+    make_random_arrays,
+    make_random_numpy,
+    make_shape_dtype_structs,
+    map_feed_dict,
+    pack_feed_dict,
+)
+from tensor2robot_tpu.specs.spec_struct import SpecStruct, TensorSpecStruct
+from tensor2robot_tpu.specs.tensor_spec import (
+    ExtendedTensorSpec,
+    TensorSpec,
+    as_dtype,
+    bfloat16,
+    dtype_name,
+)

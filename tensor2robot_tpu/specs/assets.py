@@ -1,0 +1,81 @@
+"""T2RAssets: the serialized spec contract that travels with every export.
+
+Capability-equivalent of the reference's asset I/O
+(``/root/reference/utils/tensorspec_utils.py:1680-1728``): each exported model
+directory carries an ``assets.extra/t2r_assets.pbtxt`` with the feature spec,
+label spec and global step, so a predictor can reconstruct the input contract
+without importing the model code. A JSON twin is written alongside for
+proto-free consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+from google.protobuf import text_format
+
+from tensor2robot_tpu.proto import t2r_pb2
+from tensor2robot_tpu.specs.spec_struct import SpecStruct
+
+EXTRA_ASSETS_DIRECTORY = 'assets.extra'
+T2R_ASSETS_FILENAME = 't2r_assets.pbtxt'
+T2R_ASSETS_JSON_FILENAME = 't2r_assets.json'
+
+
+def make_t2r_assets(feature_spec: Optional[SpecStruct],
+                    label_spec: Optional[SpecStruct],
+                    global_step: int = 0) -> t2r_pb2.T2RAssets:
+  assets = t2r_pb2.T2RAssets()
+  if feature_spec is not None:
+    assets.feature_spec.CopyFrom(feature_spec.to_proto())
+  if label_spec is not None:
+    assets.label_spec.CopyFrom(label_spec.to_proto())
+  assets.global_step = int(global_step)
+  return assets
+
+
+def write_t2r_assets_to_file(t2r_assets: t2r_pb2.T2RAssets,
+                             filename: str) -> None:
+  os.makedirs(os.path.dirname(filename) or '.', exist_ok=True)
+  with open(filename, 'w') as f:
+    f.write(text_format.MessageToString(t2r_assets))
+  json_twin = {
+      'feature_spec': SpecStruct.from_proto(
+          t2r_assets.feature_spec).to_json_dict(),
+      'label_spec': SpecStruct.from_proto(
+          t2r_assets.label_spec).to_json_dict(),
+      'global_step': int(t2r_assets.global_step),
+  }
+  json_path = os.path.join(
+      os.path.dirname(filename), T2R_ASSETS_JSON_FILENAME)
+  with open(json_path, 'w') as f:
+    json.dump(json_twin, f, indent=2, sort_keys=True)
+
+
+def load_t2r_assets_from_file(filename: str) -> t2r_pb2.T2RAssets:
+  assets = t2r_pb2.T2RAssets()
+  with open(filename) as f:
+    text_format.Parse(f.read(), assets)
+  return assets
+
+
+def write_assets_to_export_dir(export_dir: str,
+                               feature_spec: SpecStruct,
+                               label_spec: Optional[SpecStruct],
+                               global_step: int = 0) -> str:
+  """Writes assets.extra/t2r_assets.pbtxt under an export dir."""
+  path = os.path.join(export_dir, EXTRA_ASSETS_DIRECTORY, T2R_ASSETS_FILENAME)
+  write_t2r_assets_to_file(
+      make_t2r_assets(feature_spec, label_spec, global_step), path)
+  return path
+
+
+def load_specs_from_export_dir(
+    export_dir: str) -> Tuple[SpecStruct, SpecStruct, int]:
+  """Loads (feature_spec, label_spec, global_step) from an export dir."""
+  path = os.path.join(export_dir, EXTRA_ASSETS_DIRECTORY, T2R_ASSETS_FILENAME)
+  assets = load_t2r_assets_from_file(path)
+  return (SpecStruct.from_proto(assets.feature_spec),
+          SpecStruct.from_proto(assets.label_spec), int(assets.global_step))

@@ -1,0 +1,75 @@
+"""Dtype policy: the bfloat16-on-TPU mechanism at the spec level.
+
+Capability-equivalent of the reference's ``replace_dtype`` /
+``cast_float32_to_bfloat16`` / ``cast_bfloat16_to_float32``
+(``/root/reference/utils/tensorspec_utils.py:685-747``). In the TPU-native
+design the host pipeline always produces float32/uint8 and the *device step*
+casts per-spec to bfloat16 on entry — a free cast on TPU that keeps all host
+code and exported artifacts in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from tensor2robot_tpu.specs.algebra import flatten_spec_structure
+from tensor2robot_tpu.specs.spec_struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec, as_dtype, bfloat16
+
+
+def replace_dtype(spec_structure, from_dtype, to_dtype) -> SpecStruct:
+  """Copy of the spec structure with from_dtype specs re-typed to to_dtype."""
+  from_dtype = as_dtype(from_dtype)
+  to_dtype = as_dtype(to_dtype)
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key, value in flat.items():
+    spec = TensorSpec.to_spec(value)
+    if spec.dtype == from_dtype:
+      spec = TensorSpec.from_spec(spec, dtype=to_dtype)
+    out[key] = spec
+  return out
+
+
+def cast_float32_to_bfloat16(spec_structure) -> SpecStruct:
+  return replace_dtype(spec_structure, np.float32, bfloat16)
+
+
+def cast_bfloat16_to_float32(spec_structure) -> SpecStruct:
+  return replace_dtype(spec_structure, bfloat16, np.float32)
+
+
+def cast_arrays_to_spec_dtypes(spec_structure, tensors) -> SpecStruct:
+  """Casts each tensor to the dtype its spec declares (jax or numpy).
+
+  This is the device-entry cast: called inside the jit-ed step so that a
+  float32 host batch becomes bfloat16 on the MXU without any host work.
+  """
+  import jax.numpy as jnp
+
+  flat_spec = flatten_spec_structure(spec_structure)
+  flat_tensors = flatten_spec_structure(tensors)
+  out = SpecStruct()
+  for key, tensor in flat_tensors.items():
+    spec = flat_spec.get(key)
+    if spec is None or not isinstance(spec, TensorSpec):
+      out[key] = tensor
+      continue
+    if hasattr(tensor, 'astype'):
+      if as_dtype(tensor.dtype) != spec.dtype:
+        tensor = tensor.astype(spec.dtype)
+    else:
+      tensor = jnp.asarray(tensor, dtype=spec.dtype)
+    out[key] = tensor
+  return out
+
+
+def bfloat16_compute_policy(spec_structure) -> SpecStruct:
+  """Device-side spec view: float32 specs become bfloat16 specs.
+
+  Trainer entry point: the model's declared (float32) specs describe the host
+  batch; this view describes what the compute actually sees on TPU.
+  """
+  return cast_float32_to_bfloat16(spec_structure)

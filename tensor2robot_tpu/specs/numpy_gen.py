@@ -1,0 +1,158 @@
+"""Spec-driven numpy/jax data generation and feed-dict mapping.
+
+Capability-equivalent of the reference's placeholder/numpy helpers
+(``/root/reference/utils/tensorspec_utils.py:778-1035``). There are no TF
+placeholders in a JAX program; the analogue of ``make_placeholders`` is a
+structure of ``jax.ShapeDtypeStruct`` used for ``jax.eval_shape`` /
+ahead-of-time lowering, and the analogue of the feed-dict is a name-keyed
+numpy dict handed to a predictor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu.specs.algebra import (flatten_spec_structure,
+                                            pack_flat_sequence_to_spec_structure)
+from tensor2robot_tpu.specs.spec_struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+_DEFAULT_SEQUENCE_LENGTH = 3
+
+
+def _concrete_shape(spec: TensorSpec,
+                    batch_size: Optional[int],
+                    sequence_length: int) -> tuple:
+  shape = tuple(1 if d is None else d for d in spec.shape)
+  if spec.is_sequence and not spec.is_extracted:
+    shape = (sequence_length,) + shape
+  if batch_size is not None and batch_size != -1:
+    shape = (batch_size,) + shape
+  return shape
+
+
+def make_shape_dtype_structs(spec_structure,
+                             batch_size: Optional[int] = None) -> SpecStruct:
+  """SpecStruct of jax.ShapeDtypeStruct — the jit-facing 'placeholders'."""
+  import jax
+
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key, value in flat.items():
+    spec = TensorSpec.to_spec(value)
+    shape = _concrete_shape(spec, batch_size, _DEFAULT_SEQUENCE_LENGTH)
+    out[key] = jax.ShapeDtypeStruct(shape, spec.dtype)
+  return out
+
+
+# Reference-compatible alias: in TF land these were graph placeholders.
+make_placeholders = make_shape_dtype_structs
+
+
+def make_constant_numpy(spec_structure,
+                        constant_value,
+                        batch_size: int = 2,
+                        sequence_length: int = _DEFAULT_SEQUENCE_LENGTH
+                        ) -> SpecStruct:
+  """Constant-filled numpy arrays shaped like the spec structure."""
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key, value in flat.items():
+    spec = TensorSpec.to_spec(value)
+    shape = _concrete_shape(spec, batch_size, sequence_length)
+    out[key] = np.full(shape, constant_value, dtype=spec.dtype)
+  return out
+
+
+def make_random_numpy(spec_structure,
+                      batch_size: int = 2,
+                      sequence_length: int = _DEFAULT_SEQUENCE_LENGTH,
+                      seed: Optional[int] = None) -> SpecStruct:
+  """Random numpy arrays shaped like the spec structure.
+
+  Float dtypes get uniform [0,1); int dtypes get uniform [0, 2) for bools and
+  [0, 255] for uint8 images, [0, 10) otherwise.
+  """
+  rng = np.random.default_rng(seed)
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key, value in flat.items():
+    spec = TensorSpec.to_spec(value)
+    shape = _concrete_shape(spec, batch_size, sequence_length)
+    if spec.dtype == np.bool_:
+      out[key] = rng.integers(0, 2, size=shape).astype(np.bool_)
+    elif np.issubdtype(spec.dtype, np.integer):
+      high = 256 if spec.dtype == np.uint8 else 10
+      out[key] = rng.integers(0, high, size=shape).astype(spec.dtype)
+    else:
+      out[key] = rng.random(size=shape).astype(spec.dtype)
+  return out
+
+
+def make_random_arrays(spec_structure,
+                       batch_size: int = 2,
+                       seed: int = 0) -> SpecStruct:
+  """Random *jax* arrays shaped like the spec structure (device-side)."""
+  import jax
+  import jax.numpy as jnp
+
+  key = jax.random.PRNGKey(seed)
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for path, value in flat.items():
+    spec = TensorSpec.to_spec(value)
+    shape = _concrete_shape(spec, batch_size, _DEFAULT_SEQUENCE_LENGTH)
+    key, sub = jax.random.split(key)
+    if np.issubdtype(spec.dtype, np.integer):
+      out[path] = jax.random.randint(sub, shape, 0, 10).astype(spec.dtype)
+    elif spec.dtype == np.bool_:
+      out[path] = jax.random.bernoulli(sub, 0.5, shape)
+    else:
+      out[path] = jax.random.uniform(sub, shape, dtype=jnp.float32).astype(
+          spec.dtype)
+  return out
+
+
+def map_feed_dict(spec_structure, numpy_inputs,
+                  ignore_batch: bool = False) -> dict:
+  """Maps a hierarchy of numpy inputs onto the spec's *name* key space.
+
+  This is the predictor-boundary mapping: serialized/served models address
+  tensors by spec name, while in-process code addresses them by path.
+  """
+  from tensor2robot_tpu.specs import algebra
+
+  flat_spec = flatten_spec_structure(spec_structure)
+  flat_np = flatten_spec_structure(numpy_inputs)
+  feed = {}
+  for key, value in flat_spec.items():
+    spec = TensorSpec.to_spec(value)
+    if key not in flat_np:
+      if spec.is_optional:
+        continue
+      raise ValueError(f'Missing required feed input for {key!r} ({spec}).')
+    array = np.asarray(flat_np[key])
+    algebra.assert_equal_spec_or_tensor(
+        spec, algebra.maybe_ignore_batch(
+            SpecStruct({key: TensorSpec.from_array(array)}),
+            ignore_batch)[key])
+    name = spec.name or key.split('/')[-1]
+    if name in feed and not np.array_equal(feed[name], array):
+      raise ValueError(
+          f'Conflicting values for shared feed name {name!r}.')
+    feed[name] = array
+  return feed
+
+
+def pack_feed_dict(spec_structure, name_keyed_inputs) -> SpecStruct:
+  """Inverse of :func:`map_feed_dict`: name-keyed arrays -> packed struct."""
+  flat_spec = flatten_spec_structure(spec_structure)
+  by_path = {}
+  for key, value in flat_spec.items():
+    spec = TensorSpec.to_spec(value)
+    name = spec.name or key.split('/')[-1]
+    if name in name_keyed_inputs:
+      by_path[key] = np.asarray(name_keyed_inputs[name])
+  return pack_flat_sequence_to_spec_structure(spec_structure, by_path)

@@ -1,0 +1,290 @@
+"""SpecStruct: a container that is simultaneously flat and hierarchical.
+
+TPU-native re-design of the reference's ``TensorSpecStruct``
+(``/root/reference/utils/tensorspec_utils.py:306-682``). The same value can be
+addressed two ways:
+
+* **flat**: ``struct['train/images']`` — the canonical '/'-joined path used by
+  parsers, feed dicts and serialization;
+* **hierarchical**: ``struct.train.images`` — attribute access; intermediate
+  nodes are *live views* that share storage with the root, so mutations through
+  a view are visible everywhere.
+
+Leaves may be :class:`TensorSpec`, numpy arrays, jax arrays, or ``None``
+(placeholder for an absent optional tensor). Assigning a Mapping expands it
+into child paths.
+
+Unlike the reference (an OrderedDict subclass with TF ``nest`` integration),
+this is a small MutableMapping over a shared ordered store — it registers as a
+JAX pytree, so a SpecStruct of arrays can flow through ``jit``/``grad``
+directly.
+"""
+
+from __future__ import annotations
+
+import collections
+from collections import abc as collections_abc
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+_SEP = '/'
+
+# Leaf types a SpecStruct may hold. jax.Array is checked lazily to keep the
+# import soft for pure-data-side users.
+def _is_valid_leaf(value: Any) -> bool:
+  if value is None or isinstance(value, (TensorSpec, np.ndarray, np.generic)):
+    return True
+  type_name = type(value).__module__ + '.' + type(value).__name__
+  if type_name.startswith('jax') or 'Array' in type(value).__name__:
+    return True
+  # Host-side pipelines may hold tf.Tensors; accept anything tensor-like.
+  if hasattr(value, 'dtype') and hasattr(value, 'shape'):
+    return True
+  return isinstance(value, (bytes, str, int, float))
+
+
+class SpecStruct(collections_abc.MutableMapping):
+  """Ordered flat path->leaf mapping with live hierarchical views."""
+
+  __slots__ = ('_store', '_prefix')
+
+  def __init__(self, *args, **kwargs):
+    object.__setattr__(self, '_store', collections.OrderedDict())
+    object.__setattr__(self, '_prefix', '')
+    if args:
+      if len(args) > 1:
+        raise TypeError('SpecStruct accepts at most one positional argument.')
+      initial = args[0]
+      if isinstance(initial, collections_abc.Mapping):
+        initial = initial.items()
+      for key, value in initial:
+        self[key] = value
+    for key, value in kwargs.items():
+      self[key] = value
+
+  # ----------------------------------------------------------------- views
+
+  @classmethod
+  def _view(cls, store: collections.OrderedDict, prefix: str) -> 'SpecStruct':
+    view = cls.__new__(cls)
+    object.__setattr__(view, '_store', store)
+    object.__setattr__(view, '_prefix', prefix)
+    return view
+
+  def _full(self, key: str) -> str:
+    if not isinstance(key, str):
+      raise TypeError(f'SpecStruct keys must be str, got {type(key)}')
+    key = key.strip(_SEP)
+    if not key:
+      raise KeyError('Empty key')
+    return self._prefix + key
+
+  def _is_subtree(self, full: str) -> bool:
+    probe = full + _SEP
+    return any(k.startswith(probe) for k in self._store)
+
+  # ------------------------------------------------------------ MutableMapping
+
+  def __getitem__(self, key: str):
+    full = self._full(key)
+    if full in self._store:
+      return self._store[full]
+    if self._is_subtree(full):
+      return SpecStruct._view(self._store, full + _SEP)
+    raise KeyError(key)
+
+  def __setitem__(self, key: str, value) -> None:
+    full = self._full(key)
+    if isinstance(value, SpecStruct):
+      value = dict(value.items())
+    if isinstance(value, collections_abc.Mapping):
+      if not value:
+        raise ValueError(f'Cannot assign an empty mapping to {key!r}.')
+      if full in self._store:
+        del self._store[full]
+      for sub_key, sub_value in value.items():
+        self[key + _SEP + sub_key] = sub_value
+      return
+    if not _is_valid_leaf(value):
+      raise ValueError(
+          f'Invalid leaf for SpecStruct[{key!r}]: {type(value)}. Expected '
+          'TensorSpec, ndarray, jax array, tensor-like, or None.')
+    if self._is_subtree(full):
+      raise ValueError(
+          f'Cannot assign a leaf to {key!r}: it is an existing subtree.')
+    # The reverse conflict: writing a child under an existing leaf would make
+    # that path simultaneously a leaf and a subtree.
+    parts = full.split(_SEP)
+    for i in range(1, len(parts)):
+      ancestor = _SEP.join(parts[:i])
+      if ancestor in self._store:
+        raise ValueError(
+            f'Cannot assign {key!r}: ancestor {ancestor!r} is an existing '
+            'leaf.')
+    self._store[full] = value
+
+  def __delitem__(self, key: str) -> None:
+    full = self._full(key)
+    if full in self._store:
+      del self._store[full]
+      return
+    subtree_keys = [
+        k for k in self._store if k.startswith(full + _SEP)]
+    if not subtree_keys:
+      raise KeyError(key)
+    for k in subtree_keys:
+      del self._store[k]
+
+  def __iter__(self) -> Iterator[str]:
+    if not self._prefix:
+      yield from list(self._store)
+      return
+    n = len(self._prefix)
+    for k in list(self._store):
+      if k.startswith(self._prefix):
+        yield k[n:]
+
+  def __len__(self) -> int:
+    return sum(1 for _ in self)
+
+  def __contains__(self, key) -> bool:
+    try:
+      full = self._full(key)
+    except (TypeError, KeyError):
+      return False
+    return full in self._store or self._is_subtree(full)
+
+  # -------------------------------------------------------------- attributes
+
+  def __getattr__(self, name: str):
+    if name.startswith('_'):
+      raise AttributeError(name)
+    try:
+      return self[name]
+    except KeyError:
+      raise AttributeError(
+          f'SpecStruct has no child {name!r}; children: {list(self)[:20]}')
+
+  def __setattr__(self, name: str, value) -> None:
+    if name.startswith('_'):
+      object.__setattr__(self, name, value)
+    else:
+      self[name] = value
+
+  def __delattr__(self, name: str) -> None:
+    if name.startswith('_'):
+      object.__delattr__(self, name)
+    else:
+      del self[name]
+
+  # ----------------------------------------------------------------- helpers
+
+  def is_leaf(self, key: str) -> bool:
+    return self._full(key) in self._store
+
+  def to_dict(self) -> collections.OrderedDict:
+    """Plain flat OrderedDict of path -> leaf (relative to this view)."""
+    return collections.OrderedDict(self.items())
+
+  def to_nested_dict(self) -> collections.OrderedDict:
+    """Nested plain-dict rendering of the hierarchy."""
+    out = collections.OrderedDict()
+    for path, value in self.items():
+      node = out
+      parts = path.split(_SEP)
+      for part in parts[:-1]:
+        node = node.setdefault(part, collections.OrderedDict())
+      node[parts[-1]] = value
+    return out
+
+  def copy(self) -> 'SpecStruct':
+    return SpecStruct(self.items())
+
+  def __eq__(self, other) -> bool:
+    if not isinstance(other, collections_abc.Mapping):
+      return NotImplemented
+    if set(self.keys()) != set(other.keys()):
+      return False
+    for key, value in self.items():
+      other_value = other[key]
+      if isinstance(value, (np.ndarray, np.generic)) or isinstance(
+          other_value, (np.ndarray, np.generic)):
+        if not np.array_equal(np.asarray(value), np.asarray(other_value)):
+          return False
+      elif value != other_value:
+        return False
+    return True
+
+  def __repr__(self) -> str:
+    items = ', '.join(f'{k!r}: {v!r}' for k, v in self.items())
+    return f'SpecStruct({{{items}}})'
+
+  # ------------------------------------------------------------- proto / io
+
+  def to_proto(self):
+    from tensor2robot_tpu.proto import t2r_pb2
+
+    proto = t2r_pb2.TensorSpecStruct()
+    for key, value in self.items():
+      if value is None:
+        continue
+      if not isinstance(value, TensorSpec):
+        value = TensorSpec.from_array(value)
+      proto.key_value[key].CopyFrom(value.to_proto())
+    return proto
+
+  @classmethod
+  def from_proto(cls, proto) -> 'SpecStruct':
+    items = sorted(proto.key_value.items())
+    return cls([(k, TensorSpec.from_proto(v)) for k, v in items])
+
+  def to_json_dict(self) -> dict:
+    out = {}
+    for key, value in self.items():
+      if value is None:
+        continue
+      if not isinstance(value, TensorSpec):
+        value = TensorSpec.from_array(value)
+      out[key] = value.to_json_dict()
+    return out
+
+  @classmethod
+  def from_json_dict(cls, d: dict) -> 'SpecStruct':
+    return cls([(k, TensorSpec.from_json_dict(v)) for k, v in sorted(
+        d.items())])
+
+
+# The reference name; new code should prefer the shorter `SpecStruct`.
+TensorSpecStruct = SpecStruct
+
+
+def _register_pytree() -> None:
+  """SpecStructs of jax arrays flow through jit/grad as pytrees."""
+  import jax
+
+  def flatten(struct: SpecStruct):
+    keys = list(struct.keys())
+    values = [struct[k] for k in keys]
+    return values, tuple(keys)
+
+  def flatten_with_keys(struct: SpecStruct):
+    keys = list(struct.keys())
+    return [(jax.tree_util.DictKey(k), struct[k]) for k in keys], tuple(keys)
+
+  def unflatten(keys, values):
+    return SpecStruct(zip(keys, values))
+
+  try:
+    jax.tree_util.register_pytree_with_keys(
+        SpecStruct, flatten_with_keys, unflatten, flatten)
+  except ValueError:  # pragma: no cover - double registration on reload.
+    pass
+
+
+try:
+  _register_pytree()
+except ImportError:  # pragma: no cover - jax is a hard dep in practice.
+  pass

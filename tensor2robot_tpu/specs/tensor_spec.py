@@ -1,0 +1,300 @@
+"""TensorSpec: the typed declaration of a single tensor.
+
+TPU-native re-design of the reference's ``ExtendedTensorSpec``
+(``/root/reference/utils/tensorspec_utils.py:44-282``). The reference subclasses
+``tf.TensorSpec``; here the spec is a frozen, hashable, pure-Python dataclass
+with a numpy dtype so the core framework has **no TensorFlow dependency** —
+JAX views are produced on demand via :meth:`to_shape_dtype_struct`.
+
+Fields beyond shape/dtype/name (same capability surface as the reference):
+
+* ``is_optional``: the tensor may be absent from data; validation tolerates it.
+* ``is_sequence``: the leading (non-batch) dimension is a runtime-varying
+  sequence length (SequenceExample-style parsing).
+* ``is_extracted``: marks specs derived from concrete tensors/arrays, whose
+  shape already includes batch/sequence dims.
+* ``data_format``: 'JPEG'/'PNG' marks an encoded-image feature that the data
+  layer must decode.
+* ``dataset_key``: routes the feature to a named dataset in multi-dataset
+  input pipelines.
+* ``varlen_default_value``: if set, the feature is parsed as a variable-length
+  list padded/clipped to ``shape`` with this value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:  # bfloat16 as a real numpy dtype (ships with jax).
+  import ml_dtypes  # pytype: disable=import-error
+
+  bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax.
+  bfloat16 = np.dtype('float32')
+
+DTypeLike = Any
+ShapeLike = Union[Sequence[Optional[int]], int, None]
+
+_IMAGE_FORMATS = ('JPEG', 'PNG')
+
+
+def as_dtype(dtype: DTypeLike) -> np.dtype:
+  """Canonicalizes tf/jax/numpy/string dtypes to a numpy dtype."""
+  if dtype is None:
+    raise ValueError('dtype must not be None')
+  # tf.DType and jnp dtypes both expose `.name`; strings & np types go
+  # straight through np.dtype.
+  name = getattr(dtype, 'name', None)
+  if name is not None and not isinstance(dtype, np.dtype):
+    if name == 'bfloat16':
+      return bfloat16
+    return np.dtype(name)
+  if isinstance(dtype, str) and dtype == 'bfloat16':
+    return bfloat16
+  return np.dtype(dtype)
+
+
+def dtype_name(dtype: DTypeLike) -> str:
+  return as_dtype(dtype).name
+
+
+def _canonical_shape(shape: ShapeLike) -> Tuple[Optional[int], ...]:
+  if shape is None:
+    return ()
+  if isinstance(shape, (int, np.integer)):
+    return (int(shape),)
+  out = []
+  for dim in shape:
+    if dim is None:
+      out.append(None)
+    else:
+      d = int(dim)
+      out.append(None if d < 0 else d)
+  return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+  """A frozen declaration of one tensor's shape, dtype and data semantics."""
+
+  shape: Tuple[Optional[int], ...]
+  dtype: np.dtype
+  name: Optional[str] = None
+  is_optional: bool = False
+  is_sequence: bool = False
+  is_extracted: bool = False
+  data_format: Optional[str] = None
+  dataset_key: str = ''
+  varlen_default_value: Optional[float] = None
+
+  def __init__(self,
+               shape: ShapeLike,
+               dtype: DTypeLike,
+               name: Optional[str] = None,
+               is_optional: Optional[bool] = None,
+               is_sequence: Optional[bool] = None,
+               is_extracted: Optional[bool] = None,
+               data_format: Optional[str] = None,
+               dataset_key: Optional[str] = None,
+               varlen_default_value: Optional[float] = None):
+    object.__setattr__(self, 'shape', _canonical_shape(shape))
+    object.__setattr__(self, 'dtype', as_dtype(dtype))
+    object.__setattr__(self, 'name', name)
+    object.__setattr__(self, 'is_optional', bool(is_optional))
+    object.__setattr__(self, 'is_sequence', bool(is_sequence))
+    object.__setattr__(self, 'is_extracted', bool(is_extracted))
+    if data_format is not None:
+      data_format = data_format.upper()
+      if data_format not in _IMAGE_FORMATS:
+        raise ValueError(
+            f'data_format must be one of {_IMAGE_FORMATS}, got {data_format}')
+    object.__setattr__(self, 'data_format', data_format)
+    object.__setattr__(self, 'dataset_key', dataset_key or '')
+    if varlen_default_value is not None:
+      varlen_default_value = float(varlen_default_value)
+    object.__setattr__(self, 'varlen_default_value', varlen_default_value)
+
+  # ---------------------------------------------------------------- factories
+
+  @classmethod
+  def from_spec(cls,
+                spec: 'TensorSpec',
+                shape: ShapeLike = None,
+                dtype: DTypeLike = None,
+                name: Optional[str] = None,
+                batch_size: int = -1,
+                **overrides) -> 'TensorSpec':
+    """Copy of ``spec`` with optional overrides.
+
+    ``batch_size`` follows the reference's placeholder convention: ``-1`` →
+    leave the shape alone, ``None`` → prepend a dynamic batch dim, ``N>0`` →
+    prepend a fixed batch dim.
+    """
+    kwargs = dict(
+        shape=spec.shape if shape is None else _canonical_shape(shape),
+        dtype=spec.dtype if dtype is None else as_dtype(dtype),
+        name=spec.name if name is None else name,
+        is_optional=spec.is_optional,
+        is_sequence=spec.is_sequence,
+        is_extracted=spec.is_extracted,
+        data_format=spec.data_format,
+        dataset_key=spec.dataset_key,
+        varlen_default_value=spec.varlen_default_value,
+    )
+    kwargs.update(overrides)
+    if batch_size is None:
+      kwargs['shape'] = (None,) + tuple(kwargs['shape'])
+    elif batch_size != -1:
+      kwargs['shape'] = (int(batch_size),) + tuple(kwargs['shape'])
+    return cls(**kwargs)
+
+  @classmethod
+  def from_array(cls,
+                 array,
+                 name: Optional[str] = None) -> 'TensorSpec':
+    """Spec extracted from a concrete ndarray / jax.Array."""
+    return cls(
+        shape=tuple(int(d) for d in np.shape(array)),
+        dtype=as_dtype(getattr(array, 'dtype', np.asarray(array).dtype)),
+        name=name,
+        is_extracted=True)
+
+  # Kept as an alias so call sites mirror the reference API (`from_tensor`).
+  from_tensor = from_array
+
+  @classmethod
+  def to_spec(cls, instance) -> 'TensorSpec':
+    """Normalizes a spec or a concrete array to a TensorSpec."""
+    if isinstance(instance, TensorSpec):
+      return instance
+    return cls.from_array(instance)
+
+  # ------------------------------------------------------------------- views
+
+  def to_shape_dtype_struct(self, batch_size: Optional[int] = None):
+    """A ``jax.ShapeDtypeStruct`` view for jit/eval_shape.
+
+    Dynamic (None) dims are not representable in jit-land; they must be
+    resolved before tracing, so we raise if any remain.
+    """
+    import jax
+
+    shape = self.shape
+    if batch_size is not None and batch_size != -1:
+      shape = (batch_size,) + shape
+    if any(d is None for d in shape):
+      raise ValueError(
+          f'Cannot build ShapeDtypeStruct with dynamic dims: {self}')
+    return jax.ShapeDtypeStruct(shape, self.dtype)
+
+  @property
+  def is_encoded_image(self) -> bool:
+    return self.data_format in _IMAGE_FORMATS
+
+  # -------------------------------------------------------------- proto / io
+
+  def to_proto(self):
+    from tensor2robot_tpu.proto import t2r_pb2
+
+    proto = t2r_pb2.ExtendedTensorSpec()
+    for dim in self.shape:
+      proto.shape.append(-1 if dim is None else dim)
+    proto.dtype = self.dtype.name if self.dtype != bfloat16 else 'bfloat16'
+    if self.name is not None:
+      proto.name = self.name
+    proto.is_optional = self.is_optional
+    proto.is_sequence = self.is_sequence
+    proto.is_extracted = self.is_extracted
+    if self.data_format is not None:
+      proto.data_format = self.data_format
+    if self.dataset_key:
+      proto.dataset_key = self.dataset_key
+    if self.varlen_default_value is not None:
+      proto.varlen_default_value = self.varlen_default_value
+      proto.has_varlen_default_value = True
+    return proto
+
+  @classmethod
+  def from_proto(cls, proto) -> 'TensorSpec':
+    shape = tuple(None if d < 0 else d for d in proto.shape)
+    return cls(
+        shape=shape,
+        dtype=proto.dtype or 'float32',
+        name=proto.name or None,
+        is_optional=proto.is_optional,
+        is_sequence=proto.is_sequence,
+        is_extracted=proto.is_extracted,
+        data_format=proto.data_format or None,
+        dataset_key=proto.dataset_key or None,
+        varlen_default_value=(proto.varlen_default_value
+                              if proto.has_varlen_default_value else None))
+
+  def to_json_dict(self) -> dict:
+    d = {
+        'shape': [-1 if s is None else s for s in self.shape],
+        'dtype': self.dtype.name,
+    }
+    if self.name is not None:
+      d['name'] = self.name
+    for field in ('is_optional', 'is_sequence', 'is_extracted'):
+      if getattr(self, field):
+        d[field] = True
+    if self.data_format is not None:
+      d['data_format'] = self.data_format
+    if self.dataset_key:
+      d['dataset_key'] = self.dataset_key
+    if self.varlen_default_value is not None:
+      d['varlen_default_value'] = self.varlen_default_value
+    return d
+
+  @classmethod
+  def from_json_dict(cls, d: dict) -> 'TensorSpec':
+    return cls(
+        shape=tuple(None if s < 0 else s for s in d['shape']),
+        dtype=d['dtype'],
+        name=d.get('name'),
+        is_optional=d.get('is_optional', False),
+        is_sequence=d.get('is_sequence', False),
+        is_extracted=d.get('is_extracted', False),
+        data_format=d.get('data_format'),
+        dataset_key=d.get('dataset_key'),
+        varlen_default_value=d.get('varlen_default_value'))
+
+  # --------------------------------------------------------------- equality
+
+  def __eq__(self, other) -> bool:
+    if not isinstance(other, TensorSpec):
+      return NotImplemented
+    return (self.shape == other.shape and self.dtype == other.dtype and
+            self.name == other.name and
+            self.is_optional == other.is_optional and
+            self.is_sequence == other.is_sequence and
+            self.data_format == other.data_format and
+            self.dataset_key == other.dataset_key and
+            self.varlen_default_value == other.varlen_default_value)
+
+  def __hash__(self):
+    return hash((self.shape, self.dtype, self.name, self.is_optional,
+                 self.is_sequence, self.data_format, self.dataset_key))
+
+  def __repr__(self):
+    parts = [f'shape={self.shape}', f'dtype={self.dtype.name}']
+    if self.name:
+      parts.append(f'name={self.name!r}')
+    for field in ('is_optional', 'is_sequence', 'is_extracted'):
+      if getattr(self, field):
+        parts.append(f'{field}=True')
+    if self.data_format:
+      parts.append(f'data_format={self.data_format!r}')
+    if self.dataset_key:
+      parts.append(f'dataset_key={self.dataset_key!r}')
+    if self.varlen_default_value is not None:
+      parts.append(f'varlen_default_value={self.varlen_default_value}')
+    return f'TensorSpec({", ".join(parts)})'
+
+
+# The reference name; new code should prefer the shorter `TensorSpec`.
+ExtendedTensorSpec = TensorSpec

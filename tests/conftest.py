@@ -1,0 +1,17 @@
+"""Test harness: all tests run on a virtual 8-device CPU mesh.
+
+Multi-chip sharding (dp/fsdp/tp/sp) is validated without TPU hardware by
+forcing the host platform to expose 8 XLA CPU devices, mirroring how the
+driver dry-runs `__graft_entry__.dryrun_multichip`.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+  os.environ['XLA_FLAGS'] = (
+      xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+# Keep TF (host data pipeline only) off any accelerator and quiet.
+os.environ.setdefault('CUDA_VISIBLE_DEVICES', '-1')
+os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')

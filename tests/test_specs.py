@@ -1,0 +1,351 @@
+"""Conformance tests for the spec system.
+
+Coverage mirrors the reference's de-facto conformance suite
+(`utils/tensorspec_utils_test.py`): spec construction, flat/hierarchical
+struct semantics, flatten/pack/validate with optionals and sequences, dtype
+policy, numpy generation, proto round-trips, and asset I/O.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+
+def simple_spec():
+  s = SpecStruct()
+  s['state'] = TensorSpec(shape=(8, 128), dtype=np.float32, name='s')
+  s['action'] = TensorSpec(shape=(8,), dtype=np.float32, name='a')
+  return s
+
+
+def nested_spec():
+  s = SpecStruct()
+  s['train/images'] = TensorSpec((64, 64, 3), np.float32, name='train_img')
+  s['train/actions'] = TensorSpec((2,), np.float32, name='train_act')
+  s['val/images'] = TensorSpec((64, 64, 3), np.float32, name='val_img')
+  s['optional_debug'] = TensorSpec((4,), np.float32, name='dbg',
+                                   is_optional=True)
+  return s
+
+
+class TestTensorSpec:
+
+  def test_basic_construction(self):
+    spec = TensorSpec(shape=(3, 4), dtype='float32', name='x')
+    assert spec.shape == (3, 4)
+    assert spec.dtype == np.float32
+    assert not spec.is_optional
+
+  def test_int_shape_and_negative_dims(self):
+    assert TensorSpec(shape=5, dtype=np.int32).shape == (5,)
+    assert TensorSpec(shape=(-1, 3), dtype=np.int32).shape == (None, 3)
+
+  def test_bfloat16(self):
+    spec = TensorSpec((2,), 'bfloat16')
+    assert spec.dtype == specs.bfloat16
+    assert specs.dtype_name(spec.dtype) == 'bfloat16'
+
+  def test_from_spec_overrides(self):
+    base = TensorSpec((3,), np.float32, name='x', is_optional=True,
+                      data_format='jpeg')
+    copy = TensorSpec.from_spec(base, name='y')
+    assert copy.name == 'y'
+    assert copy.is_optional
+    assert copy.data_format == 'JPEG'
+    batched = TensorSpec.from_spec(base, batch_size=16)
+    assert batched.shape == (16, 3)
+    dynamic = TensorSpec.from_spec(base, batch_size=None)
+    assert dynamic.shape == (None, 3)
+
+  def test_from_array(self):
+    spec = TensorSpec.from_array(np.zeros((2, 3), np.int64), name='z')
+    assert spec.shape == (2, 3)
+    assert spec.dtype == np.int64
+    assert spec.is_extracted
+
+  def test_invalid_data_format(self):
+    with pytest.raises(ValueError):
+      TensorSpec((1,), np.float32, data_format='GIF')
+
+  def test_equality_and_hash(self):
+    a = TensorSpec((3,), np.float32, name='x')
+    b = TensorSpec((3,), np.float32, name='x')
+    c = TensorSpec((3,), np.float32, name='y')
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+
+  def test_proto_roundtrip(self):
+    spec = TensorSpec((None, 3), 'bfloat16', name='img', is_optional=True,
+                      is_sequence=True, data_format='PNG', dataset_key='d1',
+                      varlen_default_value=-1.0)
+    restored = TensorSpec.from_proto(spec.to_proto())
+    assert restored == spec
+    assert restored.is_sequence
+
+  def test_json_roundtrip(self):
+    spec = TensorSpec((4,), np.uint8, name='img', data_format='JPEG')
+    assert TensorSpec.from_json_dict(spec.to_json_dict()) == spec
+
+  def test_shape_dtype_struct(self):
+    spec = TensorSpec((3, 4), np.float32)
+    sds = spec.to_shape_dtype_struct(batch_size=8)
+    assert sds.shape == (8, 3, 4)
+    with pytest.raises(ValueError):
+      TensorSpec((None, 3), np.float32).to_shape_dtype_struct()
+
+
+class TestSpecStruct:
+
+  def test_flat_and_hierarchical_access(self):
+    s = nested_spec()
+    assert s['train/images'] is s.train.images
+    assert s.train['actions'].name == 'train_act'
+    assert set(s.train.keys()) == {'images', 'actions'}
+
+  def test_views_are_live(self):
+    s = nested_spec()
+    view = s.train
+    view['new'] = TensorSpec((1,), np.float32)
+    assert 'train/new' in s
+    del s['train/new']
+    assert 'new' not in view
+
+  def test_assign_nested_mapping(self):
+    s = SpecStruct()
+    s['meta'] = {'a': TensorSpec((1,), np.float32),
+                 'b': {'c': TensorSpec((2,), np.int32)}}
+    assert list(s) == ['meta/a', 'meta/b/c']
+
+  def test_attribute_set_and_delete(self):
+    s = SpecStruct()
+    s.foo = TensorSpec((1,), np.float32)
+    assert 'foo' in s
+    del s.foo
+    assert 'foo' not in s
+
+  def test_leaf_vs_subtree_conflict(self):
+    s = nested_spec()
+    with pytest.raises(ValueError):
+      s['train'] = TensorSpec((1,), np.float32)
+
+  def test_holds_arrays(self):
+    s = SpecStruct()
+    s['x'] = np.zeros((2, 2))
+    assert isinstance(s.x, np.ndarray)
+
+  def test_order_preserved(self):
+    s = simple_spec()
+    assert list(s) == ['state', 'action']
+
+  def test_equality(self):
+    assert simple_spec() == simple_spec()
+    a = SpecStruct({'x': np.ones(2)})
+    b = SpecStruct({'x': np.ones(2)})
+    assert a == b
+
+  def test_proto_roundtrip(self):
+    s = nested_spec()
+    restored = SpecStruct.from_proto(s.to_proto())
+    assert dict(restored.items()) == dict(s.items())
+
+  def test_pytree_registration(self):
+    import jax
+
+    s = SpecStruct({'a/x': np.ones(2, np.float32),
+                    'b': np.zeros(3, np.float32)})
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, s)
+    assert isinstance(doubled, SpecStruct)
+    np.testing.assert_allclose(np.asarray(doubled['a/x']), 2.0)
+
+
+class TestAlgebra:
+
+  def test_flatten_nested_dict(self):
+    flat = specs.flatten_spec_structure(
+        {'a': {'b': TensorSpec((1,), np.float32)},
+         'c': TensorSpec((2,), np.float32)})
+    assert set(flat) == {'a/b', 'c'}
+
+  def test_flatten_namedtuple_and_list(self):
+    import collections
+    Pair = collections.namedtuple('Pair', ['x', 'y'])
+    flat = specs.flatten_spec_structure(
+        Pair(x=TensorSpec((1,), np.float32),
+             y=[TensorSpec((2,), np.float32), TensorSpec((3,), np.float32)]))
+    assert set(flat) == {'x', 'y/0', 'y/1'}
+
+  def test_flatten_filters_none(self):
+    flat = specs.flatten_spec_structure({'a': None,
+                                         'b': TensorSpec((1,), np.float32)})
+    assert set(flat) == {'b'}
+    flat2 = specs.flatten_spec_structure(
+        {'a': None, 'b': TensorSpec((1,), np.float32)}, filter_none=False)
+    assert set(flat2) == {'a', 'b'}
+
+  def test_pack_required_and_optional(self):
+    spec = nested_spec()
+    data = {k: np.zeros([1 if d is None else d for d in v.shape], v.dtype)
+            for k, v in spec.items() if not v.is_optional}
+    packed = specs.validate_and_pack(spec, data, ignore_batch=False)
+    assert 'optional_debug' not in packed
+    assert isinstance(packed.train.images, np.ndarray)
+
+  def test_pack_missing_required_raises(self):
+    spec = simple_spec()
+    with pytest.raises(ValueError, match='required'):
+      specs.validate_and_pack(spec, {'state': np.zeros((8, 128), np.float32)})
+
+  def test_validate_dtype_mismatch(self):
+    spec = simple_spec()
+    data = {'state': np.zeros((8, 128), np.float64),
+            'action': np.zeros((8,), np.float32)}
+    with pytest.raises(ValueError, match='dtype'):
+      specs.validate_and_flatten(spec, data)
+
+  def test_validate_shape_mismatch(self):
+    spec = simple_spec()
+    data = {'state': np.zeros((8, 64), np.float32),
+            'action': np.zeros((8,), np.float32)}
+    with pytest.raises(ValueError, match='shape'):
+      specs.validate_and_flatten(spec, data)
+
+  def test_ignore_batch(self):
+    spec = simple_spec()
+    data = {'state': np.zeros((4, 8, 128), np.float32),
+            'action': np.zeros((4, 8), np.float32)}
+    flat = specs.validate_and_flatten(spec, data, ignore_batch=True)
+    assert flat['state'].shape == (4, 8, 128)
+
+  def test_none_wildcard_dims(self):
+    spec = SpecStruct({'x': TensorSpec((None, 3), np.float32)})
+    specs.validate_and_flatten(spec, {'x': np.zeros((7, 3), np.float32)})
+
+  def test_sequence_vs_extracted(self):
+    spec = SpecStruct(
+        {'seq': TensorSpec((5,), np.float32, is_sequence=True)})
+    # Extracted tensor carries [time, 5]; sequence dim must be stripped.
+    data = {'seq': np.zeros((9, 5), np.float32)}
+    specs.validate_and_flatten(spec, data)
+
+  def test_copy_spec_structure(self):
+    out = specs.copy_spec_structure(simple_spec(), prefix='cond',
+                                    batch_size=4)
+    assert out['state'].name == 'cond/s'
+    assert out['state'].shape == (4, 8, 128)
+
+  def test_filter_required(self):
+    flat = specs.filter_required_flat_tensor_spec(
+        specs.flatten_spec_structure(nested_spec()))
+    assert 'optional_debug' not in flat
+
+  def test_filter_by_dataset(self):
+    s = SpecStruct({
+        'a': TensorSpec((1,), np.float32, dataset_key='d1'),
+        'b': TensorSpec((1,), np.float32, dataset_key='d2')})
+    assert set(specs.filter_spec_structure_by_dataset(s, 'd1')) == {'a'}
+    assert set(specs.filter_spec_structure_by_dataset(s, '')) == {'a', 'b'}
+
+  def test_add_sequence_length_specs(self):
+    s = SpecStruct({'seq': TensorSpec((5,), np.float32, name='q',
+                                      is_sequence=True)})
+    out = specs.add_sequence_length_specs(s)
+    assert out['seq_length'].dtype == np.int64
+    assert out['seq_length'].name == 'q_length'
+
+  def test_spec_names_dedup(self):
+    s = SpecStruct({
+        'a/x': TensorSpec((1,), np.float32, name='shared'),
+        'b/x': TensorSpec((1,), np.float32, name='shared')})
+    names = specs.spec_names(s)
+    assert list(names) == ['shared']
+    bad = SpecStruct({
+        'a/x': TensorSpec((1,), np.float32, name='shared'),
+        'b/x': TensorSpec((2,), np.float32, name='shared')})
+    with pytest.raises(ValueError, match='Duplicate'):
+      specs.spec_names(bad)
+
+  def test_pad_or_clip(self):
+    spec = TensorSpec((4, 2), np.float32, varlen_default_value=-1.0)
+    padded = specs.pad_or_clip_to_spec_shape(
+        np.ones((2, 2), np.float32), spec)
+    assert padded.shape == (4, 2)
+    assert padded[2, 0] == -1.0
+    clipped = specs.pad_or_clip_to_spec_shape(
+        np.ones((6, 2), np.float32), spec)
+    assert clipped.shape == (4, 2)
+
+
+class TestDtypePolicy:
+
+  def test_replace_and_cast_specs(self):
+    s = SpecStruct({'x': TensorSpec((1,), np.float32),
+                    'i': TensorSpec((1,), np.int32)})
+    bf = specs.cast_float32_to_bfloat16(s)
+    assert bf['x'].dtype == specs.bfloat16
+    assert bf['i'].dtype == np.int32
+    back = specs.cast_bfloat16_to_float32(bf)
+    assert back['x'].dtype == np.float32
+
+  def test_cast_arrays_to_spec_dtypes(self):
+    import jax.numpy as jnp
+
+    spec = specs.cast_float32_to_bfloat16(
+        SpecStruct({'x': TensorSpec((2,), np.float32)}))
+    out = specs.cast_arrays_to_spec_dtypes(
+        spec, {'x': jnp.ones((2,), jnp.float32)})
+    assert out['x'].dtype == jnp.bfloat16
+
+
+class TestNumpyGen:
+
+  def test_make_random_numpy(self):
+    data = specs.make_random_numpy(nested_spec(), batch_size=3, seed=0)
+    assert data['train/images'].shape == (3, 64, 64, 3)
+    assert data['train/images'].dtype == np.float32
+
+  def test_make_constant_numpy(self):
+    data = specs.make_constant_numpy(simple_spec(), 2.5, batch_size=2)
+    assert float(data['state'][0, 0, 0]) == 2.5
+
+  def test_sequence_dims(self):
+    s = SpecStruct({'seq': TensorSpec((5,), np.float32, is_sequence=True)})
+    data = specs.make_random_numpy(s, batch_size=2, sequence_length=7)
+    assert data['seq'].shape == (2, 7, 5)
+
+  def test_shape_dtype_structs(self):
+    sds = specs.make_shape_dtype_structs(simple_spec(), batch_size=4)
+    assert sds['state'].shape == (4, 8, 128)
+
+  def test_feed_dict_roundtrip(self):
+    spec = simple_spec()
+    data = specs.make_random_numpy(spec, batch_size=2, seed=1)
+    feed = specs.map_feed_dict(spec, data, ignore_batch=True)
+    assert set(feed) == {'s', 'a'}
+    packed = specs.pack_feed_dict(spec, feed)
+    np.testing.assert_array_equal(packed['state'], data['state'])
+
+  def test_feed_dict_missing_required(self):
+    with pytest.raises(ValueError, match='required'):
+      specs.map_feed_dict(simple_spec(), {'state': np.zeros((8, 128),
+                                                            np.float32)})
+
+
+class TestAssets:
+
+  def test_roundtrip(self):
+    feature_spec = nested_spec()
+    label_spec = simple_spec()
+    with tempfile.TemporaryDirectory() as tmp:
+      specs.write_assets_to_export_dir(tmp, feature_spec, label_spec,
+                                       global_step=123)
+      f, l, step = specs.load_specs_from_export_dir(tmp)
+      assert step == 123
+      assert dict(f.items()) == {
+          k: v for k, v in feature_spec.items() if v is not None}
+      assert os.path.exists(
+          os.path.join(tmp, 'assets.extra', 't2r_assets.json'))
