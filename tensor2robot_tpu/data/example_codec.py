@@ -1,0 +1,343 @@
+"""Spec-driven tf.Example / SequenceExample codec.
+
+TPU-native re-design of the reference's auto-generated parser
+(``/root/reference/utils/tfdata.py:254-524`` and
+``utils/tensorspec_utils.py:1553-1624``): from a spec structure alone we
+generate (a) the tf.io feature map, (b) a batched parse function, and (c) the
+inverse encoder used by replay writers and tests.
+
+This module is the only place TensorFlow tensors touch specs — it runs on
+host CPUs inside tf.data; devices only ever see the resulting numpy batches.
+
+Parsing semantics preserved from the reference:
+
+* features are addressed by spec *name* on disk and re-keyed to spec *paths*
+  in the output (the same parsed tensor may serve several paths);
+* bfloat16-declared specs are parsed as float32 and cast back after parsing;
+* specs with ``data_format`` JPEG/PNG are parsed as strings then decoded,
+  with empty strings decoded as all-zero images, including fixed-length lists
+  of images (leading shape dims) and batched decode;
+* specs with ``varlen_default_value`` parse as VarLen, densify with that
+  default, then pad-or-clip dim 0 to the spec shape;
+* ``is_sequence`` specs parse from SequenceExamples and emit a ``<key>_length``
+  int64 tensor alongside;
+* multi-dataset parsing: each spec's ``dataset_key`` routes it to one of the
+  zipped serialized-example streams.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.specs import (SpecStruct, TensorSpec, algebra, bfloat16)
+
+
+def _tf():
+  import tensorflow as tf  # local import: host-only dependency
+  return tf
+
+
+SUPPORTED_PIXEL_DTYPES = (np.uint8, np.uint16)
+# tf.Example can natively hold only these (reference tfdata.py:328-331).
+_PARSEABLE_DTYPES = ('float32', 'int64', 'string', 'bfloat16')
+
+
+def is_encoded_image_spec(spec: TensorSpec) -> bool:
+  return spec.is_encoded_image
+
+
+def _parse_dtype(spec: TensorSpec):
+  """The dtype handed to the tf parser for a given spec."""
+  tf = _tf()
+  if spec.is_encoded_image:
+    return tf.string
+  if spec.dtype == bfloat16:
+    return tf.float32
+  name = spec.dtype.name
+  if name not in _PARSEABLE_DTYPES:
+    raise ValueError(
+        f'Feature spec dtype {name!r} cannot be parsed from tf.Example; '
+        f'supported: {_PARSEABLE_DTYPES} (spec: {spec})')
+  return tf.dtypes.as_dtype(name)
+
+
+def spec_to_tf_feature(spec: TensorSpec, decode_images: bool = True):
+  """tf.io.*Feature for one spec (reference _get_feature semantics)."""
+  tf = _tf()
+  dtype = _parse_dtype(spec)
+  if spec.is_sequence:
+    if spec.is_encoded_image and decode_images:
+      return tf.io.FixedLenSequenceFeature((), tf.string)
+    return tf.io.FixedLenSequenceFeature(spec.shape, dtype)
+  if spec.varlen_default_value is not None:
+    return tf.io.VarLenFeature(
+        tf.string if (spec.is_encoded_image and decode_images) else dtype)
+  if spec.is_encoded_image and decode_images:
+    if len(spec.shape) > 3:
+      # A fixed-length list of encoded images.
+      return tf.io.FixedLenFeature((spec.shape[0],), tf.string)
+    return tf.io.FixedLenFeature((), tf.string)
+  return tf.io.FixedLenFeature(spec.shape, dtype)
+
+
+def spec_struct_to_feature_maps(
+    spec_struct, decode_images: bool = True
+) -> Tuple[dict, dict, 'collections.OrderedDict[str, TensorSpec]']:
+  """Builds (context_features, sequence_features, name->spec) maps."""
+  by_name = algebra.spec_names(spec_struct)
+  context, sequence = {}, {}
+  for name, spec in by_name.items():
+    feature = spec_to_tf_feature(spec, decode_images)
+    if spec.is_sequence:
+      sequence[name] = feature
+    else:
+      context[name] = feature
+  return context, sequence, by_name
+
+
+def _decode_image_tensor(raw_bytes, spec: TensorSpec):
+  """Decodes (possibly nested-batched) JPEG/PNG strings to spec shape."""
+  tf = _tf()
+  if len(spec.shape) < 3:
+    raise ValueError(
+        f'Encoded-image spec must be at least (h, w, c), got {spec}')
+  if spec.dtype.name not in ('uint8', 'uint16'):
+    raise ValueError(
+        f'Encoded-image spec must be uint8 or uint16, got {spec}')
+  single_dims = tuple(spec.shape[-3:])
+  channels = single_dims[2]
+  if channels not in (1, 3):
+    raise ValueError(f'Image channels must be 1 or 3, got {spec}')
+  dtype = tf.dtypes.as_dtype(spec.dtype.name)
+
+  batch_dims = tf.shape(raw_bytes)
+  flat = tf.reshape(raw_bytes, [-1])
+
+  def decode_one(image_bytes):
+    image = tf.cond(
+        tf.equal(image_bytes, ''),
+        lambda: tf.zeros(single_dims, dtype=dtype),
+        lambda: tf.io.decode_image(image_bytes, channels=channels,
+                                   dtype=dtype))
+    image.set_shape(single_dims)
+    return image
+
+  images = tf.map_fn(decode_one, flat, fn_output_signature=dtype)
+  return tf.reshape(images, tf.concat([batch_dims, single_dims], axis=0))
+
+
+def make_parse_fn(feature_spec,
+                  label_spec=None,
+                  decode_images: bool = True):
+  """Builds a batched parse fn: serialized examples -> (features[, labels]).
+
+  The returned callable accepts either a string tensor of serialized examples
+  or a dict ``{dataset_key: string tensor}`` for multi-dataset pipelines, and
+  returns SpecStructs of tf tensors keyed by spec *paths*, validated and
+  packed against the declared specs.
+  """
+  tf = _tf()
+
+  flat_feature_spec = SpecStruct(
+      sorted(algebra.flatten_spec_structure(feature_spec).items()))
+  flat_label_spec = None
+  if label_spec is not None:
+    flat_label_spec = SpecStruct(
+        sorted(algebra.flatten_spec_structure(label_spec).items()))
+
+  def parse_single_dataset(serialized, dataset_key):
+    """Parses one serialized stream; returns name-keyed tensors + specs."""
+    specs_for_dataset = SpecStruct()
+    for flat in (flat_feature_spec, flat_label_spec):
+      if flat is None:
+        continue
+      for key, spec in algebra.filter_spec_structure_by_dataset(
+          flat, dataset_key).items():
+        if spec.name is None:
+          # Resolve the on-disk name from the original path *before*
+          # prefixing, so unnamed specs keep their natural feature key.
+          spec = TensorSpec.from_spec(spec, name=key.split('/')[-1])
+        specs_for_dataset[('l_' if flat is flat_label_spec else 'f_') +
+                          key] = spec
+    context, sequence, by_name = spec_struct_to_feature_maps(
+        specs_for_dataset, decode_images)
+
+    if sequence:
+      parsed_context, parsed_sequence, lengths = tf.io.parse_sequence_example(
+          serialized, context_features=context, sequence_features=sequence)
+      parsed = dict(parsed_context)
+      parsed.update(parsed_sequence)
+      for name, length in lengths.items():
+        parsed[name + '_length'] = length
+        by_name[name + '_length'] = TensorSpec(
+            (), np.int64, name=name + '_length')
+    else:
+      parsed = tf.io.parse_example(serialized, context)
+
+    # Densify VarLen features (images default to '', data to the declared
+    # default) and pad/clip dim 1 (dim 0 is the batch) to the spec shape.
+    for name, spec in by_name.items():
+      if spec.varlen_default_value is None or name not in parsed:
+        continue
+      value = parsed[name]
+      if isinstance(value, tf.sparse.SparseTensor):
+        default = ('' if spec.is_encoded_image else tf.cast(
+            tf.constant(spec.varlen_default_value),
+            _parse_dtype(spec)))
+        value = tf.sparse.to_dense(value, default_value=default)
+      parsed[name] = value
+
+    # Decode images.
+    if decode_images:
+      for name, spec in by_name.items():
+        if spec.is_encoded_image and name in parsed:
+          parsed[name] = _decode_image_tensor(parsed[name], spec)
+
+    # Pad/clip varlen features along the per-example dim.
+    for name, spec in by_name.items():
+      if spec.varlen_default_value is None or name not in parsed:
+        continue
+      target = spec.shape[0]
+      if target is None:
+        continue
+      value = parsed[name]
+      trailing_dims = [int(d) for d in spec.shape[1:]]
+      if trailing_dims and not spec.is_encoded_image:
+        # VarLen parses as [batch, total_values]; restore trailing dims.
+        value = tf.reshape(
+            value, tf.concat([[tf.shape(value)[0], -1],
+                              tf.constant(trailing_dims, tf.int32)], axis=0))
+      length = tf.shape(value)[1]
+      pad_value = tf.constant(
+          0 if spec.is_encoded_image else spec.varlen_default_value,
+          dtype=value.dtype)
+      trailing = trailing_dims
+      padding_shape = tf.concat(
+          [[tf.shape(value)[0], tf.maximum(target - length, 0)],
+           tf.constant(trailing, dtype=tf.int32)], axis=0)
+      padded = tf.concat(
+          [value[:, :target], tf.fill(padding_shape, pad_value)], axis=1)
+      padded.set_shape([None, target] + trailing)
+      parsed[name] = padded
+
+    # bfloat16-declared features were parsed as float32; cast back so the
+    # batch conforms to the declared spec (device transfer is then free).
+    for name, spec in by_name.items():
+      if spec.dtype == bfloat16 and name in parsed:
+        parsed[name] = tf.cast(parsed[name], tf.bfloat16)
+    return parsed
+
+  def parse_fn(serialized):
+    if isinstance(serialized, dict):
+      streams = serialized
+    else:
+      streams = {'': serialized}
+    parsed_by_name = {}
+    for dataset_key, stream in streams.items():
+      for name, value in parse_single_dataset(stream, dataset_key).items():
+        parsed_by_name[dataset_key + name] = value
+
+    def pack(flat_spec):
+      with_lengths = algebra.add_sequence_length_specs(flat_spec)
+      tensors = SpecStruct()
+      for key, spec in with_lengths.items():
+        name = spec.dataset_key + (spec.name or key.split('/')[-1])
+        if name in parsed_by_name:
+          tensors[key] = parsed_by_name[name]
+        elif not spec.is_optional and spec.name is not None and (
+            not key.endswith('_length')):
+          raise ValueError(f'Parsed data is missing required {key!r} '
+                           f'({spec}).')
+      return algebra.pack_flat_sequence_to_spec_structure(
+          with_lengths, tensors)
+
+    features = pack(flat_feature_spec)
+    if flat_label_spec is not None:
+      return features, pack(flat_label_spec)
+    return features
+
+  return parse_fn
+
+
+# ----------------------------------------------------------------- encoding
+
+
+def _encode_image_bytes(array: np.ndarray, data_format: str) -> bytes:
+  import io
+
+  from PIL import Image
+
+  array = np.asarray(array)
+  if array.ndim == 3 and array.shape[2] == 1:
+    array = array[:, :, 0]
+  image = Image.fromarray(array)
+  buf = io.BytesIO()
+  image.save(buf, format=data_format)
+  return buf.getvalue()
+
+
+def _feature_for_value(spec: TensorSpec, value: np.ndarray):
+  """One tf.train.Feature for a single (non-sequence-step) value."""
+  tf = _tf()
+  if spec.is_encoded_image:
+    arrays = np.asarray(value)
+    if arrays.ndim == len(spec.shape):  # single image or list of images
+      if len(spec.shape) > 3:
+        images = [arrays[i] for i in range(arrays.shape[0])]
+      else:
+        images = [arrays]
+    else:
+      images = [arrays]
+    encoded = [_encode_image_bytes(img, spec.data_format) for img in images]
+    return tf.train.Feature(bytes_list=tf.train.BytesList(value=encoded))
+  flat = np.asarray(value).reshape(-1)
+  if spec.dtype.name in ('float32', 'float64', 'bfloat16'):
+    return tf.train.Feature(
+        float_list=tf.train.FloatList(value=flat.astype(np.float32)))
+  if np.issubdtype(spec.dtype, np.integer) or spec.dtype == np.bool_:
+    return tf.train.Feature(
+        int64_list=tf.train.Int64List(value=flat.astype(np.int64)))
+  if spec.dtype.name in ('object', 'str', 'bytes') or flat.dtype.kind in 'SU':
+    return tf.train.Feature(bytes_list=tf.train.BytesList(
+        value=[v.encode() if isinstance(v, str) else bytes(v) for v in flat]))
+  raise ValueError(f'Cannot encode dtype {spec.dtype} for {spec}')
+
+
+def encode_example(spec_struct, numpy_struct) -> bytes:
+  """Encodes ONE example (no batch dim) to a serialized tf.(Sequence)Example.
+
+  Sequence specs (is_sequence=True) expect a leading time dim in the value and
+  are written as SequenceExample feature lists; everything else goes into
+  context features.
+  """
+  tf = _tf()
+  flat_spec = algebra.flatten_spec_structure(spec_struct)
+  flat_np = algebra.flatten_spec_structure(numpy_struct)
+  context = {}
+  feature_lists = {}
+  for key, raw_spec in flat_spec.items():
+    spec = TensorSpec.to_spec(raw_spec)
+    if key not in flat_np:
+      if spec.is_optional:
+        continue
+      raise ValueError(f'Missing value for required spec {key!r}.')
+    name = spec.name or key.split('/')[-1]
+    value = np.asarray(flat_np[key])
+    if spec.is_sequence:
+      steps = [
+          _feature_for_value(TensorSpec.from_spec(spec, is_sequence=False),
+                             value[t]) for t in range(value.shape[0])
+      ]
+      feature_lists[name] = tf.train.FeatureList(feature=steps)
+    else:
+      context[name] = _feature_for_value(spec, value)
+  if feature_lists:
+    example = tf.train.SequenceExample(
+        context=tf.train.Features(feature=context),
+        feature_lists=tf.train.FeatureLists(feature_list=feature_lists))
+  else:
+    example = tf.train.Example(features=tf.train.Features(feature=context))
+  return example.SerializeToString()

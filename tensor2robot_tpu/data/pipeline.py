@@ -1,0 +1,123 @@
+"""Host-side input pipeline: files -> parsed, batched numpy SpecStructs.
+
+TPU-native re-design of the reference's Estimator ``input_fn`` template
+(``/root/reference/utils/tfdata.py:527-606``). Same stages — list_files →
+parallel interleave → shuffle/repeat → batch(drop_remainder=True) → zip
+multi-datasets → parse → prefetch — but the sink is a numpy iterator feeding
+``jax.device_put`` instead of an in-graph Estimator: preprocessing that the
+reference ran in ``dataset.map`` happens *on device inside the jitted step*
+(see preprocessors/), so host CPUs only parse and decode.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional, Tuple, Union
+
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.data import example_codec, records
+from tensor2robot_tpu.specs import SpecStruct
+
+
+def _tf():
+  import tensorflow as tf
+  return tf
+
+
+def make_serialized_dataset(file_patterns: Union[str, Dict[str, str]],
+                            batch_size: int,
+                            is_training: bool,
+                            shuffle_buffer_size: int = 1000,
+                            parallel_shards: int = 10,
+                            repeat: bool = True,
+                            seed: Optional[int] = None):
+  """Batched serialized-example dataset; dict patterns -> zipped dict."""
+  tf = _tf()
+  if isinstance(file_patterns, dict):
+    patterns_map = file_patterns
+  else:
+    patterns_map = {'': file_patterns}
+  datasets = {}
+  for dataset_key, patterns in patterns_map.items():
+    data_format, filenames = records.get_data_format_and_filenames(patterns)
+    files = tf.data.Dataset.list_files(
+        filenames, shuffle=is_training, seed=seed)
+    cycle_length = min(parallel_shards, len(filenames)) if is_training else 1
+    dataset = files.interleave(
+        records.DATA_FORMATS[data_format],
+        cycle_length=cycle_length,
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not is_training)
+    if is_training:
+      dataset = dataset.shuffle(shuffle_buffer_size, seed=seed)
+    if repeat:
+      dataset = dataset.repeat()
+    dataset = dataset.batch(batch_size, drop_remainder=True)
+    datasets[dataset_key] = dataset
+  if list(datasets) == ['']:
+    return datasets['']
+  return tf.data.Dataset.zip(datasets)
+
+
+def make_dataset(file_patterns,
+                 feature_spec,
+                 label_spec=None,
+                 mode: str = modes.ModeKeys.TRAIN,
+                 batch_size: int = 32,
+                 preprocess_fn: Optional[Callable] = None,
+                 shuffle_buffer_size: int = 1000,
+                 parallel_shards: int = 10,
+                 num_parallel_calls: Optional[int] = None,
+                 repeat: bool = True,
+                 seed: Optional[int] = None):
+  """Full parsed tf.data.Dataset of (features[, labels]) SpecStructs.
+
+  ``preprocess_fn`` here is a *host-side* (tf) transform; device-side
+  preprocessing belongs in the jitted step. Most models need none.
+  """
+  tf = _tf()
+  dataset = make_serialized_dataset(
+      file_patterns, batch_size,
+      is_training=modes.is_training(mode),
+      shuffle_buffer_size=shuffle_buffer_size,
+      parallel_shards=parallel_shards,
+      repeat=repeat,
+      seed=seed)
+  parse_fn = example_codec.make_parse_fn(feature_spec, label_spec)
+
+  def parse(serialized):
+    parsed = parse_fn(serialized)
+    # tf.data needs plain dict structures; convert SpecStructs to flat dicts.
+    if label_spec is not None:
+      features, labels = parsed
+      return dict(features.items()), dict(labels.items())
+    return dict(parsed.items())
+
+  dataset = dataset.map(
+      parse, num_parallel_calls=num_parallel_calls or tf.data.AUTOTUNE)
+  if preprocess_fn is not None:
+    dataset = dataset.map(preprocess_fn, num_parallel_calls=tf.data.AUTOTUNE)
+  return dataset.prefetch(tf.data.AUTOTUNE)
+
+
+def as_numpy_iterator(dataset, has_labels: bool = True) -> Iterator:
+  """Yields SpecStruct numpy batches from a parsed tf.data.Dataset."""
+  for element in dataset.as_numpy_iterator():
+    if has_labels:
+      features, labels = element
+      yield SpecStruct(features), SpecStruct(labels)
+    else:
+      yield SpecStruct(element)
+
+
+def numpy_batches(file_patterns,
+                  feature_spec,
+                  label_spec=None,
+                  mode: str = modes.ModeKeys.TRAIN,
+                  batch_size: int = 32,
+                  **kwargs) -> Iterator:
+  """One-call convenience: files -> iterator of packed numpy batches."""
+  dataset = make_dataset(file_patterns, feature_spec, label_spec, mode,
+                         batch_size, **kwargs)
+  return as_numpy_iterator(dataset, has_labels=label_spec is not None)

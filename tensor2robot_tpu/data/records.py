@@ -1,0 +1,110 @@
+"""Record-file handling: formats, file patterns, and writers.
+
+Capability-equivalent of the reference's format registry / pattern utilities
+(``/root/reference/utils/tfdata.py:34-191``) plus the replay writer
+(``utils/writer.py:31-70``). TFRecord is the default interchange format; the
+registry is open so new formats can be plugged in.
+"""
+
+from __future__ import annotations
+
+import glob as glob_lib
+import os
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+
+def _tf():
+  import tensorflow as tf
+  return tf
+
+
+def _tfrecord_dataset(filenames):
+  return _tf().data.TFRecordDataset(filenames)
+
+
+DATA_FORMATS = {
+    'tfrecord': _tfrecord_dataset,
+}
+
+
+def register_data_format(name: str, dataset_factory: Callable) -> None:
+  DATA_FORMATS[name] = dataset_factory
+
+
+def infer_data_format(file_patterns: str) -> str:
+  """Infers the data format from a 'format:pattern' or bare pattern string."""
+  if ':' in file_patterns:
+    prefix = file_patterns.split(':', 1)[0]
+    if prefix in DATA_FORMATS:
+      return prefix
+  for data_format in DATA_FORMATS:
+    if data_format in os.path.basename(file_patterns):
+      return data_format
+  raise ValueError(
+      f'Cannot infer data format from {file_patterns!r}; known formats: '
+      f'{sorted(DATA_FORMATS)}. Prefix the pattern with "<format>:".')
+
+
+def get_data_format_and_filenames(
+    file_patterns: Union[str, Sequence[str]]) -> Tuple[str, List[str]]:
+  """Resolves comma-separated glob patterns to (format, filenames)."""
+  if isinstance(file_patterns, str):
+    patterns = [p for p in file_patterns.split(',') if p]
+  else:
+    patterns = list(file_patterns)
+  data_format = None
+  filenames: List[str] = []
+  for pattern in patterns:
+    if ':' in pattern and pattern.split(':', 1)[0] in DATA_FORMATS:
+      fmt, pattern = pattern.split(':', 1)
+    else:
+      fmt = infer_data_format(pattern)
+    if data_format is None:
+      data_format = fmt
+    elif data_format != fmt:
+      raise ValueError(
+          f'Mixed data formats in patterns: {data_format} vs {fmt}')
+    matches = sorted(glob_lib.glob(pattern))
+    filenames.extend(matches if matches else [pattern])
+  if data_format is None:
+    raise ValueError(f'No file patterns provided: {file_patterns!r}')
+  return data_format, filenames
+
+
+class RecordWriter:
+  """Sharded TFRecord writer for serialized examples (replay/test data)."""
+
+  def __init__(self, path: str, shard: Optional[int] = None,
+               num_shards: Optional[int] = None):
+    if shard is not None and num_shards:
+      path = f'{path}-{shard:05d}-of-{num_shards:05d}'
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    self._path = path
+    self._writer = _tf().io.TFRecordWriter(path)
+
+  @property
+  def path(self) -> str:
+    return self._path
+
+  def write(self, serialized: bytes) -> None:
+    self._writer.write(serialized)
+
+  def flush(self) -> None:
+    self._writer.flush()
+
+  def close(self) -> None:
+    self._writer.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def write_examples(path: str, serialized_examples: Sequence[bytes]) -> str:
+  """Writes serialized examples to one tfrecord file; returns the path."""
+  with RecordWriter(path) as writer:
+    for example in serialized_examples:
+      writer.write(example)
+  return path

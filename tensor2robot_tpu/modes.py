@@ -1,0 +1,13 @@
+"""Run modes. String-valued so they are trivially gin/json/config friendly."""
+
+
+class ModeKeys:
+  TRAIN = 'train'
+  EVAL = 'eval'
+  PREDICT = 'predict'
+
+  ALL = (TRAIN, EVAL, PREDICT)
+
+
+def is_training(mode: str) -> bool:
+  return mode == ModeKeys.TRAIN

@@ -1,0 +1,8 @@
+"""Preprocessors: spec-driven, device-side (jit-traceable) transforms."""
+
+from tensor2robot_tpu.preprocessors.base import (
+    AbstractPreprocessor,
+    NoOpPreprocessor,
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.dtype_policy import DtypePolicyPreprocessor

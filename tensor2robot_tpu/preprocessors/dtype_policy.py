@@ -1,0 +1,72 @@
+"""Device dtype-policy preprocessor: the host/device bfloat16 boundary.
+
+Capability-equivalent of the reference's ``TPUPreprocessorWrapper``
+(``/root/reference/preprocessors/tpu_preprocessor_wrapper.py:37-160``), which
+pairs with ``TPUT2RModelWrapper``: on the way in, specs the device wants in
+bfloat16 are declared float32 to the host pipeline; on the way out, optional
+specs are stripped (dense-only batches for the device) and float32 tensors
+are cast to bfloat16.
+
+In the TPU-native design this runs *inside the jitted step*, so the
+float32→bfloat16 cast compiles into the input of the first matmul/conv and
+is effectively free on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from tensor2robot_tpu.preprocessors.base import AbstractPreprocessor
+from tensor2robot_tpu.specs import (SpecStruct, algebra, dtypes)
+
+
+class DtypePolicyPreprocessor(AbstractPreprocessor):
+  """Wraps a base preprocessor with the TPU bfloat16 in/out policy."""
+
+  def __init__(self, preprocessor: AbstractPreprocessor):
+    super().__init__()
+    self._preprocessor = preprocessor
+
+  @property
+  def wrapped(self) -> AbstractPreprocessor:
+    return self._preprocessor
+
+  # In specs (host side): bfloat16 → float32, the host never sees bfloat16.
+  def get_in_feature_specification(self, mode):
+    return dtypes.cast_bfloat16_to_float32(
+        self._preprocessor.get_in_feature_specification(mode))
+
+  def get_in_label_specification(self, mode):
+    spec = self._preprocessor.get_in_label_specification(mode)
+    return None if spec is None else dtypes.cast_bfloat16_to_float32(spec)
+
+  # Out specs (device side): strip optionals, float32 → bfloat16.
+  def get_out_feature_specification(self, mode):
+    return dtypes.cast_float32_to_bfloat16(
+        algebra.filter_required_flat_tensor_spec(
+            algebra.flatten_spec_structure(
+                self._preprocessor.get_out_feature_specification(mode))))
+
+  def get_out_label_specification(self, mode):
+    spec = self._preprocessor.get_out_label_specification(mode)
+    if spec is None:
+      return None
+    return dtypes.cast_float32_to_bfloat16(
+        algebra.filter_required_flat_tensor_spec(
+            algebra.flatten_spec_structure(spec)))
+
+  def _preprocess_fn(self, features, labels, mode,
+                     rng) -> Tuple[SpecStruct, Optional[SpecStruct]]:
+    features, labels = self._preprocessor._preprocess_fn(  # pylint: disable=protected-access
+        features, labels, mode, rng)
+
+    def apply_policy(tensors, out_spec):
+      if tensors is None or out_spec is None:
+        return None if out_spec is None else tensors
+      flat = algebra.flatten_spec_structure(tensors)
+      kept = SpecStruct(
+          (k, v) for k, v in flat.items() if k in out_spec)
+      return dtypes.cast_arrays_to_spec_dtypes(out_spec, kept)
+
+    return (apply_policy(features, self.get_out_feature_specification(mode)),
+            apply_policy(labels, self.get_out_label_specification(mode)))

@@ -271,7 +271,8 @@ def add_sequence_length_specs(spec_structure) -> SpecStruct:
     if getattr(value, 'is_sequence', False):
       out[key + '_length'] = TensorSpec(
           shape=(), dtype=np.int64,
-          name=(value.name or key.split(_SEP)[-1]) + '_length')
+          name=(value.name or key.split(_SEP)[-1]) + '_length',
+          dataset_key=value.dataset_key)
   return out
 
 

@@ -156,9 +156,12 @@ class TensorSpec:
                  array,
                  name: Optional[str] = None) -> 'TensorSpec':
     """Spec extracted from a concrete ndarray / jax.Array."""
+    dtype = getattr(array, 'dtype', None)
+    if dtype is None:
+      dtype = np.asarray(array).dtype
     return cls(
         shape=tuple(int(d) for d in np.shape(array)),
-        dtype=as_dtype(getattr(array, 'dtype', np.asarray(array).dtype)),
+        dtype=as_dtype(dtype),
         name=name,
         is_extracted=True)
 

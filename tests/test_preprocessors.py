@@ -1,0 +1,205 @@
+"""Preprocessor contract + image transformation tests."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.preprocessors import (AbstractPreprocessor,
+                                            DtypePolicyPreprocessor,
+                                            NoOpPreprocessor,
+                                            SpecTransformationPreprocessor,
+                                            image_transformations)
+from tensor2robot_tpu.specs import (SpecStruct, TensorSpec, bfloat16,
+                                    make_random_numpy)
+
+TRAIN = modes.ModeKeys.TRAIN
+
+
+def model_feature_spec(mode=TRAIN):
+  del mode
+  return SpecStruct({
+      'image': TensorSpec((8, 8, 3), np.float32, name='img'),
+      'aux': TensorSpec((4,), np.float32, name='aux', is_optional=True),
+  })
+
+
+def model_label_spec(mode=TRAIN):
+  del mode
+  return SpecStruct({'target': TensorSpec((2,), np.float32, name='t')})
+
+
+class TestNoOp:
+
+  def test_identity(self):
+    pre = NoOpPreprocessor(model_feature_spec, model_label_spec)
+    features = make_random_numpy(
+        SpecStruct({'image': model_feature_spec()['image']}), batch_size=2)
+    labels = make_random_numpy(model_label_spec(), batch_size=2)
+    out_f, out_l = pre.preprocess(features, labels, TRAIN)
+    np.testing.assert_array_equal(out_f['image'], features['image'])
+    np.testing.assert_array_equal(out_l['target'], labels['target'])
+
+  def test_specs_match_model(self):
+    pre = NoOpPreprocessor(model_feature_spec, model_label_spec)
+    assert dict(pre.get_in_feature_specification(TRAIN).items()) == dict(
+        model_feature_spec().items())
+
+
+class TestSpecTransformation:
+
+  def test_in_spec_override(self):
+    class UintInput(SpecTransformationPreprocessor):
+
+      def _transform_in_feature_specification(self, spec, mode):
+        self.update_spec(spec, 'image', dtype=np.uint8,
+                         data_format='JPEG')
+        return spec
+
+      def _preprocess_fn(self, features, labels, mode, rng):
+        features['image'] = features['image'].astype(np.float32) / 255.0
+        return features, labels
+
+    pre = UintInput(model_feature_spec, model_label_spec)
+    in_spec = pre.get_in_feature_specification(TRAIN)
+    assert in_spec['image'].dtype == np.uint8
+    assert in_spec['image'].data_format == 'JPEG'
+    # Model (out) spec unchanged.
+    assert pre.get_out_feature_specification(TRAIN)['image'].dtype == (
+        np.float32)
+    features = SpecStruct({
+        'image': np.full((2, 8, 8, 3), 128, np.uint8),
+        'aux': np.zeros((2, 4), np.float32)})
+    labels = make_random_numpy(model_label_spec(), batch_size=2)
+    out_f, _ = pre.preprocess(features, labels, TRAIN)
+    assert out_f['image'].dtype == np.float32
+    np.testing.assert_allclose(np.asarray(out_f['image'][0, 0, 0, 0]),
+                               128 / 255.0, rtol=1e-5)
+
+
+class TestDtypePolicy:
+
+  def test_spec_views(self):
+    def bf16_feature_spec(mode):
+      del mode
+      return SpecStruct({
+          'image': TensorSpec((8, 8, 3), bfloat16, name='img'),
+          'aux': TensorSpec((4,), np.float32, name='aux',
+                            is_optional=True)})
+
+    pre = DtypePolicyPreprocessor(
+        NoOpPreprocessor(bf16_feature_spec, model_label_spec))
+    in_spec = pre.get_in_feature_specification(TRAIN)
+    assert in_spec['image'].dtype == np.float32  # host never sees bf16
+    out_spec = pre.get_out_feature_specification(TRAIN)
+    assert out_spec['image'].dtype == bfloat16
+    assert 'aux' not in out_spec  # optionals stripped for device
+
+  def test_cast_and_strip_in_call(self):
+    def bf16_feature_spec(mode):
+      del mode
+      return SpecStruct({
+          'image': TensorSpec((8, 8, 3), bfloat16, name='img'),
+          'aux': TensorSpec((4,), np.float32, name='aux',
+                            is_optional=True)})
+
+    pre = DtypePolicyPreprocessor(
+        NoOpPreprocessor(bf16_feature_spec, model_label_spec))
+    features = {
+        'image': jnp.ones((2, 8, 8, 3), jnp.float32),
+        'aux': jnp.zeros((2, 4), jnp.float32)}
+    labels = {'target': jnp.zeros((2, 2), jnp.float32)}
+    out_f, out_l = pre.preprocess(features, labels, TRAIN)
+    assert out_f['image'].dtype == jnp.bfloat16
+    assert 'aux' not in out_f
+    assert out_l['target'].dtype == jnp.bfloat16
+
+  def test_works_under_jit(self):
+    def bf16_feature_spec(mode):
+      del mode
+      return SpecStruct({'image': TensorSpec((4, 4, 3), bfloat16,
+                                             name='img')})
+
+    pre = DtypePolicyPreprocessor(
+        NoOpPreprocessor(bf16_feature_spec, model_label_spec))
+
+    @jax.jit
+    def step(features, labels):
+      out_f, out_l = pre.preprocess(features, labels, TRAIN)
+      return jnp.sum(out_f['image'].astype(jnp.float32)), out_l
+
+    total, _ = step({'image': jnp.ones((2, 4, 4, 3))},
+                    {'target': jnp.zeros((2, 2))})
+    assert float(total) == 2 * 4 * 4 * 3
+
+
+class TestCrops:
+
+  def test_center_crop(self):
+    images = jnp.arange(2 * 6 * 6 * 3, dtype=jnp.float32).reshape(2, 6, 6, 3)
+    out = image_transformations.center_crop_images(images, (4, 4))
+    assert out.shape == (2, 4, 4, 3)
+    np.testing.assert_array_equal(out, images[:, 1:5, 1:5, :])
+
+  def test_random_crop_shape_and_range(self):
+    rng = jax.random.PRNGKey(0)
+    images = jnp.ones((4, 10, 12, 3))
+    out = image_transformations.random_crop_images(rng, images, (5, 7))
+    assert out.shape == (4, 5, 7, 3)
+
+  def test_random_crop_under_jit_and_deterministic(self):
+    images = jnp.arange(2 * 8 * 8 * 1, dtype=jnp.float32).reshape(2, 8, 8, 1)
+    crop = jax.jit(lambda k, x: image_transformations.random_crop_images(
+        k, x, (4, 4)))
+    a = crop(jax.random.PRNGKey(7), images)
+    b = crop(jax.random.PRNGKey(7), images)
+    np.testing.assert_array_equal(a, b)
+
+  def test_custom_crop(self):
+    images = jnp.zeros((1, 8, 8, 3))
+    out = image_transformations.custom_crop_images(images, (2, 3, 4, 5))
+    assert out.shape == (1, 4, 5, 3)
+
+  def test_crop_too_large_raises(self):
+    with pytest.raises(ValueError):
+      image_transformations.center_crop_images(jnp.zeros((1, 4, 4, 3)),
+                                               (8, 8))
+
+
+class TestPhotometric:
+
+  def test_hsv_roundtrip(self):
+    rng = np.random.default_rng(0)
+    rgb = jnp.asarray(rng.random((16, 3)), jnp.float32)
+    back = image_transformations.hsv_to_rgb(
+        image_transformations.rgb_to_hsv(rgb))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(rgb), atol=1e-5)
+
+  def test_distortion_chain_shapes_and_range(self):
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.default_rng(1).random((3, 8, 8, 3)), jnp.float32)
+    out = image_transformations.apply_photometric_image_distortions(
+        rng, images, random_brightness=True, random_saturation=True,
+        random_hue=True, random_contrast=True, random_noise_level=0.05)
+    assert out.shape == images.shape
+    assert float(jnp.min(out)) >= 0.0
+    assert float(jnp.max(out)) <= 1.0
+
+  def test_no_distortion_is_identity(self):
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.default_rng(1).random((2, 4, 4, 3)), jnp.float32)
+    out = image_transformations.apply_photometric_image_distortions(
+        rng, images)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(images),
+                               atol=1e-6)
+
+  def test_depth_distortions(self):
+    rng = jax.random.PRNGKey(3)
+    depth = jnp.ones((4, 8, 8, 1))
+    out = image_transformations.apply_depth_image_distortions(
+        rng, depth, random_noise_level=0.1)
+    assert out.shape == depth.shape
