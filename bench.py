@@ -1,0 +1,94 @@
+"""Benchmark harness: prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.md): QT-Opt grasping-critic train steps/sec on one chip —
+full Grasping44 (472×472 images, num_convs 6/6/3), bfloat16 activations,
+in-graph preprocessing (random crop + photometric distortions), momentum +
+EMA — the reference's training configuration on its flagship workload.
+
+``vs_baseline`` divides by a locally recorded reference throughput when
+``BASELINE.json`` contains one (the reference repo publishes none), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+  import jax
+
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+  from tensor2robot_tpu.specs import make_random_numpy
+  from tensor2robot_tpu.train import Trainer, TrainerConfig
+
+  on_tpu = jax.default_backend() != 'cpu'
+  if on_tpu:
+    batch_size, steps, model_kwargs = 32, 50, {}
+  else:  # smoke-mode so the script still runs on CPU-only boxes
+    batch_size, steps, model_kwargs = 4, 5, {
+        'input_shape': (96, 112, 3),
+        'target_shape': (80, 80),
+        'num_convs': (2, 2, 1),
+    }
+
+  model = GraspingModelWrapper(device_type='tpu', **model_kwargs)
+  config = TrainerConfig(model_dir='', max_train_steps=1,
+                         eval_interval_steps=0, log_interval_steps=0)
+  trainer = Trainer(model, config)
+
+  preprocessor = model.preprocessor
+  feature_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+  label_spec = preprocessor.get_in_label_specification(ModeKeys.TRAIN)
+  batches = []
+  for seed in range(4):
+    features = make_random_numpy(feature_spec, batch_size=batch_size,
+                                 seed=seed)
+    labels = make_random_numpy(label_spec, batch_size=batch_size,
+                               seed=100 + seed)
+    batches.append((features, labels))
+
+  def batch_iter():
+    i = 0
+    while True:
+      yield batches[i % len(batches)]
+      i += 1
+
+  it = batch_iter()
+  trainer.train(it, None)  # 1 step: init + compile
+
+  state = trainer.state
+  step_fn = trainer._train_step_fn  # pylint: disable=protected-access
+  # Warmup post-compile.
+  for _ in range(3):
+    features, labels = next(it)
+    state, _ = step_fn(state, features, labels)
+  jax.block_until_ready(state.params)
+
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    features, labels = next(it)
+    state, _ = step_fn(state, features, labels)
+  jax.block_until_ready(state.params)
+  dt = time.perf_counter() - t0
+
+  steps_per_sec = steps / dt
+  baseline = None
+  try:
+    with open('BASELINE.json') as f:
+      baseline = json.load(f).get('measured', {}).get(
+          'qtopt_steps_per_sec_per_chip')
+  except Exception:
+    pass
+  vs_baseline = (steps_per_sec / baseline) if baseline else 1.0
+  print(json.dumps({
+      'metric': 'qtopt_grasp_q_train_steps_per_sec_per_chip',
+      'value': round(steps_per_sec, 3),
+      'unit': 'steps/sec',
+      'vs_baseline': round(vs_baseline, 3),
+  }))
+
+
+if __name__ == '__main__':
+  main()

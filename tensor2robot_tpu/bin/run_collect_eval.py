@@ -1,0 +1,32 @@
+"""Collect/eval binary: parse config, run the robot-side loop.
+
+Equivalent of ``/root/reference/bin/run_collect_eval.py:44-51``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tensor2robot_tpu import config as t2r_config
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--gin_configs', action='append', default=[])
+  parser.add_argument('--gin_bindings', action='append', default=[])
+  parser.add_argument('--root_dir', default='')
+  args = parser.parse_args(argv)
+
+  t2r_config.register_framework_configurables()
+  t2r_config.parse_config_files_and_bindings(
+      config_files=args.gin_configs, bindings=args.gin_bindings)
+  collect_eval_loop = t2r_config.get_configurable('collect_eval_loop')
+  if args.root_dir:
+    return collect_eval_loop(root_dir=args.root_dir)
+  return collect_eval_loop()
+
+
+if __name__ == '__main__':
+  logging.basicConfig(level=logging.INFO)
+  main()

@@ -1,0 +1,41 @@
+"""Trainer binary: parse config files, call train_eval_model.
+
+Shape-for-shape equivalent of ``/root/reference/bin/run_t2r_trainer.py:
+32-39``: all wiring lives in config files; the binary parses
+``--gin_configs`` / ``--gin_bindings`` and calls one function.
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_t2r_trainer \
+      --gin_configs path/to/experiment.gin \
+      --gin_bindings 'train_eval_model.max_train_steps = 100'
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tensor2robot_tpu import config as t2r_config
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--gin_configs', action='append', default=[],
+                      help='Path to a gin config file (repeatable).')
+  parser.add_argument('--gin_bindings', action='append', default=[],
+                      help='Individual gin bindings (repeatable).')
+  args = parser.parse_args(argv)
+
+  t2r_config.register_framework_configurables()
+  t2r_config.parse_config_files_and_bindings(
+      config_files=args.gin_configs, bindings=args.gin_bindings)
+
+  train_eval_model = t2r_config.get_configurable('train_eval_model')
+  result = train_eval_model()
+  logging.info('Operative config:\n%s', t2r_config.operative_config_str())
+  return result
+
+
+if __name__ == '__main__':
+  logging.basicConfig(level=logging.INFO)
+  main()

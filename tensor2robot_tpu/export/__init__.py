@@ -1,0 +1,18 @@
+"""Export: versioned serving artifacts, exporters, async export callbacks."""
+
+from tensor2robot_tpu.export.async_export import (
+    AsyncExportCallback,
+    TD3ExportCallback,
+)
+from tensor2robot_tpu.export.exporters import (
+    BestExporter,
+    LatestExporter,
+    ModelExporter,
+    create_default_exporters,
+    create_valid_result_larger,
+    create_valid_result_smaller,
+    gc_export_versions,
+    load_model_from_export_dir,
+    load_state_from_export_dir,
+    valid_export_dirs,
+)

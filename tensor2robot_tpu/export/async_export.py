@@ -1,0 +1,99 @@
+"""Async export callbacks: export-on-checkpoint + TD3 lagged exports.
+
+Capability-equivalent of ``hooks/async_export_hook_builder.py:91-137``
+(export a serving artifact after every checkpoint save, off the critical
+path) and ``hooks/td3.py:39-135`` / ``hooks/checkpoint_hooks.py:96-206``
+(TD3's target network realized as a *lagged*, one-version-behind export
+directory on the filesystem).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from tensor2robot_tpu.export.exporters import ModelExporter
+from tensor2robot_tpu.train.trainer import TrainerCallback
+
+
+class AsyncExportCallback(TrainerCallback):
+  """Exports the serving model after each checkpoint save.
+
+  The export runs on a worker thread so the train loop never blocks on
+  serialization (the AsyncCheckpointSaverHook capability).
+  """
+
+  def __init__(self,
+               export_dir: Optional[str] = None,
+               export_name: str = 'latest_exporter_numpy',
+               keep: int = 5,
+               asynchronous: bool = True):
+    self._export_dir = export_dir
+    self._export_name = export_name
+    self._exporter = ModelExporter(keep=keep)
+    self._asynchronous = asynchronous
+    self._pending: Optional[threading.Thread] = None
+
+  def _resolve_export_dir(self, trainer) -> str:
+    if self._export_dir:
+      return self._export_dir
+    return os.path.join(trainer.config.model_dir, 'export', self._export_name)
+
+  def after_checkpoint(self, trainer, step: int) -> None:
+    import jax
+
+    export_dir = self._resolve_export_dir(trainer)
+    model = trainer.model
+    # Snapshot to host NOW: the jitted train step donates the state buffers,
+    # so device arrays captured by the worker thread would be deleted.
+    state = jax.device_get(trainer.state)
+    if not self._asynchronous:
+      self._exporter.export(model, state, export_dir)
+      return
+    self.join()  # one in-flight export at a time; drop-behind is fine
+
+    def work(state=state):
+      self._exporter.export(model, state, export_dir)
+
+    self._pending = threading.Thread(target=work, daemon=True)
+    self._pending.start()
+
+  def end(self, trainer) -> None:
+    self.join()
+
+  def join(self) -> None:
+    if self._pending is not None and self._pending.is_alive():
+      self._pending.join()
+    self._pending = None
+
+
+class TD3ExportCallback(TrainerCallback):
+  """Maintains current + lagged export dirs (TD3 target network on disk).
+
+  ``lagged_export_dir`` always holds the *previous* exported version —
+  the contract of ``LaggedCheckpointListener``
+  (``hooks/checkpoint_hooks.py:96-206``).
+  """
+
+  def __init__(self,
+               export_dir: str,
+               lagged_export_dir: str,
+               keep: int = 5):
+    self._export_dir = export_dir
+    self._lagged_export_dir = lagged_export_dir
+    self._exporter = ModelExporter(keep=keep)
+    self._lagged_exporter = ModelExporter(keep=keep)
+    self._previous_state = None
+
+  def after_checkpoint(self, trainer, step: int) -> None:
+    import jax
+
+    state = jax.device_get(trainer.state)
+    self._exporter.export(trainer.model, state, self._export_dir)
+    # Lagged dir gets the previous version (or the current one on the first
+    # save, mirroring the listener's bootstrap).
+    lagged_state = self._previous_state or state
+    self._lagged_exporter.export(
+        trainer.model, lagged_state, self._lagged_export_dir)
+    self._previous_state = state

@@ -1,0 +1,222 @@
+"""Model export: versioned serving artifacts + best/latest exporters.
+
+Capability-equivalent of the reference's export stack
+(``export_generators/``, ``utils/train_eval.py:206-361``,
+``hooks/checkpoint_hooks.py``): the trainer writes timestamp-versioned
+export directories that a robot-side predictor polls and hot-reloads.
+
+An export directory ``<export_root>/<version>/`` contains:
+
+* ``state/`` — Orbax checkpoint of the serving variables (EMA params when
+  enabled — the reference's swapping-saver capability).
+* ``assets.extra/t2r_assets.pbtxt`` (+ JSON twin) — feature/label specs and
+  global_step (``hooks/async_export_hook_builder.py:66-88``).
+* ``export_meta.json`` — model class path + ctor kwargs, so predictors can
+  rebuild the serving fn without the training script (the role the
+  SavedModel GraphDef plays in the reference).
+
+Versions are numeric timestamps exactly like SavedModel export dirs, and
+old versions are GC'd to N newest (``hooks/checkpoint_hooks.py:36-53``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs.spec_struct import SpecStruct
+
+EXPORT_META_FILENAME = 'export_meta.json'
+STATE_DIRNAME = 'state'
+
+
+def _numeric_version_dirs(export_root: str) -> List[str]:
+  """All numeric-named child dirs, oldest → newest (predictor contract)."""
+  try:
+    entries = os.listdir(export_root)
+  except FileNotFoundError:
+    return []
+  versions = [e for e in entries if e.isdigit() and
+              os.path.isdir(os.path.join(export_root, e))]
+  return sorted(versions, key=int)
+
+
+def valid_export_dirs(export_root: str) -> List[str]:
+  """Versions whose contents are complete (assets + state + meta).
+
+  The validation-before-load contract of
+  ``exported_savedmodel_predictor.py:258-274``.
+  """
+  valid = []
+  for version in _numeric_version_dirs(export_root):
+    path = os.path.join(export_root, version)
+    if not os.path.exists(os.path.join(
+        path, assets_lib.EXTRA_ASSETS_DIRECTORY,
+        assets_lib.T2R_ASSETS_FILENAME)):
+      continue
+    if not os.path.exists(os.path.join(path, EXPORT_META_FILENAME)):
+      continue
+    if not os.path.isdir(os.path.join(path, STATE_DIRNAME)):
+      continue
+    valid.append(path)
+  return valid
+
+
+def gc_export_versions(export_root: str, keep: int = 5) -> None:
+  """Keeps the N newest versions (``_DirectoryVersionGC``, checkpoint_hooks)."""
+  versions = _numeric_version_dirs(export_root)
+  for version in versions[:-keep] if keep else versions:
+    shutil.rmtree(os.path.join(export_root, version), ignore_errors=True)
+
+
+class ModelExporter:
+  """Writes one export version from a trainer state."""
+
+  def __init__(self, keep: int = 5):
+    self._keep = keep
+    self._checkpointer = ocp.StandardCheckpointer()
+
+  def export(self, model, state, export_root: str,
+             version: Optional[int] = None) -> str:
+    """Writes ``<export_root>/<version>`` and returns its path."""
+    os.makedirs(export_root, exist_ok=True)
+    if version is None:
+      version = int(time.time() * 1e6)  # microseconds: unique + ordered
+    final_dir = os.path.join(export_root, str(version))
+    tmp_dir = os.path.join(export_root, f'.tmp_{version}')
+    if os.path.exists(tmp_dir):
+      shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+
+    # 1. Serving variables (EMA when enabled).
+    serving_variables = jax.device_get(dict(state.eval_variables))
+    self._checkpointer.save(
+        os.path.abspath(os.path.join(tmp_dir, STATE_DIRNAME)),
+        serving_variables)
+    self._checkpointer.wait_until_finished()
+
+    # 2. Specs + global step.
+    feature_spec = model.get_feature_specification_for_packing(
+        ModeKeys.PREDICT)
+    label_spec = model.get_label_specification_for_packing(ModeKeys.PREDICT)
+    assets_lib.write_assets_to_export_dir(
+        tmp_dir, feature_spec, label_spec, global_step=int(state.step))
+
+    # 3. Reconstruction metadata.
+    meta = {
+        'model_class': f'{type(model).__module__}.{type(model).__qualname__}',
+        'global_step': int(state.step),
+    }
+    with open(os.path.join(tmp_dir, EXPORT_META_FILENAME), 'w') as f:
+      json.dump(meta, f, indent=2)
+
+    # Atomic publish: predictors never observe partial exports.
+    os.replace(tmp_dir, final_dir)
+    if self._keep:
+      gc_export_versions(export_root, keep=self._keep)
+    return final_dir
+
+
+def load_model_from_export_dir(export_dir: str,
+                               model_kwargs: Optional[Dict[str, Any]] = None):
+  """Rebuilds the model object recorded in export_meta.json."""
+  with open(os.path.join(export_dir, EXPORT_META_FILENAME)) as f:
+    meta = json.load(f)
+  module_name, _, class_name = meta['model_class'].rpartition('.')
+  module = importlib.import_module(module_name)
+  model_cls = getattr(module, class_name)
+  return model_cls(**(model_kwargs or {}))
+
+
+def load_state_from_export_dir(export_dir: str):
+  """Loads the serving variables written by :class:`ModelExporter`."""
+  checkpointer = ocp.StandardCheckpointer()
+  return checkpointer.restore(
+      os.path.abspath(os.path.join(export_dir, STATE_DIRNAME)))
+
+
+# ------------------------------------------------------------ eval exporters
+
+
+def create_valid_result_smaller(metric_key: str = 'loss'):
+  """Best = smaller metric (train_eval.py:206-246)."""
+
+  def compare(best: Optional[Dict], current: Dict) -> bool:
+    if best is None or metric_key not in best:
+      return True
+    return current[metric_key] < best[metric_key]
+
+  return compare
+
+
+def create_valid_result_larger(metric_key: str):
+  """Best = larger metric (train_eval.py:249-292)."""
+
+  def compare(best: Optional[Dict], current: Dict) -> bool:
+    if best is None or metric_key not in best:
+      return True
+    return current[metric_key] > best[metric_key]
+
+  return compare
+
+
+class LatestExporter:
+  """Exports on every eval, keeping N newest (LatestExporter semantics)."""
+
+  def __init__(self, name: str = 'latest_exporter_numpy', keep: int = 5):
+    self.name = name
+    self._exporter = ModelExporter(keep=keep)
+
+  def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
+    del metrics
+    export_root = os.path.join(trainer.config.model_dir, 'export', self.name)
+    return self._exporter.export(trainer.model, trainer.state, export_root)
+
+
+class BestExporter:
+  """Exports only when the metric improves (BestExporter semantics)."""
+
+  def __init__(self,
+               name: str = 'best_exporter_numpy',
+               compare_fn: Optional[Callable] = None,
+               keep: int = 5):
+    self.name = name
+    self._compare_fn = compare_fn or create_valid_result_smaller('loss')
+    self._exporter = ModelExporter(keep=keep)
+    self._best_metrics: Optional[Dict[str, float]] = None
+
+  def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
+    if not metrics:
+      return None
+    if not self._compare_fn(self._best_metrics, metrics):
+      return None
+    self._best_metrics = dict(metrics)
+    export_root = os.path.join(trainer.config.model_dir, 'export', self.name)
+    return self._exporter.export(trainer.model, trainer.state, export_root)
+
+
+def create_default_exporters(best_metric_key: str = 'loss',
+                             compare_larger: bool = False,
+                             keep: int = 5):
+  """Best + latest exporter pair (train_eval.py:295-361)."""
+
+  def create_exporters_fn(model):
+    del model
+    compare = (create_valid_result_larger(best_metric_key) if compare_larger
+               else create_valid_result_smaller(best_metric_key))
+    return [
+        BestExporter(compare_fn=compare, keep=keep),
+        LatestExporter(keep=keep),
+    ]
+
+  return create_exporters_fn

@@ -1,0 +1,43 @@
+"""NN layers: Flax re-designs of the reference layer library."""
+
+from tensor2robot_tpu.layers.mdn import (
+    GaussianMixture,
+    MDNDecoder,
+    MDNParams,
+    gaussian_mixture_approximate_mode,
+    get_mixture_distribution,
+    mdn_nll_loss,
+)
+from tensor2robot_tpu.layers.resnet import (
+    BLOCK_SIZES,
+    FilmResNet,
+    LinearFilmGenerator,
+    ResNet,
+    apply_film,
+)
+from tensor2robot_tpu.layers.snail import (
+    AttentionBlock,
+    CausalConv,
+    DenseBlock,
+    TCBlock,
+    causally_masked_softmax,
+)
+from tensor2robot_tpu.layers.spatial_softmax import (
+    BuildSpatialSoftmax,
+    spatial_softmax,
+)
+from tensor2robot_tpu.layers.tec import (
+    EmbedConditionImages,
+    EmbedFullstate,
+    ReduceTemporalEmbeddings,
+    compute_embedding_contrastive_loss,
+    contrastive_loss,
+)
+from tensor2robot_tpu.layers.vision_layers import (
+    FILMParams,
+    ImageFeaturesToPoseModel,
+    ImagesToFeaturesModel,
+    ImagesToFeaturesModelHighRes,
+    film_modulation,
+    film_params_size,
+)

@@ -1,0 +1,151 @@
+"""Mixture density network head: mixture of isotropic gaussians.
+
+Reference: ``/root/reference/layers/mdn.py:34-168`` (tfp-based). Rebuilt in
+pure jnp (no tfp in this environment): a lightweight
+:class:`GaussianMixture` pytree provides exactly the operations the
+framework uses — ``log_prob``, ``mode of the most probable component``,
+and ``sample`` — with logsumexp-stable math that jits cleanly.
+
+Layout contract is identical: params vector =
+``[alphas (K) | mus (K*D) | raw_sigmas (K*D)]``, ``sigma = softplus(raw)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@flax.struct.dataclass
+class GaussianMixture:
+  """Mixture of K isotropic gaussians over D dims; batched arbitrarily."""
+
+  logits: jnp.ndarray      # [..., K]
+  mus: jnp.ndarray         # [..., K, D]
+  sigmas: jnp.ndarray      # [..., K, D] (already softplus'd, > 0)
+
+  @property
+  def num_components(self) -> int:
+    return self.logits.shape[-1]
+
+  def component_log_prob(self, value: jnp.ndarray) -> jnp.ndarray:
+    """log N(value | mu_k, sigma_k) for each component k → [..., K]."""
+    value = value[..., None, :]  # broadcast over K
+    var = jnp.square(self.sigmas)
+    log_det = jnp.sum(jnp.log(var), axis=-1)
+    d = self.mus.shape[-1]
+    quad = jnp.sum(jnp.square(value - self.mus) / var, axis=-1)
+    return -0.5 * (quad + log_det + d * jnp.log(2.0 * jnp.pi))
+
+  def log_prob(self, value: jnp.ndarray) -> jnp.ndarray:
+    log_alphas = jax.nn.log_softmax(self.logits, axis=-1)
+    return jax.scipy.special.logsumexp(
+        log_alphas + self.component_log_prob(value), axis=-1)
+
+  def mean(self) -> jnp.ndarray:
+    alphas = jax.nn.softmax(self.logits, axis=-1)
+    return jnp.sum(alphas[..., None] * self.mus, axis=-2)
+
+  def approximate_mode(self) -> jnp.ndarray:
+    """Mean of the most probable component (mdn.py:118-125)."""
+    top = jnp.argmax(self.logits, axis=-1)
+    return jnp.take_along_axis(
+        self.mus, top[..., None, None], axis=-2).squeeze(-2)
+
+  def sample(self, rng: jax.Array) -> jnp.ndarray:
+    comp_rng, noise_rng = jax.random.split(rng)
+    idx = jax.random.categorical(comp_rng, self.logits, axis=-1)
+    mus = jnp.take_along_axis(self.mus, idx[..., None, None], axis=-2)
+    sigmas = jnp.take_along_axis(self.sigmas, idx[..., None, None], axis=-2)
+    noise = jax.random.normal(noise_rng, mus.shape, dtype=mus.dtype)
+    return (mus + sigmas * noise).squeeze(-2)
+
+
+def get_mixture_distribution(params: jnp.ndarray,
+                             num_alphas: int,
+                             sample_size: int,
+                             output_mean: Optional[jnp.ndarray] = None
+                             ) -> GaussianMixture:
+  """Param vector → mixture (mdn.py:34-73); same packing layout."""
+  num_mus = num_alphas * sample_size
+  if params.shape[-1] != num_alphas + 2 * num_mus:
+    raise ValueError(
+        f'params last dim {params.shape[-1]} != '
+        f'{num_alphas + 2 * num_mus} (K + 2*K*D)')
+  batch_shape = params.shape[:-1]
+  alphas = params[..., :num_alphas]
+  mus = params[..., num_alphas:num_alphas + num_mus].reshape(
+      batch_shape + (num_alphas, sample_size))
+  raw_sigmas = params[..., num_alphas + num_mus:].reshape(
+      batch_shape + (num_alphas, sample_size))
+  if output_mean is not None:
+    mus = mus + output_mean[..., None, :]
+  return GaussianMixture(
+      logits=alphas, mus=mus, sigmas=jax.nn.softplus(raw_sigmas))
+
+
+gaussian_mixture_approximate_mode = GaussianMixture.approximate_mode
+
+
+class MDNParams(nn.Module):
+  """Dense head emitting mixture params (predict_mdn_params, mdn.py:76-115).
+
+  With ``condition_sigmas=False`` the sigmas are free variables initialized
+  so ``softplus(sigma) = 1``.
+  """
+
+  num_alphas: int
+  sample_size: int
+  condition_sigmas: bool = False
+
+  @nn.compact
+  def __call__(self, inputs: jnp.ndarray) -> jnp.ndarray:
+    num_mus = self.num_alphas * self.sample_size
+    num_out = self.num_alphas + num_mus
+    if self.condition_sigmas:
+      num_out += num_mus
+    params = nn.Dense(num_out, name='mdn_params')(inputs)
+    if not self.condition_sigmas:
+      sigmas = self.param(
+          'mdn_stddev_inputs',
+          nn.initializers.constant(np.log(np.e - 1.0)),
+          (num_mus,), jnp.float32)
+      tiled = jnp.broadcast_to(
+          sigmas, params.shape[:-1] + (num_mus,)).astype(params.dtype)
+      params = jnp.concatenate([params, tiled], axis=-1)
+    return params
+
+
+class MDNDecoder(nn.Module):
+  """Action decoder head (mdn.py:128-168), stateless JAX version.
+
+  ``__call__(params_features, output_size)`` returns
+  ``(action, GaussianMixture)`` — the mixture is returned instead of being
+  stashed on the object (the statefulness the reference's TODO warns about).
+  Use :func:`mdn_nll_loss` with the returned mixture.
+  """
+
+  num_mixture_components: int = 1
+
+  @nn.compact
+  def __call__(self, params: jnp.ndarray,
+               output_size: int) -> Tuple[jnp.ndarray, GaussianMixture]:
+    dist_params = MDNParams(
+        num_alphas=self.num_mixture_components,
+        sample_size=output_size,
+        condition_sigmas=False)(params)
+    gm = get_mixture_distribution(
+        dist_params.astype(jnp.float32), self.num_mixture_components,
+        output_size)
+    action = gm.approximate_mode()
+    return action, gm
+
+
+def mdn_nll_loss(gm: GaussianMixture, target: jnp.ndarray) -> jnp.ndarray:
+  """Mean negative log likelihood over batch/sequence dims."""
+  return -jnp.mean(gm.log_prob(target.astype(jnp.float32)))

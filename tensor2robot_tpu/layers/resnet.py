@@ -1,0 +1,284 @@
+"""ResNet v1/v2 (18–200) with per-block FiLM conditioning, in Flax.
+
+Reference: ``/root/reference/layers/film_resnet_model.py`` (TF official
+ResNet extended with FiLM, ``:113-124`` ``_apply_film``) and
+``/root/reference/layers/resnet.py`` (size table ``:37-68``, builder
+``:152-218``, ``linear_film_generator`` ``:103-149``,
+``resnet_endpoints`` ``:86-100``).
+
+TPU-first notes: NHWC layout (XLA's native conv layout on TPU), bfloat16-
+friendly (compute dtype follows the input), no channels_first switch, and
+endpoints returned as a dict instead of graph-name scraping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BLOCK_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+    200: [3, 24, 36, 3],
+}
+
+# v1/v2 bottleneck cutoff: sizes < 50 use basic blocks (resnet.py:172-187).
+_BOTTLENECK_MIN_SIZE = 50
+
+
+def apply_film(inputs: jnp.ndarray,
+               film_gamma_beta: Optional[jnp.ndarray]) -> jnp.ndarray:
+  """(1+γ)·x + β with γ/β split from [B, 2C] (film_resnet_model.py:113-124)."""
+  if film_gamma_beta is None:
+    return inputs
+  gamma, beta = jnp.split(film_gamma_beta, 2, axis=-1)
+  gamma = (1.0 + gamma)[:, None, None, :].astype(inputs.dtype)
+  beta = beta[:, None, None, :].astype(inputs.dtype)
+  return gamma * inputs + beta
+
+
+class _BatchNorm(nn.Module):
+  """BN with the TF official model's hyperparams (momentum .997, eps 1e-5)."""
+
+  @nn.compact
+  def __call__(self, x, train: bool):
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=0.997, epsilon=1e-5,
+        dtype=x.dtype)(x)
+
+
+def _conv_fixed_padding(x, filters, kernel_size, strides, name=None):
+  """Strided convs use explicit symmetric padding (resnet fixed_padding)."""
+  if strides > 1:
+    pad_total = kernel_size - 1
+    pad_beg = pad_total // 2
+    pad_end = pad_total - pad_beg
+    x = jnp.pad(x, ((0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)))
+    padding = 'VALID'
+  else:
+    padding = 'SAME'
+  return nn.Conv(
+      features=filters,
+      kernel_size=(kernel_size, kernel_size),
+      strides=(strides, strides),
+      padding=padding,
+      use_bias=False,
+      kernel_init=nn.initializers.variance_scaling(
+          2.0, 'fan_out', 'truncated_normal'),
+      name=name)(x)
+
+
+class _Block(nn.Module):
+  """One residual block, v1 or v2, basic or bottleneck, FiLM-aware."""
+
+  filters: int
+  strides: int
+  bottleneck: bool
+  version: int
+  project_shortcut: bool
+
+  @nn.compact
+  def __call__(self, x, film_gamma_beta, train: bool):
+    shortcut = x
+    out_filters = self.filters * (4 if self.bottleneck else 1)
+
+    if self.version == 2:
+      # v2: pre-activation; projection taken from the pre-activated input.
+      pre = _BatchNorm()(x, train)
+      pre = nn.relu(pre)
+      if self.project_shortcut:
+        shortcut = _conv_fixed_padding(pre, out_filters, 1, self.strides,
+                                       name='proj')
+      net = pre
+      if self.bottleneck:
+        net = _conv_fixed_padding(net, self.filters, 1, 1, name='conv1')
+        net = nn.relu(_BatchNorm()(net, train))
+        net = _conv_fixed_padding(net, self.filters, 3, self.strides,
+                                  name='conv2')
+        net = nn.relu(_BatchNorm()(net, train))
+        net = _conv_fixed_padding(net, out_filters, 1, 1, name='conv3')
+      else:
+        net = _conv_fixed_padding(net, self.filters, 3, self.strides,
+                                  name='conv1')
+        net = nn.relu(_BatchNorm()(net, train))
+        net = _conv_fixed_padding(net, out_filters, 3, 1, name='conv2')
+      # FiLM on the block output before the residual add
+      # (film_resnet_model.py:219-222, applied pre-shortcut in v2).
+      net = apply_film(net, film_gamma_beta)
+      return net + shortcut
+
+    # v1: post-activation.
+    if self.project_shortcut:
+      shortcut = _conv_fixed_padding(x, out_filters, 1, self.strides,
+                                     name='proj')
+      shortcut = _BatchNorm()(shortcut, train)
+    net = x
+    if self.bottleneck:
+      net = _conv_fixed_padding(net, self.filters, 1, 1, name='conv1')
+      net = nn.relu(_BatchNorm()(net, train))
+      net = _conv_fixed_padding(net, self.filters, 3, self.strides,
+                                name='conv2')
+      net = nn.relu(_BatchNorm()(net, train))
+      net = _conv_fixed_padding(net, out_filters, 1, 1, name='conv3')
+      net = _BatchNorm()(net, train)
+    else:
+      net = _conv_fixed_padding(net, self.filters, 3, self.strides,
+                                name='conv1')
+      net = nn.relu(_BatchNorm()(net, train))
+      net = _conv_fixed_padding(net, out_filters, 3, 1, name='conv2')
+      net = _BatchNorm()(net, train)
+    # FiLM before the final ReLU (film_resnet_model.py:166-173).
+    net = apply_film(net, film_gamma_beta)
+    return nn.relu(net + shortcut)
+
+
+class ResNet(nn.Module):
+  """ResNet v1/v2 with optional FiLM conditioning per block.
+
+  ``__call__(images, film_gamma_betas=None, train=False)`` returns
+  ``(logits_or_features, endpoints)`` where endpoints mirrors
+  ``resnet_endpoints`` (resnet.py:86-100): ``initial_conv``,
+  ``initial_max_pool``, ``block_layer{1..4}``, ``pre_final_pool``,
+  ``final_reduce_mean``, ``final_dense``.
+
+  ``film_gamma_betas[i][j]`` conditions block j of block-layer i with a
+  [B, 2*C_out] tensor (or None) — the `linear_film_generator` layout.
+  """
+
+  resnet_size: int = 50
+  num_classes: Optional[int] = None  # None → return pooled features
+  num_filters: int = 64
+  version: int = 2
+  first_pool: bool = True
+  include_initial_layers: bool = True
+
+  @nn.compact
+  def __call__(self,
+               images: jnp.ndarray,
+               film_gamma_betas: Optional[Sequence[Sequence[Any]]] = None,
+               train: bool = False) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    block_sizes = BLOCK_SIZES[self.resnet_size]
+    bottleneck = self.resnet_size >= _BOTTLENECK_MIN_SIZE
+    if film_gamma_betas is None:
+      film_gamma_betas = [[None] * n for n in block_sizes]
+    endpoints: Dict[str, Any] = {}
+
+    net = images
+    if self.include_initial_layers:
+      net = _conv_fixed_padding(net, self.num_filters, 7, 2,
+                                name='initial_conv')
+      if self.version == 1:
+        net = nn.relu(_BatchNorm()(net, train))
+      endpoints['initial_conv'] = net
+      if self.first_pool:
+        net = jnp.pad(net, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                      constant_values=-jnp.inf)
+        net = nn.max_pool(net, (3, 3), strides=(2, 2), padding='VALID')
+      endpoints['initial_max_pool'] = net
+
+    for i, num_blocks in enumerate(block_sizes):
+      filters = self.num_filters * (2**i)
+      strides = 1 if i == 0 else 2
+      for j in range(num_blocks):
+        net = _Block(
+            filters=filters,
+            strides=strides if j == 0 else 1,
+            bottleneck=bottleneck,
+            version=self.version,
+            project_shortcut=(j == 0),
+            name=f'block_layer{i + 1}_block{j}')(
+                net, film_gamma_betas[i][j], train)
+      endpoints[f'block_layer{i + 1}'] = net
+
+    if self.version == 2:
+      net = nn.relu(_BatchNorm()(net, train))
+    endpoints['pre_final_pool'] = net
+    net = jnp.mean(net, axis=(1, 2))
+    endpoints['final_reduce_mean'] = net
+    if self.num_classes is not None:
+      net = nn.Dense(self.num_classes, name='final_dense')(net)
+      endpoints['final_dense'] = net
+    return net, endpoints
+
+  @property
+  def block_sizes(self) -> List[int]:
+    return BLOCK_SIZES[self.resnet_size]
+
+  @property
+  def filter_sizes(self) -> List[int]:
+    mult = 4 if self.resnet_size >= _BOTTLENECK_MIN_SIZE else 1
+    return [self.num_filters * (2**i) * mult for i in range(4)]
+
+
+class LinearFilmGenerator(nn.Module):
+  """Linear FiLM γ/β generator for every enabled block (resnet.py:103-149).
+
+  Produces ``film_gamma_betas[i][j]`` of shape [B, 2*C_out_i].
+  """
+
+  block_sizes: Sequence[int]
+  filter_sizes: Sequence[int]
+  enabled_block_layers: Optional[Sequence[bool]] = None
+
+  @nn.compact
+  def __call__(self, embedding: jnp.ndarray) -> List[List[Any]]:
+    if self.enabled_block_layers and (
+        len(self.enabled_block_layers) != len(self.block_sizes)):
+      raise ValueError(
+          f'Got {len(self.enabled_block_layers)} bools for '
+          f'enabled_block_layers, expected {len(self.block_sizes)}')
+    film_gamma_betas: List[List[Any]] = []
+    for i, num_blocks in enumerate(self.block_sizes):
+      if self.enabled_block_layers and not self.enabled_block_layers[i]:
+        film_gamma_betas.append([None] * num_blocks)
+        continue
+      film_output_size = num_blocks * self.filter_sizes[i] * 2
+      flat = nn.Dense(film_output_size, name=f'film{i}')(embedding)
+      film_gamma_betas.append(list(jnp.split(flat, num_blocks, axis=-1)))
+    return film_gamma_betas
+
+
+class FilmResNet(nn.Module):
+  """ResNet whose blocks are conditioned on an embedding via FiLM.
+
+  The capability of ``resnet_model(..., film_generator_fn=...)``
+  (resnet.py:152-218): embedding → linear γ/β per block → conditioned
+  ResNet forward.
+  """
+
+  resnet_size: int = 50
+  num_classes: Optional[int] = None
+  version: int = 2
+  enabled_block_layers: Optional[Sequence[bool]] = None
+
+  @nn.compact
+  def __call__(self, images, embedding=None, train: bool = False):
+    resnet = ResNet(
+        resnet_size=self.resnet_size,
+        num_classes=self.num_classes,
+        version=self.version,
+        name='resnet')
+    film_gamma_betas = None
+    if embedding is not None:
+      film_gamma_betas = LinearFilmGenerator(
+          block_sizes=tuple(BLOCK_SIZES[self.resnet_size]),
+          filter_sizes=tuple(resnet.filter_sizes),
+          enabled_block_layers=self.enabled_block_layers,
+          name='film_generator')(embedding)
+    return resnet(images, film_gamma_betas, train=train)
+
+
+def resnet_model(images,
+                 is_training: bool,
+                 num_classes: Optional[int] = None,
+                 resnet_size: int = 50,
+                 **unused_kwargs):
+  """Functional alias mirroring the reference builder's call shape."""
+  del unused_kwargs
+  model = ResNet(resnet_size=resnet_size, num_classes=num_classes)
+  return model, model  # module; apply via .init/.apply in JAX style

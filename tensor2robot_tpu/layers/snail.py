@@ -1,0 +1,93 @@
+"""SNAIL meta-learner blocks: dilated causal convs + causal attention.
+
+Reference: ``/root/reference/layers/snail.py:35-152`` (Mishra et al. '17).
+Flax modules with the same shape contracts. The causal mask is applied as
+an additive ``-inf`` upper triangle before one fused softmax — the TPU-
+friendly form XLA pattern-matches — instead of the reference's band-part
+decomposition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class CausalConv(nn.Module):
+  """Causal dilated 1-D conv over [B, T, C] (snail.py:35-58)."""
+
+  filters: int
+  dilation_rate: int = 1
+  kernel_size: int = 2
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    pad = (self.kernel_size - 1) * self.dilation_rate
+    x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
+    return nn.Conv(
+        features=self.filters,
+        kernel_size=(self.kernel_size,),
+        kernel_dilation=(self.dilation_rate,),
+        padding='VALID')(x)
+
+
+class DenseBlock(nn.Module):
+  """Gated activation, concatenated to the input (snail.py:60-76)."""
+
+  filters: int
+  dilation_rate: int = 1
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    xf = CausalConv(self.filters, self.dilation_rate, name='xf')(x)
+    xg = CausalConv(self.filters, self.dilation_rate, name='xg')(x)
+    activations = jnp.tanh(xf) * nn.sigmoid(xg)
+    return jnp.concatenate([x, activations], axis=2)
+
+
+class TCBlock(nn.Module):
+  """DenseBlocks with dilations 2^1..2^ceil(log2(T)) (snail.py:78-93)."""
+
+  sequence_length: int
+  filters: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    num_blocks = int(np.ceil(np.log2(self.sequence_length)))
+    for i in range(1, num_blocks + 1):
+      x = DenseBlock(self.filters, 2**i, name=f'DenseBlock_{i}')(x)
+    return x
+
+
+def causally_masked_softmax(logits: jnp.ndarray) -> jnp.ndarray:
+  """Softmax over the last dim with positions j > i masked out.
+
+  Same contract as snail.py:95-117 for [B, T, T] logits.
+  """
+  t = logits.shape[-1]
+  mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+  logits = jnp.where(mask, logits, -jnp.inf)
+  return nn.softmax(logits, axis=-1)
+
+
+class AttentionBlock(nn.Module):
+  """Causal single-head attention, read concatenated (snail.py:119-152).
+
+  Returns ([B, T, C + value_size], {'attn_prob': [B, T, T]}).
+  """
+
+  key_size: int
+  value_size: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, dict]:
+    key = nn.Dense(self.key_size)(x)
+    query = nn.Dense(self.key_size)(x)
+    logits = jnp.einsum('btk,bsk->bts', query, key)
+    probs = causally_masked_softmax(logits / np.sqrt(self.key_size))
+    values = nn.Dense(self.value_size)(x)
+    read = jnp.einsum('bts,bsv->btv', probs, values)
+    return jnp.concatenate([x, read], axis=2), {'attn_prob': probs}

@@ -1,0 +1,65 @@
+"""Spatial softmax: expected 2-D feature coordinates (soft arg-max).
+
+Reference: ``/root/reference/layers/spatial_softmax.py:33-93``. Same output
+contract — coordinates in [-1, 1], inner dim ordered
+``[x1..xN, y1..yN]`` — as pure jnp: one softmax over flattened pixels and
+one matmul against the coordinate grid (fuses into a couple of XLA ops; no
+per-pixel Python loops).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _coordinate_grid(num_rows: int, num_cols: int, dtype) -> jnp.ndarray:
+  """[num_rows*num_cols, 2] grid of (x, y) in [-1, 1]."""
+  ys = jnp.linspace(-1.0, 1.0, num_rows, dtype=dtype)
+  xs = jnp.linspace(-1.0, 1.0, num_cols, dtype=dtype)
+  grid_y, grid_x = jnp.meshgrid(ys, xs, indexing='ij')
+  return jnp.stack([grid_x.ravel(), grid_y.ravel()], axis=-1)
+
+
+def spatial_softmax(features: jnp.ndarray,
+                    temperature: float = 1.0,
+                    spatial_gumbel_softmax: bool = False,
+                    rng: Optional[jax.Array] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Expected feature coordinates of [B, H, W, C] feature maps.
+
+  Returns:
+    (expected_feature_points [B, 2*C] ordered [x1..xC, y1..yC],
+     softmax [B, H, W, C]).
+  """
+  batch, num_rows, num_cols, num_features = features.shape
+  compute_dtype = jnp.promote_types(features.dtype, jnp.float32)
+  # [B, C, H*W]: merge batch & channel for one batched softmax.
+  logits = jnp.transpose(features, (0, 3, 1, 2)).reshape(
+      batch, num_features, num_rows * num_cols).astype(compute_dtype)
+  logits = logits / temperature
+  if spatial_gumbel_softmax:
+    if rng is None:
+      raise ValueError('spatial_gumbel_softmax requires an rng key.')
+    # Relaxed one-hot categorical sample (Gumbel-softmax, temperature 1.0).
+    gumbel = jax.random.gumbel(rng, logits.shape, dtype=compute_dtype)
+    attention = jax.nn.softmax(logits + gumbel, axis=-1)
+  else:
+    attention = jax.nn.softmax(logits, axis=-1)
+  grid = _coordinate_grid(num_rows, num_cols, compute_dtype)  # [HW, 2]
+  # [B, C, 2]: expectation = attention @ grid (rides the MXU).
+  expected_xy = attention @ grid
+  # Reorder to [x1..xC, y1..yC].
+  expected_feature_points = jnp.concatenate(
+      [expected_xy[..., 0], expected_xy[..., 1]], axis=-1)
+  softmax_maps = jnp.transpose(
+      attention.reshape(batch, num_features, num_rows, num_cols),
+      (0, 2, 3, 1))
+  return (expected_feature_points.astype(features.dtype),
+          softmax_maps.astype(features.dtype))
+
+
+# Reference-name alias.
+BuildSpatialSoftmax = spatial_softmax

@@ -1,0 +1,133 @@
+"""Task-embedding (TEC) layers: episode → embedding + contrastive loss.
+
+Reference: ``/root/reference/layers/tec.py:30-172`` (Task-Embedded Control
+Networks). Flax modules with the same contracts: full-state/image episode
+encoders, temporal reduction via 1-D convs + MLP, and the contrastive
+embedding loss over (inference, condition) episode embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.vision_layers import ImagesToFeaturesModel
+
+
+class EmbedFullstate(nn.Module):
+  """MLP embedding of non-image state [N, F] → [N, embed_size] (tec.py:30)."""
+
+  embed_size: int
+  fc_layers: Sequence[int] = (100,)
+
+  @nn.compact
+  def __call__(self, fullstate: jnp.ndarray) -> jnp.ndarray:
+    net = fullstate
+    for i, width in enumerate(self.fc_layers):
+      net = nn.Dense(width, name=f'fc{i}')(net)
+      net = nn.LayerNorm()(net)
+      net = nn.relu(net)
+    return nn.Dense(self.embed_size, name='embed')(net)
+
+
+class EmbedConditionImages(nn.Module):
+  """Per-image embedding via the vision tower (tec.py:53-88)."""
+
+  fc_layers: Optional[Sequence[int]] = None
+
+  @nn.compact
+  def __call__(self, condition_image: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    if condition_image.ndim != 4:
+      raise ValueError(
+          f'Image has unexpected shape {condition_image.shape}.')
+    embedding, _ = ImagesToFeaturesModel()(condition_image, train=train)
+    if self.fc_layers is not None:
+      for i, width in enumerate(self.fc_layers[:-1]):
+        embedding = nn.Dense(width, name=f'fc{i}')(embedding)
+        embedding = nn.LayerNorm()(embedding)
+        embedding = nn.relu(embedding)
+      embedding = nn.Dense(self.fc_layers[-1], name='fc_out')(embedding)
+    return embedding
+
+
+class ReduceTemporalEmbeddings(nn.Module):
+  """[N, T, F] → [N, output_size] via 1-D convs + MLP (tec.py:90-133)."""
+
+  output_size: int
+  conv1d_layers: Optional[Sequence[int]] = (64,)
+  fc_hidden_layers: Sequence[int] = (100,)
+  kernel_size: int = 10
+
+  @nn.compact
+  def __call__(self, temporal_embedding: jnp.ndarray) -> jnp.ndarray:
+    if temporal_embedding.ndim != 3:
+      raise ValueError(
+          f'Temporal embedding has unexpected shape '
+          f'{temporal_embedding.shape}.')
+    net = temporal_embedding
+    if self.conv1d_layers is not None:
+      for i, num_filters in enumerate(self.conv1d_layers):
+        net = nn.Conv(
+            num_filters, (self.kernel_size,), padding='VALID',
+            use_bias=False, name=f'conv1d_{i}')(net)
+        net = nn.relu(net)
+        net = nn.LayerNorm()(net)
+    net = net.reshape((net.shape[0], -1))
+    for i, width in enumerate(self.fc_hidden_layers):
+      net = nn.Dense(width, name=f'fc{i}')(net)
+      net = nn.LayerNorm()(net)
+      net = nn.relu(net)
+    return nn.Dense(self.output_size, name='out')(net)
+
+
+def contrastive_loss(labels: jnp.ndarray,
+                     anchor: jnp.ndarray,
+                     embeddings: jnp.ndarray,
+                     margin: float = 1.0) -> jnp.ndarray:
+  """Standard contrastive loss between one anchor and N embeddings.
+
+  ``labels[i]`` marks embedding i as a positive for the anchor. Positives
+  pull (squared distance), negatives push below ``margin``.
+  """
+  distances = jnp.sqrt(
+      jnp.sum(jnp.square(anchor - embeddings), axis=-1) + 1e-12)
+  labels = labels.astype(jnp.float32)
+  positive_term = labels * jnp.square(distances)
+  negative_term = (1.0 - labels) * jnp.square(
+      jnp.maximum(margin - distances, 0.0))
+  return jnp.mean(positive_term + negative_term)
+
+
+def compute_embedding_contrastive_loss(
+    inf_embedding: jnp.ndarray,
+    con_embedding: jnp.ndarray,
+    positives: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+  """Anchor = task-0 inference embedding vs all condition embeddings.
+
+  Mirrors tec.py:136-172: embeddings [num_tasks, num_episodes, K] are
+  averaged over episodes; task 0 is the positive unless ``positives``
+  marks otherwise. Embeddings are expected L2-normalized.
+  """
+  if inf_embedding.ndim != 3:
+    raise ValueError(
+        f'Unexpected inf_embedding shape: {inf_embedding.shape}.')
+  if con_embedding.ndim != 3:
+    raise ValueError(
+        f'Unexpected con_embedding shape: {con_embedding.shape}.')
+  avg_inf_embedding = jnp.mean(inf_embedding, axis=1)
+  avg_con_embedding = jnp.mean(con_embedding, axis=1)
+  anchor = avg_inf_embedding[0:1]
+  if positives is not None:
+    labels = positives
+  else:
+    labels = jnp.arange(avg_con_embedding.shape[0]) == 0
+  return contrastive_loss(labels, anchor, avg_con_embedding)
+
+
+# Reference-name aliases.
+embed_fullstate = EmbedFullstate
+embed_condition_images = EmbedConditionImages
+reduce_temporal_embeddings = ReduceTemporalEmbeddings

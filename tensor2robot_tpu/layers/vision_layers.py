@@ -1,0 +1,228 @@
+"""Vision layers: conv towers with spatial-softmax heads + pose MLPs.
+
+Reference: ``/root/reference/layers/vision_layers.py`` ("Berkeley-Net"
+family used by pose_env / vrgripper). Flax modules with identical shape
+and conditioning contracts:
+
+* :class:`ImagesToFeaturesModel` — VALID-padded conv stack with optional
+  per-block FiLM ``(1+γ)·x + β`` conditioning, 1×1 projection, spatial
+  softmax head (vision_layers.py:33-151).
+* :class:`FILMParams` — linear layer emitting concatenated γ/β
+  (vision_layers.py:154-174).
+* :class:`ImagesToFeaturesModelHighRes` — multi-resolution PI-GPS variant
+  summing upsampled block outputs (vision_layers.py:177-266).
+* :class:`ImageFeaturesToPoseModel` — MLP with MAML-style bias transform
+  (vision_layers.py:269-343).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+
+_NUM_CHANNELS_PER_BLOCK = 32
+
+
+def film_modulation(net: jnp.ndarray, gamma: jnp.ndarray,
+                    beta: jnp.ndarray) -> jnp.ndarray:
+  """FiLM with the zero-centered-gamma convention: (1 + γ)·x + β."""
+  gamma = gamma[:, None, None, :]
+  beta = beta[:, None, None, :]
+  return (1.0 + gamma) * net + beta
+
+
+def film_params_size(num_blocks: int,
+                     channels: int = _NUM_CHANNELS_PER_BLOCK) -> int:
+  return 2 * num_blocks * channels
+
+
+class ImagesToFeaturesModel(nn.Module):
+  """Conv tower → spatial softmax (vision_layers.py:33-151).
+
+  ``__call__(images, film_output_params=None, train=False)`` returns
+  ``(expected_feature_points [B, 2*num_output_maps], {'softmax': maps})``.
+  FiLM params, when given, are ``[B, 2*num_blocks*32]`` laid out as all
+  gammas then all betas (block-major).
+  """
+
+  filter_size: int = 3
+  num_blocks: int = 5
+  num_output_maps: int = 32
+  use_batch_norm: bool = False  # reference default: layer norm
+
+  @nn.compact
+  def __call__(self,
+               images: jnp.ndarray,
+               film_output_params: Optional[jnp.ndarray] = None,
+               train: bool = False) -> Tuple[jnp.ndarray, dict]:
+    channels = _NUM_CHANNELS_PER_BLOCK
+    gammas = betas = None
+    if film_output_params is not None:
+      expected = film_params_size(self.num_blocks, channels)
+      if film_output_params.ndim != 2 or (
+          film_output_params.shape[-1] != expected):
+        raise ValueError(
+            f'FiLM params must be [B, {expected}], got '
+            f'{film_output_params.shape}')
+      split = jnp.split(film_output_params, 2 * self.num_blocks, axis=-1)
+      gammas, betas = split[:self.num_blocks], split[self.num_blocks:]
+
+    net = images
+    for i in range(self.num_blocks):
+      stride = 2 if i in (0, 1) else 1
+      net = nn.Conv(
+          features=channels,
+          kernel_size=(self.filter_size, self.filter_size),
+          strides=(stride, stride),
+          padding='VALID',
+          kernel_init=nn.initializers.xavier_uniform(),
+          bias_init=nn.initializers.constant(0.01),
+          name=f'conv{i + 2}')(net)
+      net = self._normalize(net, train, scale=False, name=f'norm{i + 2}')
+      if gammas is not None:
+        net = film_modulation(net, gammas[i], betas[i])
+      net = nn.relu(net)
+
+    net = nn.Conv(
+        features=self.num_output_maps,
+        kernel_size=(1, 1),
+        padding='VALID',
+        kernel_init=nn.initializers.xavier_uniform(),
+        bias_init=nn.initializers.constant(0.01),
+        name='final_conv_1x1')(net)
+    net = self._normalize(net, train, scale=True, name='final_norm')
+    points, softmax = spatial_softmax(net)
+    return points, {'softmax': softmax}
+
+  def _normalize(self, net, train, scale, name):
+    if self.use_batch_norm:
+      return nn.BatchNorm(
+          use_running_average=not train, momentum=0.99, epsilon=1e-4,
+          use_scale=scale, name=name)(net)
+    return nn.LayerNorm(use_scale=scale, name=name)(net)
+
+
+class FILMParams(nn.Module):
+  """Linear γ/β generator from an embedding (vision_layers.py:154-174)."""
+
+  film_output_size: int = film_params_size(5)
+
+  @nn.compact
+  def __call__(self, embedding: jnp.ndarray) -> jnp.ndarray:
+    return nn.Dense(self.film_output_size, name='film')(embedding)
+
+
+class ImagesToFeaturesModelHighRes(nn.Module):
+  """Multi-res conv tower (PI-GPS variant, vision_layers.py:177-266).
+
+  Block outputs at different resolutions are nearest-neighbor upsampled to
+  the first block's resolution and summed before the spatial softmax.
+  """
+
+  filter_size: int = 3
+  num_blocks: int = 5
+  num_output_maps: int = 32
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               train: bool = False) -> Tuple[jnp.ndarray, dict]:
+    conv_kwargs = dict(
+        padding='VALID',
+        kernel_init=nn.initializers.truncated_normal(stddev=0.1))
+
+    def norm(net, scale, name):
+      return nn.BatchNorm(
+          use_running_average=not train, momentum=0.99, epsilon=1e-4,
+          use_scale=scale, name=name)(net)
+
+    block_outs = []
+    net = nn.avg_pool(images, (2, 2), strides=(2, 2), padding='VALID')
+    net = nn.Conv(16, (self.filter_size, self.filter_size), strides=(2, 2),
+                  name='conv1', **conv_kwargs)(net)
+    net = nn.relu(norm(net, False, 'norm1'))
+    net = nn.Conv(32, (self.filter_size, self.filter_size), name='conv2',
+                  **conv_kwargs)(net)
+    net = nn.relu(norm(net, False, 'norm2'))
+    out = nn.Conv(32, (1, 1), name='conv2_1x1', **conv_kwargs)(net)
+    block_outs.append(nn.relu(norm(out, False, 'norm2_1x1')))
+    for i in range(1, self.num_blocks):
+      net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
+      net = nn.Conv(32, (self.filter_size, self.filter_size),
+                    name=f'conv{i + 2}', **conv_kwargs)(net)
+      net = nn.relu(norm(net, False, f'norm{i + 2}'))
+      out = nn.Conv(32, (1, 1), name=f'conv{i + 2}_1x1', **conv_kwargs)(net)
+      block_outs.append(nn.relu(norm(out, False, f'norm{i + 2}_1x1')))
+
+    target_hw = block_outs[0].shape[1:3]
+
+    def resize(layer):
+      return jax.image.resize(
+          layer, layer.shape[:1] + target_hw + layer.shape[3:],
+          method='nearest')
+
+    net = sum(resize(layer) for layer in block_outs)
+    net = nn.Conv(self.num_output_maps, (1, 1), name='final_conv_1x1',
+                  **conv_kwargs)(net)
+    net = norm(net, True, 'final_norm')
+    points, softmax = spatial_softmax(net)
+    return points, {'softmax': softmax}
+
+
+class ImageFeaturesToPoseModel(nn.Module):
+  """Feature points (+aux) → pose MLP (vision_layers.py:269-343).
+
+  The bias transform — a learned vector concatenated to the input — gives
+  MAML's inner loop a direct knob on the MLP input distribution.
+  """
+
+  num_outputs: Optional[int]
+  aux_output_dim: int = 0
+  hidden_dim: int = 100
+  num_layers: int = 2
+  bias_transform_size: int = 10
+
+  @nn.compact
+  def __call__(self,
+               expected_feature_points: jnp.ndarray,
+               aux_input: Optional[jnp.ndarray] = None
+               ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    dense_kwargs = dict(
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        bias_init=nn.initializers.constant(0.01))
+    if aux_input is not None:
+      net = jnp.concatenate([expected_feature_points, aux_input], axis=1)
+    else:
+      net = expected_feature_points
+    if self.bias_transform_size > 0:
+      bias_transform = self.param(
+          'bias_transform', nn.initializers.constant(0.01),
+          (self.bias_transform_size,), jnp.float32)
+      tiled = jnp.broadcast_to(
+          bias_transform,
+          (net.shape[0], self.bias_transform_size)).astype(net.dtype)
+      net = jnp.concatenate([net, tiled], axis=1)
+    for layer_index in range(self.num_layers):
+      net = nn.Dense(self.hidden_dim, name=f'pose_fc{layer_index}',
+                     **dense_kwargs)(net)
+      net = nn.LayerNorm()(net)
+      net = nn.relu(net)
+    if self.num_outputs:
+      net = nn.Dense(self.num_outputs, name=f'pose_fc{self.num_layers}',
+                     **dense_kwargs)(net)
+    aux_output = None
+    if self.aux_output_dim > 0:
+      aux_output = nn.Dense(self.aux_output_dim, name='pose_fc_aux',
+                            **dense_kwargs)(expected_feature_points)
+    return net, aux_output
+
+
+# Reference-name aliases.
+BuildImagesToFeaturesModel = ImagesToFeaturesModel
+BuildFILMParams = FILMParams
+BuildImagesToFeaturesModelHighRes = ImagesToFeaturesModelHighRes
+BuildImageFeaturesToPoseModel = ImageFeaturesToPoseModel

@@ -1,0 +1,27 @@
+"""Meta-learning: MAML via grad+vmap, meta specs, MetaExample pipeline."""
+
+from tensor2robot_tpu.meta_learning.maml_inner_loop import (
+    MAMLInnerLoopGradientDescent,
+    gradient_descent_step,
+)
+from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+from tensor2robot_tpu.meta_learning.meta_example import (
+    make_meta_example,
+    serialize_meta_example,
+)
+from tensor2robot_tpu.meta_learning.meta_policies import (
+    FixedLengthSequentialRegressionPolicy,
+    MAMLCEMPolicy,
+    MAMLRegressionPolicy,
+    MetaLearningPolicy,
+    ScheduledExplorationMAMLRegressionPolicy,
+)
+from tensor2robot_tpu.meta_learning.preprocessors import (
+    FixedLenMetaExamplePreprocessor,
+    MAMLPreprocessorV2,
+    create_maml_feature_spec,
+    create_maml_label_spec,
+    create_metaexample_spec,
+)
+from tensor2robot_tpu.meta_learning.run_meta_env import run_meta_env
+from tensor2robot_tpu.meta_learning import meta_tfdata

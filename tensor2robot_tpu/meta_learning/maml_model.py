@@ -1,0 +1,211 @@
+"""MAMLModel: wraps any base T2R model for meta-learning.
+
+Capability-equivalent of
+``/root/reference/meta_learning/maml_model.py:76-554``. The reference maps
+per-task adaptation over the task batch with ``tf.map_fn`` (after building
+the base model in a throwaway graph just to infer output dtypes,
+``:154-232``). Here adaptation is a pure function and tasks are mapped
+with ``jax.vmap`` — no dtype inference, no graph surgery, and the task
+loop vectorizes onto the MXU.
+
+Predictions contract (``:310-359``):
+``full_condition_output/output_<i>`` for every adaptation step (pre/post),
+``full_inference_output`` (adapted) and
+``full_inference_output_unconditioned``.
+Outer loss = base ``model_train_fn`` on the flattened inference outputs
+vs ``meta_labels`` (``:420-501``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.meta_learning import maml_inner_loop, meta_tfdata
+from tensor2robot_tpu.meta_learning.preprocessors import (
+    MAMLPreprocessorV2,
+    create_maml_feature_spec,
+    create_maml_label_spec,
+)
+from tensor2robot_tpu.models.base import AbstractT2RModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct, algebra
+
+
+class MAMLModel(AbstractT2RModel):
+  """Meta-model: per-task inner adaptation + outer meta-objective."""
+
+  def __init__(self,
+               base_model: AbstractT2RModel,
+               num_inner_loop_steps: int = 1,
+               inner_learning_rate: float = 0.001,
+               use_second_order: bool = False,
+               learn_inner_lr: bool = False,
+               preprocessor_cls=None,
+               **kwargs):
+    kwargs.setdefault('device_type', base_model.device_type)
+    super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
+    self._base_model = base_model
+    self._num_inner_loop_steps = num_inner_loop_steps
+    self._inner_loop = maml_inner_loop.MAMLInnerLoopGradientDescent(
+        learning_rate=inner_learning_rate,
+        use_second_order=use_second_order,
+        learn_inner_lr=learn_inner_lr)
+
+  @property
+  def base_model(self) -> AbstractT2RModel:
+    return self._base_model
+
+  # ------------------------------------------------------------------ specs
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return create_maml_feature_spec(
+        self._base_model.get_feature_specification(mode),
+        self._base_model.get_label_specification(mode))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return create_maml_label_spec(
+        self._base_model.get_label_specification(mode))
+
+  @property
+  def preprocessor(self):
+    base_preprocessor = self._base_model.preprocessor
+    if self._preprocessor_cls is not None:
+      preprocessor = self._preprocessor_cls(base_preprocessor)
+    else:
+      preprocessor = MAMLPreprocessorV2(base_preprocessor)
+    return preprocessor
+
+  # ----------------------------------------------------------------- params
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    """Initializes base variables from one task's flattened sample batch."""
+    cond_features = self._subtree(features, 'condition/features')
+    flat = meta_tfdata.flatten_batch_examples(cond_features)
+    variables = dict(self._base_model.init_variables(rng, flat, mode))
+    if self._inner_loop.learn_inner_lr:
+      lr_params = self._inner_loop.create_lr_params(variables['params'])
+      variables['params'] = {
+          'base': variables['params'],
+          'inner_lrs': lr_params,
+      }
+    return variables
+
+  def _split_params(self, params) -> Tuple[Any, Optional[Any]]:
+    if self._inner_loop.learn_inner_lr:
+      return params['base'], params['inner_lrs']
+    return params, None
+
+  def _subtree(self, struct, prefix: str) -> SpecStruct:
+    out = SpecStruct()
+    for key, value in struct.items():
+      if key.startswith(prefix + '/'):
+        out[key[len(prefix) + 1:]] = value
+    return out
+
+  # ---------------------------------------------------------------- forward
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    base = self._base_model
+    variables = dict(variables)
+    params = variables.pop('params')
+    base_params, lr_params = self._split_params(params)
+    model_state = variables  # non-trainable collections, shared across tasks
+
+    condition_features = self._subtree(features, 'condition/features')
+    condition_labels = self._subtree(features, 'condition/labels')
+    inference_features = self._subtree(features, 'inference/features')
+
+    def forward(p, task_features):
+      merged = dict(model_state)
+      merged['params'] = p
+      outputs, _ = base.inference_network_fn(
+          merged, task_features, None, ModeKeys.EVAL, rng)
+      return dict(outputs)
+
+    def inner_objective(p, task_features, task_labels):
+      outputs = forward(p, task_features)
+      loss, _ = base.model_train_fn(
+          task_features, task_labels,
+          algebra.flatten_spec_structure(outputs), mode)
+      return loss
+
+    def task_learn(task_cond_f, task_cond_l, task_inf_f):
+      result = self._inner_loop.inner_loop(
+          base_params,
+          inner_objective,
+          forward,
+          task_cond_f,
+          task_cond_l,
+          task_inf_f,
+          num_steps=self._num_inner_loop_steps,
+          lr_params=lr_params)
+      return (result['condition_outputs'], result['conditioned_output'],
+              result['unconditioned_output'])
+
+    cond_outputs, inf_outputs, inf_unconditioned = jax.vmap(task_learn)(
+        dict(condition_features), dict(condition_labels),
+        dict(inference_features))
+
+    predictions = SpecStruct()
+    for i, step_output in enumerate(cond_outputs):
+      for key, value in step_output.items():
+        predictions[f'full_condition_output/output_{i}/{key}'] = value
+    for key, value in inf_outputs.items():
+      predictions[f'full_inference_output/{key}'] = value
+    for key, value in inf_unconditioned.items():
+      predictions[f'full_inference_output_unconditioned/{key}'] = value
+    variables['params'] = params
+    return predictions, variables
+
+  # ------------------------------------------------------------------ losses
+
+  def _base_label_view(self, labels) -> SpecStruct:
+    """meta_labels/... → base label keys, flattened over tasks."""
+    base_labels = SpecStruct()
+    for key, value in labels.items():
+      base_labels[key] = value
+    return meta_tfdata.flatten_batch_examples(base_labels)
+
+  def _base_inference_view(self, inference_outputs) -> SpecStruct:
+    outputs = SpecStruct()
+    for key, value in inference_outputs.items():
+      prefix = 'full_inference_output/'
+      if key.startswith(prefix):
+        outputs[key[len(prefix):]] = value
+    return meta_tfdata.flatten_batch_examples(outputs)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    """Outer loss on adapted inference outputs (maml_model.py:420-501)."""
+    flat_outputs = self._base_inference_view(inference_outputs)
+    flat_labels = self._base_label_view(labels)
+    inference_features = meta_tfdata.flatten_batch_examples(
+        self._subtree(features, 'inference/features'))
+    loss, scalars = self._base_model.model_train_fn(
+        inference_features, flat_labels, flat_outputs, mode)
+    return loss, scalars
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    flat_outputs = self._base_inference_view(inference_outputs)
+    flat_labels = self._base_label_view(labels)
+    inference_features = meta_tfdata.flatten_batch_examples(
+        self._subtree(features, 'inference/features'))
+    metrics = self._base_model.model_eval_fn(
+        inference_features, flat_labels, flat_outputs)
+    # Adaptation benefit: unconditioned-vs-conditioned loss delta.
+    uncond = SpecStruct()
+    prefix = 'full_inference_output_unconditioned/'
+    for key, value in inference_outputs.items():
+      if key.startswith(prefix):
+        uncond[key[len(prefix):]] = value
+    uncond_metrics = self._base_model.model_eval_fn(
+        inference_features, flat_labels,
+        meta_tfdata.flatten_batch_examples(uncond))
+    metrics['loss_unconditioned'] = uncond_metrics['loss']
+    return metrics
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    return inference_outputs

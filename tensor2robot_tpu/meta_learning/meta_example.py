@@ -1,0 +1,71 @@
+"""MetaExample construction: episode Examples → one MetaExample record.
+
+Capability-equivalent of
+``/root/reference/meta_learning/meta_example.py:34-90``: every feature of
+episode i is copied under ``condition_ep<i>/...`` or ``inference_ep<i>/...``.
+Operates on ``tf.train.Example`` / ``SequenceExample`` protos or their
+serialized bytes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+ExampleLike = Union[bytes, object]
+
+
+def _tf():
+  import tensorflow as tf
+
+  return tf
+
+
+def _to_example(example: ExampleLike):
+  tf = _tf()
+  if isinstance(example, bytes):
+    parsed = tf.train.Example()
+    parsed.ParseFromString(example)
+    return parsed
+  return example
+
+
+def append_example(meta_example, ep_example, prefix: str) -> None:
+  """Copies episode features under ``<prefix>/<key>`` (meta_example.py:54-60)."""
+  context_feature_map = meta_example.features.feature
+  for key, feature in ep_example.features.feature.items():
+    context_feature_map[f'{prefix}/{key}'].CopyFrom(feature)
+
+
+def append_sequence_example(meta_example, ep_example, prefix: str) -> None:
+  """SequenceExample variant (meta_example.py:63-76)."""
+  context_feature_map = meta_example.context.feature
+  for key, feature in ep_example.context.feature.items():
+    context_feature_map[f'{prefix}/{key}'].CopyFrom(feature)
+  sequential_feature_map = meta_example.feature_lists.feature_list
+  for key, feature_list in ep_example.feature_lists.feature_list.items():
+    sequential_feature_map[f'{prefix}/{key}'].CopyFrom(feature_list)
+
+
+def make_meta_example(condition_examples: Sequence[ExampleLike],
+                      inference_examples: Sequence[ExampleLike]):
+  """K condition + M inference Examples → MetaExample (meta_example.py:34-51)."""
+  tf = _tf()
+  condition_examples = [_to_example(e) for e in condition_examples]
+  inference_examples = [_to_example(e) for e in inference_examples]
+  if isinstance(condition_examples[0], tf.train.Example):
+    meta_example = tf.train.Example()
+    append_fn = append_example
+  else:
+    meta_example = tf.train.SequenceExample()
+    append_fn = append_sequence_example
+  for i, train_example in enumerate(condition_examples):
+    append_fn(meta_example, train_example, f'condition_ep{i}')
+  for i, val_example in enumerate(inference_examples):
+    append_fn(meta_example, val_example, f'inference_ep{i}')
+  return meta_example
+
+
+def serialize_meta_example(condition_examples: Sequence[ExampleLike],
+                           inference_examples: Sequence[ExampleLike]) -> bytes:
+  return make_meta_example(
+      condition_examples, inference_examples).SerializeToString()

@@ -1,0 +1,132 @@
+"""Meta-learning policies: condition on demo episodes, then act.
+
+Capability-equivalent of
+``/root/reference/meta_learning/meta_policies.py:32-206``: policies cache
+condition episodes via ``adapt(episode_data)`` and feed them alongside the
+inference state; the exported MAML model performs the inner-loop
+adaptation inside its forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu.policies import policies
+
+
+class MetaLearningPolicy(policies.Policy):
+  """Adds reset_task/adapt to the policy surface (meta_policies.py:32-43)."""
+
+  def reset_task(self) -> None:
+    ...
+
+  def adapt(self, episode_data) -> None:
+    ...
+
+
+class MAMLCEMPolicy(MetaLearningPolicy, policies.CEMPolicy):
+  """CEM + MAML adaptation (meta_policies.py:45-99)."""
+
+  def reset_task(self):
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data):
+    self._prev_episode_data = episode_data
+
+  def SelectAction(self, state, context, timestep):
+    if getattr(self, '_prev_episode_data', None):
+      prediction_key = 'full_inference_output/q_predicted'
+    else:
+      prediction_key = 'full_inference_output_unconditioned/q_predicted'
+
+    def objective_fn(samples):
+      cem_state = np.tile(
+          np.expand_dims(state, 0), [samples.shape[0]] + [1] * state.ndim)
+      np_inputs = self._t2r_model.pack_features(
+          cem_state, self._prev_episode_data, timestep, samples)
+      q_values = self._predictor.predict(np_inputs)[prediction_key]
+      if not self._prev_episode_data:
+        q_values = q_values * 0
+      return np.asarray(q_values).reshape(-1)
+
+    action, _ = self.get_cem_action(objective_fn)
+    return action
+
+
+class MAMLRegressionPolicy(MetaLearningPolicy, policies.RegressionPolicy):
+  """Regression + MAML adaptation (meta_policies.py:103-139)."""
+
+  def reset_task(self):
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data):
+    self._prev_episode_data = episode_data
+
+  def sample_action(self, obs, explore_prob):
+    del explore_prob
+    action = self.SelectAction(obs, None, None)
+    # Replay writers require the is_demo flag when forming MetaExamples.
+    return action, {'is_demo': False}
+
+  def SelectAction(self, state, context, timestep):
+    np_features = self._t2r_model.pack_features(
+        state, getattr(self, '_prev_episode_data', None), timestep)
+    action = np.asarray(
+        self._predictor.predict(np_features)['full_inference_output/'
+                                             'inference_output'])
+    if action.ndim == 4:
+      return action[0, 0, 0]
+    if action.ndim == 3:
+      return action[0, 0]
+    if action.ndim == 2:
+      return action[0]
+    raise ValueError(f'Invalid action rank: {action.ndim}')
+
+
+class FixedLengthSequentialRegressionPolicy(MAMLRegressionPolicy):
+  """Buffers recent observations into a fixed-length episode context
+  (meta_policies.py:141-170)."""
+
+  def reset_task(self):
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data):
+    self._prev_episode_data = episode_data
+
+  def reset(self):
+    self._obs_buffer = []
+
+  def SelectAction(self, state, context, timestep):
+    self._obs_buffer.append(state)
+    np_features = self._t2r_model.pack_features(
+        self._obs_buffer, getattr(self, '_prev_episode_data', None), timestep)
+    action = np.asarray(
+        self._predictor.predict(np_features)['full_inference_output/'
+                                             'inference_output'])
+    return action.reshape(-1, action.shape[-1])[-1]
+
+
+class ScheduledExplorationMAMLRegressionPolicy(MAMLRegressionPolicy):
+  """MAML regression + scheduled gaussian noise (meta_policies.py:172-206)."""
+
+  def __init__(self,
+               *args,
+               action_size: int = 2,
+               stddev_0: float = 0.2,
+               slope: float = 0.0,
+               **kwargs):
+    super().__init__(*args, **kwargs)
+    self._noise_action_size = action_size
+    self._stddev_0 = stddev_0
+    self._slope = slope
+
+  def get_noise(self):
+    stddev = max(self._stddev_0 + self.global_step * self._slope, 0.0)
+    return stddev * np.random.randn(self._noise_action_size)
+
+  def sample_action(self, obs, explore_prob):
+    del explore_prob
+    action = self.SelectAction(obs, None, None) + self.get_noise()
+    return action, {'is_demo': False}

@@ -1,0 +1,96 @@
+"""Meta-batch utilities: flatten/unflatten task×sample dims, multi-batch apply.
+
+Capability-equivalent of ``/root/reference/meta_learning/meta_tfdata.py``:
+
+* :func:`flatten_batch_examples` / :func:`unflatten_batch_examples` —
+  merge/split the leading [num_tasks, num_samples] dims (``:179-224``).
+* :func:`multi_batch_apply` — vectorize a function over multiple leading
+  batch dims (``:266-286``); in JAX this is a reshape round-trip (the
+  reference's approach) kept for API parity — ``jax.vmap`` is the
+  idiomatic alternative and what MAML uses.
+* Task-grouped record reading lives in
+  :class:`MetaExampleInputGenerator` (one MetaExample record per task).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.specs import SpecStruct, algebra
+
+
+def _map_leaves(fn, structure):
+  if structure is None:
+    return None
+  flat = algebra.flatten_spec_structure(structure)
+  out = SpecStruct()
+  for key, value in flat.items():
+    out[key] = fn(value)
+  return out
+
+
+def flatten_batch_examples(tensor_collection):
+  """[num_tasks, num_samples, ...] → [num_tasks*num_samples, ...]."""
+
+  def flatten(value):
+    shape = value.shape
+    return value.reshape((shape[0] * shape[1],) + tuple(shape[2:]))
+
+  return _map_leaves(flatten, tensor_collection)
+
+
+def unflatten_batch_examples(tensor_collection, num_samples_per_task: int):
+  """[num_tasks*num_samples, ...] → [num_tasks, num_samples, ...]."""
+
+  def unflatten(value):
+    shape = value.shape
+    return value.reshape(
+        (-1, num_samples_per_task) + tuple(shape[1:]))
+
+  return _map_leaves(unflatten, tensor_collection)
+
+
+def multi_batch_apply(fn: Callable, num_batch_dims: int, *args, **kwargs):
+  """Applies ``fn`` (expecting one batch dim) over several leading dims.
+
+  All array leaves in ``args`` are reshaped to merge their first
+  ``num_batch_dims`` dims, ``fn`` is applied, and outputs are reshaped
+  back (meta_tfdata.py:266-286).
+  """
+  import jax
+
+  lead_shape = None
+
+  def merge(value):
+    nonlocal lead_shape
+    if hasattr(value, 'shape') and len(value.shape) >= num_batch_dims:
+      lead_shape = tuple(value.shape[:num_batch_dims])
+      return value.reshape((-1,) + tuple(value.shape[num_batch_dims:]))
+    return value
+
+  merged_args = jax.tree_util.tree_map(merge, list(args))
+  result = fn(*merged_args, **kwargs)
+  if lead_shape is None:
+    return result
+
+  def split(value):
+    if hasattr(value, 'shape'):
+      return value.reshape(lead_shape + tuple(value.shape[1:]))
+    return value
+
+  return jax.tree_util.tree_map(split, result)
+
+
+def split_train_val(tensors, num_train_samples_per_task: int):
+  """Splits the samples dim into (train, val) (meta_tfdata.py:135-156)."""
+
+  def head(value):
+    return value[:, :num_train_samples_per_task]
+
+  def tail(value):
+    return value[:, num_train_samples_per_task:]
+
+  return _map_leaves(head, tensors), _map_leaves(tail, tensors)

@@ -1,0 +1,145 @@
+"""Meta task-loop driver: per task, adapt on demos/rollouts then evaluate.
+
+Capability-equivalent of
+``/root/reference/meta_learning/run_meta_env.py:37-262``: for each task,
+(optionally) collect demonstration episodes, ``policy.adapt`` on them, run
+``num_adaptations_per_task`` trial rounds re-adapting on accumulated data,
+and log per-step rewards (JSON lines instead of TF summaries).
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+import datetime
+import json
+import logging
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def run_meta_env(env,
+                 policy=None,
+                 demo_policy_cls=None,
+                 explore_schedule=None,
+                 episode_to_transitions_fn: Optional[Callable] = None,
+                 replay_writer=None,
+                 root_dir: Optional[str] = None,
+                 task: int = 0,
+                 global_step: int = 0,
+                 num_episodes=None,
+                 num_tasks: int = 10,
+                 num_adaptations_per_task: int = 2,
+                 num_episodes_per_adaptation: int = 1,
+                 num_demos: int = 1,
+                 break_after_one_task: bool = False,
+                 tag: str = 'collect',
+                 write_summary: bool = False):
+  """Runs the meta collect/eval loop; returns per-task step rewards."""
+  del num_episodes
+
+  task_step_rewards = collections.defaultdict(
+      lambda: collections.defaultdict(list))
+  episode_q_values = collections.defaultdict(list)
+
+  for task_idx in range(num_tasks):
+    if hasattr(policy, 'reset_task'):
+      policy.reset_task()
+    env.reset_task()
+
+    record_name = None
+    if root_dir and replay_writer:
+      timestamp = datetime.datetime.now().strftime('%Y-%m-%d-%H-%M-%S')
+      record_name = os.path.join(
+          root_dir, f'gs{global_step}_t{task}_{timestamp}_{task_idx}')
+      replay_writer.open(record_name)
+
+    # Collect demonstration episodes to condition on (run_meta_env.py:
+    # 126-176).
+    condition_data = []
+    if hasattr(env, 'get_demonstration') and hasattr(policy, 'adapt'):
+      for _ in range(num_demos):
+        obs = env.reset()
+        demo_policy = demo_policy_cls(env)
+        episode_data = []
+        while True:
+          action, debug = demo_policy.sample_action(obs, 0)
+          if action is None:
+            break
+          next_obs, rew, done, debug = env.step(action)
+          debug = dict(debug or {})
+          debug['is_demo'] = True
+          episode_data.append((obs, action, rew, next_obs, done, debug))
+          obs = next_obs
+        condition_data.append(episode_data)
+        if replay_writer and episode_to_transitions_fn:
+          replay_writer.write(
+              episode_to_transitions_fn(episode_data, is_demo=True))
+      policy.adapt(copy.copy(condition_data))
+    elif hasattr(env, 'task_data') and hasattr(policy, 'adapt'):
+      for episode_name, episode_data in env.task_data.items():
+        if str(episode_name).startswith('condition_ep'):
+          condition_data.append(episode_data)
+      policy.adapt(copy.copy(condition_data))
+
+    # Trial rounds with re-adaptation (run_meta_env.py:178-225).
+    for step_num in range(num_adaptations_per_task):
+      if step_num != 0 and hasattr(policy, 'adapt'):
+        policy.adapt(copy.copy(condition_data))
+      for ep in range(num_episodes_per_adaptation):
+        done, env_step, episode_reward, episode_data = False, 0, 0.0, []
+        policy.reset()
+        obs = env.reset()
+        explore_prob = (explore_schedule.value(global_step)
+                        if explore_schedule else 0.0)
+        while not done:
+          debug = {}
+          action, policy_debug = policy.sample_action(obs, explore_prob)
+          if policy_debug is not None:
+            debug.update(policy_debug)
+          if policy_debug and 'q_predicted' in policy_debug:
+            episode_q_values[env_step].append(policy_debug['q_predicted'])
+          new_obs, rew, done, env_debug = env.step(action)
+          debug.update(env_debug)
+          env_step += 1
+          episode_reward += rew
+          episode_data.append((obs, action, rew, new_obs, done, debug))
+          obs = new_obs
+          if done:
+            logging.info('Step %d episode %d reward: %f', step_num, ep,
+                         episode_reward)
+            task_step_rewards[task_idx][step_num].append(episode_reward)
+            if replay_writer and episode_to_transitions_fn:
+              replay_writer.write(episode_to_transitions_fn(episode_data))
+        condition_data.append(episode_data)
+
+    avg = float(np.mean(
+        task_step_rewards[task_idx][num_adaptations_per_task - 1]))
+    logging.info('Task %d avg reward: %f', task_idx, avg)
+    if replay_writer and record_name:
+      replay_writer.close()
+    if break_after_one_task:
+      break
+
+  if root_dir and write_summary:
+    summary_dir = os.path.join(root_dir, f'live_eval_{task}')
+    os.makedirs(summary_dir, exist_ok=True)
+    summary = {'tag': tag, 'global_step': int(global_step)}
+    for step_num in range(num_adaptations_per_task):
+      step_rewards = [
+          float(np.mean(task_step_rewards[t][step_num]))
+          for t in task_step_rewards
+      ]
+      summary[f'step_{step_num}_reward'] = float(np.mean(step_rewards))
+      if step_num > 0:
+        deltas = [
+            float(np.mean(np.asarray(task_step_rewards[t][step_num]) -
+                          np.asarray(task_step_rewards[t][step_num - 1])))
+            for t in task_step_rewards
+        ]
+        summary[f'step_{step_num}_improvement'] = float(np.mean(deltas))
+    with open(os.path.join(summary_dir, 'metrics.jsonl'), 'a') as f:
+      f.write(json.dumps(summary) + '\n')
+  return task_step_rewards

@@ -1,0 +1,19 @@
+"""Models: the functional T2R model protocol and base model families."""
+
+from tensor2robot_tpu.models.base import (
+    DEVICE_TYPE_CPU,
+    DEVICE_TYPE_GPU,
+    DEVICE_TYPE_TPU,
+    AbstractT2RModel,
+    FlaxModel,
+    ModelInterface,
+    merge_variables,
+    split_variables,
+)
+from tensor2robot_tpu.models.classification_model import (
+    ClassificationModel,
+    sigmoid_log_loss,
+)
+from tensor2robot_tpu.models.critic_model import CriticModel, log_loss
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.models import optimizers

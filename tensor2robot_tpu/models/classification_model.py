@@ -1,0 +1,76 @@
+"""Classification model base (reference: models/classification_model.py:48-242).
+
+The reference declares ``a_func(features) -> logits`` and wires sigmoid
+cross-entropy plus eval metrics. Here the subclass supplies a Flax module
+whose output dict contains ``'a_predicted'`` logits; loss and metrics are
+pure jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.models.base import FlaxModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct
+
+
+def sigmoid_log_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+  """Mean sigmoid cross entropy (tf.losses.log_loss on sigmoid outputs)."""
+  logits = logits.astype(jnp.float32)
+  labels = labels.astype(jnp.float32)
+  # Numerically stable BCE-with-logits.
+  per_element = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+      jnp.exp(-jnp.abs(logits)))
+  return jnp.mean(per_element)
+
+
+class ClassificationModel(FlaxModel):
+  """Binary classifier over spec-declared features.
+
+  Predictions contract (classification_model.py:154-201):
+  ``a_predicted`` (logits). Eval metrics: loss/accuracy/precision/recall/mse.
+  """
+
+  loss_fn = staticmethod(sigmoid_log_loss)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    logits = inference_outputs['a_predicted']
+    target = self._classification_target(labels)
+    loss = self.loss_fn(logits, target)
+    return loss, {}
+
+  def _classification_target(self, labels) -> jax.Array:
+    """The label tensor holding {0,1} targets; override for custom specs."""
+    if isinstance(labels, SpecStruct) or hasattr(labels, 'keys'):
+      keys = list(labels.keys())
+      if len(keys) != 1:
+        raise ValueError(
+            f'Override _classification_target for multi-label specs: {keys}')
+      return labels[keys[0]]
+    return labels
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    logits = inference_outputs['a_predicted'].astype(jnp.float32)
+    target = self._classification_target(labels).astype(jnp.float32)
+    prob = jax.nn.sigmoid(logits)
+    predicted = (prob > 0.5).astype(jnp.float32)
+    loss = self.loss_fn(logits, target)
+    tp = jnp.sum(predicted * target)
+    metrics = {
+        'loss': loss,
+        'accuracy': jnp.mean((predicted == target).astype(jnp.float32)),
+        'precision': tp / jnp.maximum(jnp.sum(predicted), 1.0),
+        'recall': tp / jnp.maximum(jnp.sum(target), 1.0),
+        'mean_squared_error': jnp.mean(jnp.square(prob - target)),
+    }
+    return metrics
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    outputs = SpecStruct()
+    outputs['a_predicted'] = jax.nn.sigmoid(
+        inference_outputs['a_predicted'].astype(jnp.float32))
+    return outputs

@@ -1,0 +1,151 @@
+"""Critic (Q-function) model base (reference: models/critic_model.py:48-243).
+
+Declares separate *state* and *action* specs; the network ``q_func`` maps
+(state, action) → ``q_predicted``; training regresses Monte-Carlo returns
+with log loss (QT-Opt style, rewards in [0, 1]).
+
+CEM contract (critic_model.py:111-141): at PREDICT time the policy
+evaluates one state against ``action_batch_size`` candidate actions. The
+reference tiles the state inside the graph to amortize session round
+trips; here the jitted predictor makes per-candidate batching free, and
+:meth:`pack_features` performs the numpy-side tiling so exported-model
+clients keep the same contract (predictors expand dims the same way,
+``predictors/exported_savedmodel_predictor.py:89-101``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.base import FlaxModel
+from tensor2robot_tpu.specs import SpecStruct, algebra
+
+
+def log_loss(predictions: jnp.ndarray, targets: jnp.ndarray,
+             epsilon: float = 1e-7) -> jnp.ndarray:
+  """tf.losses.log_loss semantics: binary CE on probabilities."""
+  predictions = jnp.clip(predictions.astype(jnp.float32), epsilon,
+                         1.0 - epsilon)
+  targets = targets.astype(jnp.float32)
+  return -jnp.mean(targets * jnp.log(predictions) +
+                   (1.0 - targets) * jnp.log(1.0 - predictions))
+
+
+def mean_squared_error(predictions: jnp.ndarray,
+                       targets: jnp.ndarray) -> jnp.ndarray:
+  return jnp.mean(
+      jnp.square(predictions.astype(jnp.float32) -
+                 targets.astype(jnp.float32)))
+
+
+class CriticModel(FlaxModel):
+  """Q(s, a) critic with split state/action specs.
+
+  ``loss_function(predictions, targets)`` defaults to MSE on Monte-Carlo
+  returns (critic_model.py:53-66); QT-Opt swaps in :func:`log_loss`.
+  """
+
+  def __init__(self,
+               action_batch_size: Optional[int] = None,
+               loss_function=mean_squared_error,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_batch_size = action_batch_size
+    self._loss_function = loss_function
+
+  @property
+  def action_batch_size(self) -> Optional[int]:
+    return self._action_batch_size
+
+  # ------------------------------------------------------------------ specs
+
+  @abc.abstractmethod
+  def get_state_specification(self) -> SpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_action_specification(self) -> SpecStruct:
+    ...
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    """state/... + action/... merged (critic_model.py:87-109)."""
+    del mode
+    spec = SpecStruct()
+    for key, value in algebra.flatten_spec_structure(
+        self.get_state_specification()).items():
+      spec[f'state/{key}'] = value
+    for key, value in algebra.flatten_spec_structure(
+        self.get_action_specification()).items():
+      spec[f'action/{key}'] = value
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = SpecStruct()
+    spec['reward'] = TensorSpec(shape=(1,), dtype=np.float32, name='reward')
+    return spec
+
+  # ------------------------------------------------------------- loss/eval
+
+  def q_predicted(self, inference_outputs) -> jnp.ndarray:
+    return inference_outputs['q_predicted']
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    q = self.q_predicted(inference_outputs)
+    reward = labels['reward'].astype(jnp.float32).reshape(q.shape)
+    loss = self._loss_function(q, reward)
+    return loss, {'q_mean': jnp.mean(q.astype(jnp.float32))}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    q = self.q_predicted(inference_outputs).astype(jnp.float32)
+    reward = labels['reward'].astype(jnp.float32).reshape(q.shape)
+    return {
+        'loss': self._loss_function(q, reward),
+        'q_mean': jnp.mean(q),
+        'td_abs_error': jnp.mean(jnp.abs(q - reward)),
+    }
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    outputs = SpecStruct()
+    outputs['q_predicted'] = self.q_predicted(inference_outputs)
+    return outputs
+
+  # ----------------------------------------------------------------- policy
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    """Packs one env state + a batch of candidate actions for CEM.
+
+    ``context`` carries the candidate actions (numpy [num_samples, adim]);
+    the state is tiled across the candidate batch.
+    """
+    del timestep
+    packed = SpecStruct()
+    state_spec = algebra.flatten_spec_structure(self.get_state_specification())
+    action_spec = algebra.flatten_spec_structure(
+        self.get_action_specification())
+    actions = context
+    if hasattr(actions, 'keys'):
+      action_items = {k: np.asarray(v) for k, v in actions.items()}
+    else:
+      keys = list(action_spec.keys())
+      if len(keys) != 1:
+        raise ValueError('Single-array actions need a single action spec.')
+      action_items = {keys[0]: np.asarray(actions)}
+    num_samples = next(iter(action_items.values())).shape[0]
+    state_items = (
+        {k: np.asarray(v) for k, v in state.items()}
+        if hasattr(state, 'keys') else
+        {list(state_spec.keys())[0]: np.asarray(state)})
+    for key in state_spec:
+      value = state_items[key]
+      tiled = np.broadcast_to(value, (num_samples,) + value.shape)
+      packed[f'state/{key}'] = tiled
+    for key in action_spec:
+      packed[f'action/{key}'] = action_items[key]
+    return packed

@@ -1,0 +1,43 @@
+"""Regression model base (reference: models/regression_model.py:50-172).
+
+Predictions contract: ``inference_output``; loss: mean squared error.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tensor2robot_tpu.models.base import FlaxModel
+from tensor2robot_tpu.specs import SpecStruct
+
+
+class RegressionModel(FlaxModel):
+  """Regression over spec-declared features → 'inference_output'."""
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    target = self._regression_target(labels).astype(jnp.float32)
+    loss = jnp.mean(jnp.square(prediction - target))
+    return loss, {}
+
+  def _regression_target(self, labels):
+    if hasattr(labels, 'keys'):
+      keys = list(labels.keys())
+      if len(keys) != 1:
+        raise ValueError(
+            f'Override _regression_target for multi-label specs: {keys}')
+      return labels[keys[0]]
+    return labels
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    target = self._regression_target(labels).astype(jnp.float32)
+    return {
+        'loss': jnp.mean(jnp.square(prediction - target)),
+        'mean_absolute_error': jnp.mean(jnp.abs(prediction - target)),
+    }
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    outputs = SpecStruct()
+    outputs['inference_output'] = inference_outputs['inference_output']
+    return outputs

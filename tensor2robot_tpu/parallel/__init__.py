@@ -1,0 +1,19 @@
+"""Parallelism: device mesh, sharding rules, multi-host init."""
+
+from tensor2robot_tpu.parallel.mesh import (
+    BATCH_AXES,
+    DATA_AXIS,
+    DEFAULT_AXES,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+    global_batch_size,
+    initialize_multihost,
+    replicated,
+    shard_batch,
+    single_device_mesh,
+    state_shardings_for,
+)

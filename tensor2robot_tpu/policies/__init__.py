@@ -1,0 +1,12 @@
+"""Policies: action selection over predictors (CEM, regression, explore)."""
+
+from tensor2robot_tpu.policies.policies import (
+    CEMPolicy,
+    LSTMCEMPolicy,
+    OUExploreRegressionPolicy,
+    PerEpisodeSwitchPolicy,
+    Policy,
+    RegressionPolicy,
+    ScheduledExplorationRegressionPolicy,
+    SequentialRegressionPolicy,
+)

@@ -1,0 +1,263 @@
+"""Policies: predictor-backed action selection for robot loops.
+
+Capability-equivalent of ``/root/reference/policies/policies.py:38-370``:
+the same class family (Policy / CEMPolicy / LSTMCEMPolicy / regression +
+exploration variants / PerEpisodeSwitchPolicy) with the same
+``SelectAction(state, context, timestep)`` and dql-compat
+``sample_action(obs, explore_prob)`` surface. All numpy — predictors own
+the device round trip, and with a jitted predictor CEM's action megabatch
+is a single device call per iteration.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.utils import cross_entropy
+
+
+class Policy(abc.ABC):
+  """Base policy (policies.py:38-108)."""
+
+  def __init__(self, predictor=None):
+    self._predictor = predictor
+
+  @abc.abstractmethod
+  def SelectAction(self, state, context, timestep):
+    """Action for the observed state; must not mutate state/context."""
+
+  def reset(self) -> None:
+    ...
+
+  def init_randomly(self) -> None:
+    if self._predictor is not None:
+      self._predictor.init_randomly()
+
+  def restore(self) -> None:
+    if self._predictor is not None:
+      self._predictor.restore()
+
+  @property
+  def global_step(self) -> int:
+    if self._predictor is not None:
+      return self._predictor.global_step
+    return 0
+
+  def sample_action(self, obs, explore_prob):
+    """dql_grasping run_env compatibility (policies.py:89-108)."""
+    del explore_prob
+    action = self.SelectAction(obs, None, None)
+    return action, None
+
+
+class CEMPolicy(Policy):
+  """CEM argmax over a critic's q_predicted (policies.py:111-190)."""
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               cem_iters: int = 3,
+               cem_samples: int = 64,
+               num_elites: int = 10,
+               pack_fn: Optional[Callable] = None,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self._action_size = action_size
+    self._cem_iters = cem_iters
+    self._cem_samples = cem_samples
+    self._num_elites = num_elites
+    self.sample_fn = self._default_sample_fn
+    self.pack_fn = pack_fn or self._default_pack_fn
+
+  def _default_sample_fn(self, mean, stddev):
+    return mean + stddev * np.random.standard_normal(
+        (self._cem_samples, self._action_size))
+
+  def _default_pack_fn(self, t2r_model, state, context, timestep, samples):
+    del context
+    return t2r_model.pack_features(state, samples, timestep)
+
+  def get_cem_action(self, objective_fn):
+    """CEM maximization; returns (best_action, debug) (policies.py:139-172)."""
+
+    def update_fn(params, elite_samples):
+      del params
+      return {
+          'mean': np.mean(elite_samples, axis=0),
+          'stddev': np.std(elite_samples, axis=0, ddof=1),
+      }
+
+    initial_params = {
+        'mean': np.zeros(self._action_size),
+        'stddev': np.ones(self._action_size),
+    }
+    samples, values, final_params = cross_entropy.cross_entropy_method(
+        self.sample_fn, objective_fn, update_fn, initial_params,
+        num_elites=self._num_elites, num_iterations=self._cem_iters)
+    idx = int(np.argmax(values))
+    debug = {
+        'q_predicted': values[idx],
+        'final_params': final_params,
+        'best_idx': idx,
+    }
+    return np.asarray(samples)[idx], debug
+
+  def SelectAction(self, state, context, timestep):
+
+    def objective_fn(samples):
+      np_inputs = self.pack_fn(self._t2r_model, state, context, timestep,
+                               samples)
+      return self._predictor.predict(np_inputs)['q_predicted']
+
+    action, _ = self.get_cem_action(objective_fn)
+    return action
+
+
+class LSTMCEMPolicy(CEMPolicy):
+  """CEM with cached critic LSTM hidden state (policies.py:193-224)."""
+
+  def __init__(self, hidden_state_size: int, **kwargs):
+    self._hidden_state_size = hidden_state_size
+    super().__init__(**kwargs)
+    self.reset()
+
+  def reset(self):
+    self._hidden_state = np.zeros((self._hidden_state_size,), np.float32)
+    self._hidden_state_batch = None
+
+  def SelectAction(self, state, context, timestep):
+
+    def objective_fn(samples):
+      np_inputs = self.pack_fn(self._t2r_model, state, self._hidden_state,
+                               timestep, samples)
+      predictions = self._predictor.predict(np_inputs)
+      self._hidden_state_batch = predictions['lstm_hidden_state']
+      return predictions['q_predicted']
+
+    action, debug = self.get_cem_action(objective_fn)
+    self._hidden_state = self._hidden_state_batch[debug['best_idx']]
+    return action
+
+
+class RegressionPolicy(Policy):
+  """Direct regression action (policies.py:227-242)."""
+
+  def __init__(self, t2r_model, **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+
+  def SelectAction(self, state, context, timestep):
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    action = self._predictor.predict(np_inputs)['inference_output']
+    return action[0]
+
+
+class SequentialRegressionPolicy(RegressionPolicy):
+  """Feeds the previous packed input back as context (policies.py:245-259)."""
+
+  def reset(self):
+    self._sequence_context = None
+
+  def SelectAction(self, state, context, timestep):
+    np_inputs = self._t2r_model.pack_features(
+        state, self._sequence_context, timestep)
+    self._sequence_context = np_inputs
+    action = self._predictor.predict(np_inputs)['inference_output']
+    return action[0]
+
+
+class OUExploreRegressionPolicy(Policy):
+  """Ornstein-Uhlenbeck exploration noise (policies.py:262-296)."""
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               theta: float = 0.2,
+               sigma: float = 0.15,
+               use_noise: bool = True,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self.theta, self.sigma, self.mu = theta, sigma, 0.0
+    self._action_size = action_size
+    self._x_t = np.zeros(action_size)
+    self._use_noise = use_noise
+
+  def ou_step(self):
+    dx_t = self.theta * (self.mu - self._x_t) + self.sigma * np.random.randn(
+        *self._x_t.shape)
+    self._x_t = self._x_t + dx_t
+    return self._x_t
+
+  def reset(self):
+    self._x_t = np.zeros(self._action_size)
+
+  def SelectAction(self, state, context, timestep):
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    action = self._predictor.predict(np_inputs)['inference_output']
+    noise = self.ou_step() if self._use_noise else 0.0
+    return action[0] + noise
+
+
+class ScheduledExplorationRegressionPolicy(Policy):
+  """Gaussian noise on a linear stddev schedule (policies.py:299-327)."""
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               stddev_0: float = 0.2,
+               slope: float = 0.0,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self._action_size = action_size
+    self._stddev_0 = stddev_0
+    self._slope = slope
+
+  def get_noise(self):
+    stddev = max(self._stddev_0 + self.global_step * self._slope, 0.0)
+    return stddev * np.random.randn(self._action_size)
+
+  def SelectAction(self, state, context, timestep):
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    action = self._predictor.predict(np_inputs)['inference_output']
+    return action[0] + self.get_noise()
+
+
+class PerEpisodeSwitchPolicy(Policy):
+  """Explore-vs-greedy chosen per episode (policies.py:330-370)."""
+
+  def __init__(self, explore_policy_class, greedy_policy_class,
+               explore_prob: float, **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._explore_policy = explore_policy_class()
+    self._greedy_policy = greedy_policy_class()
+    self._explore_prob = explore_prob
+    self._active_policy = self._greedy_policy
+
+  def reset(self):
+    self._explore_policy.reset()
+    self._greedy_policy.reset()
+    if np.random.random() < self._explore_prob:
+      self._active_policy = self._explore_policy
+    else:
+      self._active_policy = self._greedy_policy
+
+  def init_randomly(self):
+    self._explore_policy.init_randomly()
+    self._greedy_policy.init_randomly()
+
+  def restore(self):
+    self._explore_policy.restore()
+    self._greedy_policy.restore()
+
+  @property
+  def global_step(self):
+    return self._greedy_policy.global_step
+
+  def SelectAction(self, state, context, timestep):
+    return self._active_policy.SelectAction(state, context, timestep)

@@ -1,0 +1,7 @@
+"""Predictors: restore-and-infer objects backing policies."""
+
+from tensor2robot_tpu.predictors.predictors import (
+    AbstractPredictor,
+    CheckpointPredictor,
+    ExportedModelPredictor,
+)

@@ -1,0 +1,262 @@
+"""Predictors: restore-and-infer objects driving robot policies.
+
+Capability-equivalent of ``/root/reference/predictors/``:
+
+* :class:`AbstractPredictor` — the ABC surface policies rely on
+  (``abstract_predictor.py:32-88``).
+* :class:`CheckpointPredictor` — rebuilds the PREDICT path from a model
+  object + polls the trainer's Orbax checkpoints
+  (``checkpoint_predictor.py:39-212``).
+* :class:`ExportedModelPredictor` — polls a versioned export root, loads
+  the newest *valid* export (specs from ``assets.extra``), hot-reloads on
+  ``restore()`` (``exported_savedmodel_predictor.py:50-274``).
+
+Both jit the preprocess→forward→export-outputs chain once and reuse it
+across calls; CEM's action-batched queries become one device call.
+"""
+
+from __future__ import annotations
+
+import abc
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.export import exporters as exporters_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct, algebra
+from tensor2robot_tpu.specs import numpy_gen
+from tensor2robot_tpu.train import checkpoints as ckpt_lib
+from tensor2robot_tpu.train import train_state as ts_lib
+
+
+class AbstractPredictor(abc.ABC):
+  """The predictor surface policies consume (abstract_predictor.py:32-88)."""
+
+  @abc.abstractmethod
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    ...
+
+  @abc.abstractmethod
+  def get_feature_specification(self) -> SpecStruct:
+    ...
+
+  def get_label_specification(self) -> Optional[SpecStruct]:
+    return None
+
+  @abc.abstractmethod
+  def restore(self) -> bool:
+    """Loads the newest available weights; returns success."""
+
+  def init_randomly(self) -> None:
+    raise NotImplementedError
+
+  def close(self) -> None:
+    ...
+
+  def assert_is_loaded(self) -> None:
+    if not self.is_loaded:
+      raise ValueError('The predictor has not been restored yet.')
+
+  @property
+  @abc.abstractmethod
+  def is_loaded(self) -> bool:
+    ...
+
+  @property
+  def model_version(self) -> int:
+    return self.global_step
+
+  @property
+  @abc.abstractmethod
+  def global_step(self) -> int:
+    ...
+
+
+class _JitForward:
+  """Shared jitted PREDICT chain: preprocess → network → export outputs."""
+
+  def __init__(self, model):
+    self._model = model
+    preprocessor = model.preprocessor
+
+    def forward(variables, features):
+      features_p, _ = preprocessor.preprocess(
+          features, None, ModeKeys.PREDICT, None)
+      outputs, _ = model.inference_network_fn(
+          dict(variables), features_p, None, ModeKeys.PREDICT)
+      return dict(model.create_export_outputs_fn(features_p, outputs))
+
+    self._fn = jax.jit(forward)
+
+  def __call__(self, variables, features: Dict[str, np.ndarray]):
+    packed = SpecStruct(features)
+    outputs = self._fn(variables, packed)
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+
+def _expand_to_spec_rank(features: Dict[str, np.ndarray],
+                         spec: SpecStruct) -> Dict[str, np.ndarray]:
+  """Adds leading batch dims the caller omitted.
+
+  The dim-expansion contract of
+  ``exported_savedmodel_predictor.py:78-102``: a single example (or single
+  CEM sample) may be fed without its batch dim.
+  """
+  out = {}
+  for key, value in features.items():
+    value = np.asarray(value)
+    if key in spec:
+      expected_rank = len(spec[key].shape) + 1  # + batch
+      while value.ndim < expected_rank:
+        value = value[None]
+    out[key] = value
+  return out
+
+
+class CheckpointPredictor(AbstractPredictor):
+  """Model + trainer checkpoint dir → predictor (checkpoint_predictor.py).
+
+  ``restore()`` polls ``<model_dir>/checkpoints`` for the newest step and
+  loads it; ``init_randomly()`` supports collect-before-first-checkpoint.
+  """
+
+  def __init__(self,
+               t2r_model,
+               model_dir: str = '',
+               restore_timeout_secs: float = 0.0):
+    self._model = t2r_model
+    self._model_dir = model_dir
+    self._restore_timeout_secs = restore_timeout_secs
+    self._forward = _JitForward(t2r_model)
+    self._variables = None
+    self._global_step = -1
+    self._restored_step: Optional[int] = None
+    self._feature_spec = algebra.filter_required_flat_tensor_spec(
+        t2r_model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
+
+  def get_feature_specification(self) -> SpecStruct:
+    return self._feature_spec
+
+  def _init_state(self):
+    features = numpy_gen.make_random_numpy(self._feature_spec, batch_size=1)
+    features_p, _ = self._model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT, None)
+    optimizer = self._model.create_optimizer()
+    return ts_lib.create_train_state(
+        self._model, optimizer, jax.random.PRNGKey(0), features_p,
+        ModeKeys.PREDICT)
+
+  def init_randomly(self) -> None:
+    state = self._init_state()
+    self._variables = jax.device_get(dict(state.eval_variables))
+    self._global_step = 0
+
+  def restore(self) -> bool:
+    ckpt_dir = f'{self._model_dir}/checkpoints'
+    deadline = time.time() + self._restore_timeout_secs
+    while True:
+      step = ckpt_lib.latest_checkpoint_step(ckpt_dir)
+      if step is not None and step != self._restored_step:
+        break
+      if step is not None and step == self._restored_step:
+        return True  # nothing newer; still loaded
+      if time.time() >= deadline:
+        return False
+      time.sleep(1.0)
+    state = self._init_state()
+    with ckpt_lib.CheckpointManager(ckpt_dir, async_save=False) as manager:
+      restored = manager.restore(state, step=step)
+    if restored is None:
+      return False
+    self._variables = jax.device_get(dict(restored.eval_variables))
+    self._global_step = int(restored.step)
+    self._restored_step = step
+    return True
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    self.assert_is_loaded()
+    features = _expand_to_spec_rank(features, self._feature_spec)
+    return self._forward(self._variables, features)
+
+  @property
+  def is_loaded(self) -> bool:
+    return self._variables is not None
+
+  @property
+  def global_step(self) -> int:
+    return self._global_step
+
+
+class ExportedModelPredictor(AbstractPredictor):
+  """Polls a versioned export root (exported_savedmodel_predictor.py).
+
+  ``restore()`` scans for the newest *complete* export version, reads specs
+  + global_step from its assets, loads its serving variables, and rebuilds
+  the jitted forward (from the recorded model class unless a model object
+  is supplied). A busy-wait with ``timeout`` tolerates the trainer not
+  having exported yet (``:120-202``).
+  """
+
+  def __init__(self,
+               export_dir: str,
+               t2r_model=None,
+               timeout: float = 0.0,
+               model_kwargs: Optional[Dict[str, Any]] = None):
+    self._export_root = export_dir
+    self._model = t2r_model
+    self._model_kwargs = model_kwargs
+    self._timeout = timeout
+    self._forward: Optional[_JitForward] = None
+    self._variables = None
+    self._global_step = -1
+    self._feature_spec: Optional[SpecStruct] = None
+    self._loaded_dir: Optional[str] = None
+
+  def get_feature_specification(self) -> SpecStruct:
+    if self._feature_spec is None:
+      raise ValueError('restore() must succeed before specs are available.')
+    return self._feature_spec
+
+  def restore(self) -> bool:
+    deadline = time.time() + self._timeout
+    while True:
+      dirs = exporters_lib.valid_export_dirs(self._export_root)
+      if dirs:
+        newest = dirs[-1]
+        if newest != self._loaded_dir:
+          return self._load(newest)
+        return True
+      if time.time() >= deadline:
+        return False
+      time.sleep(1.0)
+
+  def _load(self, export_dir: str) -> bool:
+    from tensor2robot_tpu.specs import load_specs_from_export_dir
+
+    feature_spec, _, global_step = load_specs_from_export_dir(export_dir)
+    if self._model is None:
+      self._model = exporters_lib.load_model_from_export_dir(
+          export_dir, self._model_kwargs)
+    if self._forward is None:
+      self._forward = _JitForward(self._model)
+    self._variables = exporters_lib.load_state_from_export_dir(export_dir)
+    self._feature_spec = algebra.filter_required_flat_tensor_spec(feature_spec)
+    self._global_step = global_step
+    self._loaded_dir = export_dir
+    return True
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    self.assert_is_loaded()
+    features = _expand_to_spec_rank(features, self._feature_spec)
+    return self._forward(self._variables, features)
+
+  @property
+  def is_loaded(self) -> bool:
+    return self._variables is not None
+
+  @property
+  def global_step(self) -> int:
+    return self._global_step

@@ -1,0 +1,3 @@
+"""dql_grasping_lib: agent/env episode loop."""
+
+from tensor2robot_tpu.research.dql_grasping_lib.run_env import run_env

@@ -1,0 +1,110 @@
+"""Agent↔env episode loop with explore schedule and replay writing.
+
+Capability-equivalent of
+``/root/reference/research/dql_grasping_lib/run_env.py:80-240``. Gym and
+gymnasium step APIs are both supported (the reference's gym/tf_agents
+split); summaries become metric JSON lines under ``root_dir`` instead of
+TF summary protos.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import json
+import logging
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _gym_env_reset(env):
+  obs = env.reset()
+  if isinstance(obs, tuple) and len(obs) == 2:
+    obs = obs[0]  # gymnasium returns (obs, info)
+  return obs
+
+
+def _gym_env_step(env, action):
+  result = env.step(action)
+  if len(result) == 5:  # gymnasium: obs, reward, terminated, truncated, info
+    obs, reward, terminated, truncated, info = result
+    return obs, reward, bool(terminated or truncated), info
+  return result  # classic gym: obs, reward, done, info
+
+
+def run_env(env,
+            policy=None,
+            explore_schedule=None,
+            episode_to_transitions_fn: Optional[Callable] = None,
+            replay_writer=None,
+            root_dir: Optional[str] = None,
+            task: int = 0,
+            global_step: int = 0,
+            num_episodes: int = 100,
+            tag: str = 'collect'):
+  """Runs the policy for ``num_episodes`` episodes (run_env.py:80-240).
+
+  Returns the list of episode rewards (the reference logs them; returning
+  them makes testing direct).
+  """
+  episode_rewards = []
+  episode_q_values = collections.defaultdict(list)
+
+  record_prefix = None
+  if root_dir and replay_writer:
+    timestamp = datetime.datetime.now().strftime('%Y-%m-%d-%H-%M-%S')
+    record_prefix = os.path.join(
+        root_dir, f'policy_{tag}', f'gs{global_step}_t{task}_{timestamp}')
+  if replay_writer and record_prefix:
+    replay_writer.open(record_prefix)
+
+  for ep in range(num_episodes):
+    done, env_step, episode_reward, episode_data = False, 0, 0.0, []
+    policy.reset()
+    obs = _gym_env_reset(env)
+    if explore_schedule:
+      explore_prob = explore_schedule.value(global_step)
+    else:
+      explore_prob = 0.0
+    while not done:
+      action, policy_debug = policy.sample_action(obs, explore_prob)
+      if policy_debug and 'q' in policy_debug:
+        episode_q_values[env_step].append(policy_debug['q'])
+      new_obs, rew, done, env_debug = _gym_env_step(env, action)
+      env_step += 1
+      episode_reward += rew
+      episode_data.append((obs, action, rew, new_obs, done, env_debug))
+      obs = new_obs
+      if done:
+        logging.info('Episode %d reward: %f', ep, episode_reward)
+        episode_rewards.append(episode_reward)
+        if replay_writer and episode_to_transitions_fn:
+          transitions = episode_to_transitions_fn(episode_data)
+          replay_writer.write(transitions)
+    if episode_rewards and len(episode_rewards) % 10 == 0:
+      logging.info('Average %d collect episodes reward: %f',
+                   len(episode_rewards), float(np.mean(episode_rewards)))
+
+  logging.info('Closing environment.')
+  env.close()
+  if replay_writer and record_prefix:
+    replay_writer.close()
+
+  if root_dir and task == 0:
+    summary_dir = os.path.join(root_dir, f'live_eval_{task}')
+    os.makedirs(summary_dir, exist_ok=True)
+    summary = {
+        'tag': tag,
+        'global_step': int(global_step),
+        'episode_reward': float(np.mean(episode_rewards))
+        if episode_rewards else 0.0,
+        'q_values': {
+            str(step): float(np.mean(q))
+            for step, q in episode_q_values.items()
+        },
+    }
+    with open(os.path.join(summary_dir, 'metrics.jsonl'), 'a') as f:
+      f.write(json.dumps(summary) + '\n')
+  return episode_rewards

@@ -1,0 +1,8 @@
+"""Grasp2Vec: self-supervised grasping representation workload."""
+
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    Grasp2VecModel,
+    Grasp2VecPreprocessor,
+)
+from tensor2robot_tpu.research.grasp2vec.networks import Embedding
+from tensor2robot_tpu.research.grasp2vec import losses, visualization

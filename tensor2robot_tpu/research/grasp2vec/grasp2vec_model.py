@@ -1,0 +1,213 @@
+"""Grasp2Vec model: arithmetic-consistent scene/goal embeddings.
+
+Capability-equivalent of
+``/root/reference/research/grasp2vec/grasp2vec_model.py:49-245``:
+pregrasp/postgrasp share the scene encoder (one concatenated batch), the
+goal image gets its own encoder, and training enforces
+``pregrasp - postgrasp ≈ goal`` with N-pairs (or triplet) loss.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.base import AbstractT2RModel, merge_variables
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors.base import SpecTransformationPreprocessor
+from tensor2robot_tpu.research.grasp2vec import losses, networks
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+RAW_SHAPE = (512, 640, 3)
+
+
+def maybe_crop_images(rng, images, crop, mode):
+  """Random (train) / center (eval) crop window per the crop spec.
+
+  Crop spec mirrors grasp2vec_model.py:49-78:
+  (min_offset_height, max_offset_height, target_height,
+   min_offset_width, max_offset_width, target_width).
+  """
+  (min_oh, max_oh, target_h, min_ow, max_ow, target_w) = crop
+  if mode == ModeKeys.TRAIN and rng is not None:
+    oh_rng, ow_rng = jax.random.split(rng)
+    oh = jax.random.randint(oh_rng, (), min_oh, max(max_oh, min_oh + 1))
+    ow = jax.random.randint(ow_rng, (), min_ow, max(max_ow, min_ow + 1))
+  else:
+    oh = (min_oh + max_oh) // 2
+    ow = (min_ow + max_ow) // 2
+  return [
+      jax.lax.dynamic_slice(
+          img, (0, oh, ow, 0),
+          (img.shape[0], target_h, target_w, img.shape[3]))
+      for img in images
+  ]
+
+
+class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
+  """512×640 uint8 JPEGs → cropped float32 + random flips
+  (grasp2vec_model.py:81-139)."""
+
+  IMAGE_KEYS = ('pregrasp_image', 'postgrasp_image', 'goal_image')
+
+  def __init__(self,
+               scene_crop=(0, 40, 472, 0, 168, 472),
+               goal_crop=(0, 40, 472, 0, 168, 472),
+               **kwargs):
+    self._scene_crop = scene_crop
+    self._goal_crop = goal_crop
+    super().__init__(**kwargs)
+
+  def _transform_in_feature_specification(self, spec_struct, mode):
+    for name in self.IMAGE_KEYS:
+      self.update_spec(
+          spec_struct, name, shape=RAW_SHAPE, dtype=np.uint8,
+          data_format='JPEG')
+    return spec_struct
+
+  def _preprocess_fn(self, features, labels, mode, rng):
+    rngs = (jax.random.split(rng, 3) if rng is not None else [None] * 3)
+    scene = maybe_crop_images(
+        rngs[0],
+        [features['pregrasp_image'], features['postgrasp_image']],
+        self._scene_crop, mode)
+    features['pregrasp_image'], features['postgrasp_image'] = scene
+    features['goal_image'] = maybe_crop_images(
+        rngs[1], [features['goal_image']], self._goal_crop, mode)[0]
+    flip_rng = rngs[2]
+    for i, name in enumerate(self.IMAGE_KEYS):
+      image = features[name].astype(jnp.float32) / 255.0
+      if mode == ModeKeys.TRAIN and flip_rng is not None:
+        lr_rng, ud_rng = jax.random.split(jax.random.fold_in(flip_rng, i))
+        flip_lr = jax.random.bernoulli(lr_rng)
+        flip_ud = jax.random.bernoulli(ud_rng)
+        image = jnp.where(flip_lr, image[:, :, ::-1], image)
+        image = jnp.where(flip_ud, image[:, ::-1], image)
+      features[name] = image
+    return features, labels
+
+
+class Grasp2VecModel(AbstractT2RModel):
+  """Embedding-arithmetic model (grasp2vec_model.py:141-245)."""
+
+  def __init__(self,
+               scene_size: Tuple[int, int] = (472, 472),
+               goal_size: Tuple[int, int] = (472, 472),
+               embedding_loss_fn: Callable = losses.npairs_loss,
+               resnet_size: int = 50,
+               **kwargs):
+    self._scene_size = tuple(scene_size)
+    self._goal_size = tuple(goal_size)
+    self._embedding_loss_fn = embedding_loss_fn
+    self._resnet_size = resnet_size
+    super().__init__(**kwargs)
+
+  @property
+  def default_preprocessor_cls(self):
+    return Grasp2VecPreprocessor
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['pregrasp_image'] = TensorSpec(
+        shape=self._scene_size + (3,), dtype=np.float32, name='image',
+        data_format='JPEG')
+    spec['postgrasp_image'] = TensorSpec(
+        shape=self._scene_size + (3,), dtype=np.float32,
+        name='postgrasp_image', data_format='JPEG')
+    spec['goal_image'] = TensorSpec(
+        shape=self._goal_size + (3,), dtype=np.float32, name='present_image',
+        data_format='JPEG')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    return SpecStruct()  # unsupervised
+
+  def _modules(self):
+    return (networks.Embedding(resnet_size=self._resnet_size),
+            networks.Embedding(resnet_size=self._resnet_size))
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    scene_module, goal_module = self._modules()
+    scene_rng, goal_rng = jax.random.split(rng)
+    scene_images = jnp.concatenate(
+        [features['pregrasp_image'], features['postgrasp_image']], axis=0)
+    scene_vars = scene_module.init(
+        {'params': scene_rng}, scene_images.astype(jnp.float32))
+    goal_vars = goal_module.init(
+        {'params': goal_rng}, features['goal_image'].astype(jnp.float32))
+    variables = {}
+    for col in set(scene_vars) | set(goal_vars):
+      variables[col] = {
+          'scene': scene_vars.get(col, {}),
+          'goal': goal_vars.get(col, {}),
+      }
+    return variables
+
+  def _split_cols(self, variables, branch):
+    return {col: tree[branch] for col, tree in variables.items()}
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    scene_module, goal_module = self._modules()
+    train = mode == ModeKeys.TRAIN
+    scene_images = jnp.concatenate(
+        [features['pregrasp_image'], features['postgrasp_image']],
+        axis=0).astype(jnp.float32)
+    goal_images = features['goal_image'].astype(jnp.float32)
+
+    scene_vars = self._split_cols(variables, 'scene')
+    goal_vars = self._split_cols(variables, 'goal')
+    mutable = [k for k in variables if k != 'params'] if train else False
+
+    if mutable:
+      (scene_v, scene_s), scene_mut = scene_module.apply(
+          scene_vars, scene_images, train=True, mutable=mutable)
+      (goal_v, goal_s), goal_mut = goal_module.apply(
+          goal_vars, goal_images, train=True, mutable=mutable)
+      new_variables = dict(variables)
+      for col in mutable:
+        new_variables[col] = {
+            'scene': scene_mut.get(col, {}),
+            'goal': goal_mut.get(col, {}),
+        }
+    else:
+      scene_v, scene_s = scene_module.apply(scene_vars, scene_images,
+                                            train=False)
+      goal_v, goal_s = goal_module.apply(goal_vars, goal_images, train=False)
+      new_variables = variables
+
+    pre_v, post_v = jnp.split(scene_v, 2, axis=0)
+    pre_s, post_s = jnp.split(scene_s, 2, axis=0)
+    outputs = SpecStruct()
+    outputs['pre_vector'] = pre_v
+    outputs['post_vector'] = post_v
+    outputs['pre_spatial'] = pre_s
+    outputs['post_spatial'] = post_s
+    outputs['goal_vector'] = goal_v
+    outputs['goal_spatial'] = goal_s
+    return outputs, new_variables
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    embed_loss = self._embedding_loss_fn(
+        inference_outputs['pre_vector'].astype(jnp.float32),
+        inference_outputs['goal_vector'].astype(jnp.float32),
+        inference_outputs['post_vector'].astype(jnp.float32))
+    if isinstance(embed_loss, tuple):  # triplet returns (loss, pairs, labels)
+      embed_loss = embed_loss[0]
+    return embed_loss, {'embed_loss': embed_loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(features, labels, inference_outputs,
+                                        ModeKeys.EVAL)
+    metrics = dict(scalars)
+    metrics['loss'] = loss
+    return metrics

@@ -1,0 +1,133 @@
+"""Grasp2Vec embedding losses, pure jnp.
+
+Capability-equivalent of ``/root/reference/research/grasp2vec/losses.py``:
+N-pairs (both directions), semi-hard triplet, L2/cosine arithmetic
+consistency (``pregrasp - postgrasp ≈ goal``), and keypoint quadrant
+accuracy. tf-slim's metric-learning losses are re-derived in jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _npairs_loss(labels: jnp.ndarray, embeddings_anchor: jnp.ndarray,
+                 embeddings_positive: jnp.ndarray) -> jnp.ndarray:
+  """tf.contrib npairs_loss: softmax CE over anchor·positiveᵀ similarities."""
+  logits = embeddings_anchor @ embeddings_positive.T
+  log_probs = jax.nn.log_softmax(logits, axis=1)
+  one_hot = jax.nn.one_hot(labels, logits.shape[1], dtype=log_probs.dtype)
+  return -jnp.mean(jnp.sum(one_hot * log_probs, axis=1))
+
+
+def npairs_loss(pregrasp_embedding: jnp.ndarray,
+                goal_embedding: jnp.ndarray,
+                postgrasp_embedding: jnp.ndarray,
+                non_negativity_constraint: bool = False) -> jnp.ndarray:
+  """Bidirectional N-pairs on (pre-post, goal) (losses.py:165-190)."""
+  pair_a = pregrasp_embedding - postgrasp_embedding
+  if non_negativity_constraint:
+    pair_a = jax.nn.relu(pair_a)
+  pair_b = goal_embedding
+  labels = jnp.arange(pair_a.shape[0])
+  return (_npairs_loss(labels, pair_a, pair_b) +
+          _npairs_loss(labels, pair_b, pair_a))
+
+
+def l2_arithmetic_loss(pregrasp_embedding, goal_embedding,
+                       postgrasp_embedding, mask) -> jnp.ndarray:
+  """Masked mean ||pre - goal - post||² (losses.py:34-57)."""
+  raw = pregrasp_embedding - goal_embedding - postgrasp_embedding
+  distances = jnp.sum(jnp.square(raw), axis=1)
+  mask = mask.astype(jnp.float32).reshape(-1)
+  total = jnp.sum(mask)
+  return jnp.where(total > 0, jnp.sum(distances * mask) /
+                   jnp.maximum(total, 1.0), 0.0)
+
+
+def cosine_arithmetic_loss(pregrasp_embedding, goal_embedding,
+                           postgrasp_embedding, mask) -> jnp.ndarray:
+  """Masked mean cosine distance of (pre-post) vs goal (losses.py:85-113)."""
+
+  def normalize(x):
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+
+  pair_a = normalize(pregrasp_embedding - postgrasp_embedding)
+  pair_b = normalize(goal_embedding)
+  distances = 1.0 - jnp.sum(pair_a * pair_b, axis=1)
+  mask = mask.astype(jnp.float32).reshape(-1)
+  total = jnp.sum(mask)
+  return jnp.where(total > 0, jnp.sum(distances * mask) /
+                   jnp.maximum(total, 1.0), 0.0)
+
+
+def triplet_semihard_loss(labels: jnp.ndarray, embeddings: jnp.ndarray,
+                          margin: float = 1.0) -> jnp.ndarray:
+  """Semi-hard mining triplet loss (tf-slim triplet_semihard_loss)."""
+  # Pairwise squared distances.
+  dots = embeddings @ embeddings.T
+  sq = jnp.diag(dots)
+  pdist = jnp.maximum(sq[:, None] - 2 * dots + sq[None, :], 0.0)
+  adjacency = labels[:, None] == labels[None, :]
+  adjacency_not = ~adjacency
+  batch = embeddings.shape[0]
+
+  # For each anchor-positive pair (i, j), find the semi-hard negative:
+  # the closest negative farther than d(i, j); fallback to the largest.
+  inf = jnp.asarray(1e9, pdist.dtype)
+  neg_mask = adjacency_not[:, None, :]  # [i, j, k]: k negative of i
+  d_ij = pdist[:, :, None]
+  d_ik = pdist[:, None, :].repeat(batch, axis=1)
+  semihard = neg_mask & (d_ik > d_ij)
+  semihard_min = jnp.min(jnp.where(semihard, d_ik, inf), axis=2)
+  hardest_max = jnp.max(jnp.where(neg_mask, d_ik, -inf), axis=2)
+  neg_dist = jnp.where(semihard_min < inf, semihard_min, hardest_max)
+
+  loss_mat = jnp.maximum(pdist + margin - neg_dist, 0.0)
+  pos_mask = adjacency & ~jnp.eye(batch, dtype=bool)
+  num_pos = jnp.maximum(jnp.sum(pos_mask), 1.0)
+  return jnp.sum(jnp.where(pos_mask, loss_mat, 0.0)) / num_pos
+
+
+def triplet_loss(pregrasp_embedding, goal_embedding,
+                 postgrasp_embedding) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                               jnp.ndarray]:
+  """Semi-hard triplet on normalized pairs (losses.py:59-83)."""
+
+  def normalize(x):
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+
+  pair_a = normalize(pregrasp_embedding - postgrasp_embedding)
+  pair_b = normalize(goal_embedding)
+  labels = jnp.arange(pair_a.shape[0])
+  labels = jnp.concatenate([labels, labels])
+  pairs = jnp.concatenate([pair_a, pair_b], axis=0)
+  loss = triplet_semihard_loss(labels, pairs, margin=3.0)
+  return loss, pairs, labels
+
+
+def keypoint_accuracy(keypoints: jnp.ndarray,
+                      labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Quadrant accuracy of spatial-softmax keypoints (losses.py:117-146)."""
+  keypoints = keypoints.reshape((-1, 2))
+  quadrant_centers = jnp.asarray(
+      [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]], jnp.float32)
+  logits = keypoints @ quadrant_centers.T
+  predictions = jnp.argmax(logits, axis=1)
+  labels = labels.reshape(-1).astype(jnp.int32)
+  correct = jnp.mean((predictions == labels).astype(jnp.float32))
+  labels_onehot = jax.nn.one_hot(labels, 4, dtype=jnp.float32)
+  per_elem = (jnp.maximum(logits, 0) - logits * labels_onehot +
+              jnp.log1p(jnp.exp(-jnp.abs(logits))))
+  return correct, jnp.mean(per_elem)
+
+
+# Reference-name aliases.
+NPairsLoss = npairs_loss
+TripletLoss = triplet_loss
+L2ArithmeticLoss = l2_arithmetic_loss
+CosineArithmeticLoss = cosine_arithmetic_loss
+KeypointAccuracy = keypoint_accuracy

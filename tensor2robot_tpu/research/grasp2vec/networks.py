@@ -1,0 +1,33 @@
+"""Grasp2Vec embedding network.
+
+Capability-equivalent of
+``/root/reference/research/grasp2vec/networks.py:27-45`` +
+``resnet.py:338-563`` (their private ResNet-50 copy): a ResNet-50 trunk
+producing *spatial* feature maps, ReLU'd, mean-pooled into the embedding
+vector. Reuses the framework ResNet instead of a private copy.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.resnet import ResNet
+
+
+class Embedding(nn.Module):
+  """Scene/goal embedding: (mean-pooled vector, spatial map)."""
+
+  resnet_size: int = 50
+
+  @nn.compact
+  def __call__(self, image: jnp.ndarray,
+               train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    _, endpoints = ResNet(
+        resnet_size=self.resnet_size, num_classes=None, name='resnet')(
+            image, train=train)
+    spatial = nn.relu(endpoints['pre_final_pool'])
+    summed = jnp.mean(spatial, axis=(1, 2))
+    return summed, spatial

@@ -1,0 +1,49 @@
+"""Grasp2Vec heatmap localization utilities.
+
+Capability-equivalent of
+``/root/reference/research/grasp2vec/visualization.py`` — in particular
+``_GetSoftMaxResponse``: correlate a goal embedding against a spatial
+feature map and return the soft-argmax response (the instance-localization
+mechanism evaluated in the paper).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+
+
+def get_softmax_response(goal_embedding: jnp.ndarray,
+                         scene_spatial: jnp.ndarray
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Correlation heatmap + its max response (visualization.py:246-273).
+
+  Args:
+    goal_embedding: [B, C] goal vectors.
+    scene_spatial: [B, H, W, C] scene feature maps.
+
+  Returns:
+    (heatmap [B, H, W, 1] softmaxed over pixels, response [B] max logit).
+  """
+  heatmap_logits = jnp.einsum('bhwc,bc->bhw', scene_spatial, goal_embedding)
+  batch, h, w = heatmap_logits.shape
+  flat = heatmap_logits.reshape(batch, h * w)
+  softmax = jax.nn.softmax(flat, axis=-1).reshape(batch, h, w, 1)
+  response = jnp.max(flat, axis=-1)
+  return softmax, response
+
+
+def heatmap_keypoints(goal_embedding: jnp.ndarray,
+                      scene_spatial: jnp.ndarray) -> jnp.ndarray:
+  """Expected (x, y) of the correlation heatmap via spatial softmax."""
+  heatmap = jnp.einsum('bhwc,bc->bhw', scene_spatial, goal_embedding)
+  points, _ = spatial_softmax(heatmap[..., None])
+  return points
+
+
+# Reference-name alias.
+_GetSoftMaxResponse = get_softmax_response

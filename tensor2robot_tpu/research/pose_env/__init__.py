@@ -1,0 +1,16 @@
+"""Pose env workload: toy pose-regression env + models."""
+
+from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
+    episode_to_transitions_pose_toy,
+)
+from tensor2robot_tpu.research.pose_env.pose_env import (
+    PoseEnvRandomPolicy,
+    PoseToyEnv,
+)
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvContinuousMCModel,
+    PoseEnvRegressionModel,
+)
+from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+    PoseEnvRegressionModelMAML,
+)

@@ -1,0 +1,49 @@
+"""Pose-env episode → serialized tf.Example transitions.
+
+Capability-equivalent of
+``/root/reference/research/pose_env/episode_to_transitions.py:32-70``.
+Record schema matches the reference's checked-in dataset exactly:
+``state/image`` (JPEG bytes), ``pose`` [2], ``reward`` [1],
+``target_pose`` [2] — verified against
+``/root/reference/test_data/pose_env_test_data.tfrecord``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.utils import image as image_lib
+
+
+def _example(features: dict) -> bytes:
+  """Builds a serialized tf.Example from {key: feature-value}."""
+  import tensorflow as tf
+
+  feature_map = {}
+  for key, value in features.items():
+    if isinstance(value, bytes):
+      feature_map[key] = tf.train.Feature(
+          bytes_list=tf.train.BytesList(value=[value]))
+    else:
+      feature_map[key] = tf.train.Feature(
+          float_list=tf.train.FloatList(
+              value=np.asarray(value, np.float32).flatten().tolist()))
+  example = tf.train.Example(
+      features=tf.train.Features(feature=feature_map))
+  return example.SerializeToString()
+
+
+def episode_to_transitions_pose_toy(episode_data: Sequence[Tuple]
+                                    ) -> List[bytes]:
+  """Supervised regression records; obs_tp1/done dropped (reference :32-70)."""
+  transitions = []
+  for (obs_t, action, reward, _, _, debug) in episode_data:
+    transitions.append(_example({
+        'state/image': image_lib.numpy_to_image_string(obs_t),
+        'pose': np.asarray(action).flatten(),
+        'reward': [float(reward)],
+        'target_pose': debug['target_pose'],
+    }))
+  return transitions

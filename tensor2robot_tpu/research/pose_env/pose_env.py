@@ -1,0 +1,141 @@
+"""Pose prediction toy env: predict object pose from a rendered image.
+
+Capability-equivalent of ``/root/reference/research/pose_env/pose_env.py:
+56-200`` (``PoseToyEnv``). The reference renders a duck with pybullet;
+pybullet is not available in this environment, so the renderer is a small
+analytic rasterizer: the object is an oriented, shaded blob projected with
+the episode's randomized camera (yaw/pitch), on a textured table plane.
+The learning problem is identical — regress the object's (x, y) pose from
+a 64×64 RGB image whose camera pose varies per task — and the observation/
+action/reward contracts match:
+
+* observation: uint8 [64, 64, 3] image
+* action: predicted (x, y) pose
+* reward: ``-||target_pose_xy - action||_2``; episodes are single-step
+* ``hidden_drift`` for meta-learning: rendered pose differs from the true
+  pose by a per-task hidden offset (pose_env.py:75-120).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class PoseEnvRandomPolicy:
+  """Random pose policy for dataset generation (pose_env.py:40-52)."""
+
+  def reset(self):
+    pass
+
+  @property
+  def global_step(self):
+    return 0
+
+  def sample_action(self, obs, explore_prob):
+    del obs, explore_prob
+    return np.random.uniform(low=-1.0, high=1.0, size=2), None
+
+
+def _rotation2d(angle: float) -> np.ndarray:
+  c, s = np.cos(angle), np.sin(angle)
+  return np.array([[c, -s], [s, c]], np.float32)
+
+
+class PoseToyEnv:
+  """Gym-style env: image observation → pose action → distance reward."""
+
+  def __init__(self,
+               render_mode: str = 'DIRECT',
+               hidden_drift: bool = False,
+               urdf_root: str = '',
+               seed: Optional[int] = None):
+    del render_mode, urdf_root  # no GUI / assets in the analytic renderer
+    self._width, self._height = 64, 64
+    self._hidden_drift = hidden_drift
+    self._hidden_drift_xyz = None
+    self._rng = np.random.RandomState(seed)
+    self.reset_task()
+
+  # ----------------------------------------------------------------- tasks
+
+  def reset_task(self) -> None:
+    """New camera + (optionally) new hidden drift (pose_env.py:114-120)."""
+    self._reset_camera()
+    if self._hidden_drift:
+      drift = self._rng.uniform(low=-0.3, high=0.3, size=3)
+      drift[2] = 0.0
+      self._hidden_drift_xyz = drift
+    self.set_new_pose()
+
+  def set_new_pose(self) -> None:
+    self._target_pose = self._sample_pose()
+    self._rendered_pose = self._target_pose.copy()
+    if self._hidden_drift:
+      self._target_pose = self._target_pose + self._hidden_drift_xyz
+
+  def _sample_pose(self) -> np.ndarray:
+    x = self._rng.uniform(low=-0.7, high=0.7)
+    y = self._rng.uniform(low=-0.4, high=0.4)
+    angle = self._rng.uniform(low=-np.pi, high=np.pi)
+    return np.array([x, y, angle], np.float32)
+
+  def _reset_camera(self) -> None:
+    self._camera_yaw = self._rng.uniform(-np.pi, np.pi)
+    self._camera_pitch = np.deg2rad(-30.0 + self._rng.uniform(-10, 10))
+
+  # ------------------------------------------------------------- rendering
+
+  def _get_image(self) -> np.ndarray:
+    """Rasterizes the scene: table plane + oriented object blob."""
+    h, w = self._height, self._width
+    # Pixel grid in normalized device coords.
+    ys, xs = np.meshgrid(
+        np.linspace(-1.0, 1.0, h), np.linspace(-1.0, 1.0, w), indexing='ij')
+    # World→camera: rotate by yaw, foreshorten y by pitch.
+    x, y, angle = self._rendered_pose
+    cam = _rotation2d(self._camera_yaw) @ np.array([x, y], np.float32)
+    foreshorten = np.cos(self._camera_pitch)
+    center = np.array([cam[0], cam[1] * foreshorten], np.float32)
+    # Object: oriented anisotropic gaussian blob ("duck" body + head dot).
+    obj_angle = angle + self._camera_yaw
+    rot = _rotation2d(-obj_angle)
+    rel = np.stack([xs - center[0], ys - center[1]], axis=-1) @ rot.T
+    body = np.exp(-(rel[..., 0]**2 / 0.02 + rel[..., 1]**2 / 0.008))
+    head_offset = rot.T @ np.array([0.16, 0.0], np.float32)
+    head = np.exp(
+        -((xs - center[0] - head_offset[0])**2 +
+          (ys - center[1] - head_offset[1])**2) / 0.004)
+    # Table: subtle checkerboard so the camera pose is observable.
+    checker = (np.floor((xs + 2) * 4) + np.floor(
+        (ys + 2) * 4)) % 2
+    image = np.zeros((h, w, 3), np.float32)
+    image[..., 0] = 0.35 + 0.08 * checker
+    image[..., 1] = 0.30 + 0.08 * checker
+    image[..., 2] = 0.25 + 0.05 * checker
+    # Yellow-ish duck.
+    duck = np.clip(body + head, 0.0, 1.0)
+    image[..., 0] = image[..., 0] * (1 - duck) + duck * 0.9
+    image[..., 1] = image[..., 1] * (1 - duck) + duck * 0.8
+    image[..., 2] = image[..., 2] * (1 - duck) + duck * 0.1
+    return (image * 255).astype(np.uint8)
+
+  def get_observation(self) -> np.ndarray:
+    return self._get_image()
+
+  # ------------------------------------------------------------- gym API
+
+  def reset(self) -> np.ndarray:
+    return self.get_observation()
+
+  def step(self, action) -> Tuple[np.ndarray, float, bool, dict]:
+    reward = -np.linalg.norm(
+        np.asarray(action) - self._target_pose[:2]).astype(np.float32)
+    done = True
+    debug = {'target_pose': self._target_pose[:2].astype(np.float32)}
+    observation = self.get_observation()
+    return observation, float(reward), done, debug
+
+  def close(self) -> None:
+    pass

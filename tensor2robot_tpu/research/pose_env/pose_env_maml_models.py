@@ -1,0 +1,71 @@
+"""Pose-env MAML regression model.
+
+Capability-equivalent of
+``/root/reference/research/pose_env/pose_env_maml_models.py:33-110``:
+``MAMLModel`` over ``PoseEnvRegressionModel`` with the policy-side
+``pack_features`` that stuffs dummy condition episodes (reward 0 → no
+inner gradient) until real trials are available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tensor2robot_tpu.meta_learning import maml_model
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct
+
+
+class PoseEnvRegressionModelMAML(maml_model.MAMLModel):
+  """MAML regression for the duck pose task."""
+
+  def _make_dummy_labels(self) -> SpecStruct:
+    label_spec = self._base_model.get_label_specification(ModeKeys.TRAIN)
+    labels = SpecStruct()
+    labels['reward'] = np.zeros(
+        tuple(label_spec['reward'].shape), np.float32)
+    labels['target_pose'] = np.zeros(
+        tuple(label_spec['target_pose'].shape), np.float32)
+    return labels
+
+  def select_inference_output(self, predictions: SpecStruct) -> SpecStruct:
+    """Adds top-level (condition_/inference_)output keys
+    (pose_env_maml_models.py:47-55)."""
+    predictions['condition_output'] = predictions[
+        'full_condition_output/output_0/inference_output']
+    predictions['inference_output'] = predictions[
+        'full_inference_output/inference_output']
+    return predictions
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    return self.select_inference_output(inference_outputs)
+
+  def pack_features(self, state, prev_episode_data, timestep) -> SpecStruct:
+    """Packs obs + conditioning episode into MetaExample features
+    (pose_env_maml_models.py:56-110)."""
+    del timestep
+    meta_features = SpecStruct()
+    meta_features['inference/features/state/image/0'] = np.asarray(state)
+
+    def pack_condition_features(transition, idx, dummy_values=False):
+      obs, action, reward = transition[0], transition[1], transition[2]
+      reward = np.asarray([2.0 * float(np.asarray(reward).flatten()[0]) - 1.0])
+      if dummy_values:
+        reward = np.asarray([0.0])
+      meta_features[f'condition/features/state/image/{idx}'] = np.asarray(obs)
+      meta_features[f'condition/labels/target_pose/{idx}'] = np.asarray(
+          action, np.float32)
+      meta_features[f'condition/labels/reward/{idx}'] = reward.astype(
+          np.float32)
+
+    if prev_episode_data:
+      pack_condition_features(prev_episode_data[0][0], 0)
+    else:
+      dummy_labels = self._make_dummy_labels()
+      dummy_transition = (np.asarray(state), dummy_labels['target_pose'],
+                          dummy_labels['reward'])
+      pack_condition_features(dummy_transition, 0, dummy_values=True)
+    out = SpecStruct()
+    for key, value in meta_features.items():
+      out[key] = np.expand_dims(value, 0)
+    return out

@@ -1,0 +1,209 @@
+"""Pose-env models: vision→pose regression + continuous MC critic.
+
+Capability-equivalent of
+``/root/reference/research/pose_env/pose_env_models.py:40-330``:
+
+* :class:`PoseEnvRegressionModel` — conv tower + spatial softmax →
+  pose MLP; MSE weighted by reward; specs declare the uint8-JPEG
+  on-disk contract via the preprocessor.
+* :class:`PoseEnvContinuousMCModel` — critic over (image, pose action);
+  action embedding broadcast-added to conv features (the CEM megabatch
+  tiling trick becomes plain broadcasting in JAX).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.models import critic_model, regression_model
+from tensor2robot_tpu.preprocessors.base import AbstractPreprocessor
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
+
+IMAGE_SHAPE = (64, 64, 3)
+
+
+class _Uint8ToFloatPreprocessor(AbstractPreprocessor):
+  """uint8 images on disk → float32 [0,1] on device.
+
+  The role of ``DefaultPoseEnvRegressionPreprocessor`` /
+  ``DefaultPoseEnvContinuousPreprocessor`` (pose_env_models.py:44-92,
+  185-233): in-spec re-types the image to uint8+JPEG, the transform
+  scales to [0, 1] (tf.image.convert_image_dtype semantics).
+  """
+
+  IMAGE_KEYS = ('state/image',)
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    spec = algebra.flatten_spec_structure(
+        self._model_feature_specification_fn(mode)).copy()
+    for key in self.IMAGE_KEYS:
+      if key in spec:
+        spec[key] = TensorSpec.from_spec(
+            spec[key], dtype=np.uint8, data_format='JPEG')
+    return spec
+
+  def get_in_label_specification(self, mode: str):
+    return self.model_label_specification(mode)
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode: str):
+    return self.model_label_specification(mode)
+
+  def _preprocess_fn(self, features, labels, mode, rng):
+    del mode, rng
+    for key in self.IMAGE_KEYS:
+      if key in features:
+        features[key] = features[key].astype(jnp.float32) / 255.0
+    return features, labels
+
+
+class _RegressionNet(nn.Module):
+  """Vision tower + pose MLP (pose_env_models.py:269-320 a_func)."""
+
+  action_size: int = 2
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    image = features['state/image'].astype(jnp.float32)
+    feature_points, _ = vision_layers.ImagesToFeaturesModel(
+        name='state_features')(image, train=train)
+    estimated_pose, _ = vision_layers.ImageFeaturesToPoseModel(
+        num_outputs=self.action_size)(feature_points)
+    return {
+        'inference_output': estimated_pose,
+        'state_features': feature_points,
+    }
+
+
+class PoseEnvRegressionModel(regression_model.RegressionModel):
+  """Vision → pose regression (pose_env_models.py:235-329)."""
+
+  def __init__(self, action_size: int = 2, **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+
+  @property
+  def action_size(self) -> int:
+    return self._action_size
+
+  @property
+  def default_preprocessor_cls(self):
+    return _Uint8ToFloatPreprocessor
+
+  def create_module(self):
+    return _RegressionNet(action_size=self._action_size)
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['state/image'] = TensorSpec(
+        shape=IMAGE_SHAPE, dtype=np.float32, name='state/image',
+        data_format='JPEG')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['target_pose'] = TensorSpec(
+        shape=(self._action_size,), dtype=np.float32, name='target_pose')
+    spec['reward'] = TensorSpec(shape=(1,), dtype=np.float32, name='reward')
+    return spec
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    """Reward-weighted MSE (pose_env_models.py:322-329 loss_fn)."""
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    target = labels['target_pose'].astype(jnp.float32)
+    weights = labels['reward'].astype(jnp.float32)
+    per_example = jnp.mean(jnp.square(prediction - target), axis=-1,
+                           keepdims=True)
+    num_nonzero = jnp.maximum(jnp.sum(weights != 0.0), 1.0)
+    loss = jnp.sum(per_example * weights) / num_nonzero
+    return loss, {}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    target = labels['target_pose'].astype(jnp.float32)
+    mse = jnp.mean(jnp.square(prediction - target))
+    loss, _ = self.model_train_fn(features, labels, inference_outputs,
+                                  'eval')
+    return {'loss': loss, 'pose_mse': mse}
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    del context, timestep
+    packed = SpecStruct()
+    packed['state/image'] = np.expand_dims(state, 0)
+    return packed
+
+
+class _CriticNet(nn.Module):
+  """Conv features + broadcast action context → q (pose_env_models.py:
+  119-172 ``_q_features``/``q_func``)."""
+
+  channels: int = 32
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    image = features['state/image'].astype(jnp.float32)
+    action = features['action/pose'].astype(jnp.float32)
+    net = image
+    for layer_index in range(3):
+      net = nn.Conv(self.channels, (3, 3), name=f'conv{layer_index}')(net)
+      net = nn.LayerNorm()(net)
+      net = nn.relu(net)
+    action_context = nn.Dense(self.channels, name='action_fc')(action)
+    net = net + action_context[:, None, None, :]
+    net = net.reshape((net.shape[0], -1))
+    net = nn.relu(nn.Dense(100)(net))
+    net = nn.relu(nn.Dense(100)(net))
+    q = nn.Dense(1, name='q_head')(net)
+    return {'q_predicted': jnp.squeeze(q, axis=1)}
+
+
+class PoseEnvContinuousMCModel(critic_model.CriticModel):
+  """Continuous MC critic for the pose env (pose_env_models.py:96-185)."""
+
+  @property
+  def default_preprocessor_cls(self):
+    return _Uint8ToFloatPreprocessor
+
+  def create_module(self):
+    return _CriticNet()
+
+  def get_state_specification(self) -> SpecStruct:
+    spec = SpecStruct()
+    spec['image'] = TensorSpec(
+        shape=IMAGE_SHAPE, dtype=np.float32, name='state/image',
+        data_format='JPEG')
+    return spec
+
+  def get_action_specification(self) -> SpecStruct:
+    spec = SpecStruct()
+    spec['pose'] = TensorSpec(shape=(2,), dtype=np.float32, name='pose')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['reward'] = TensorSpec(shape=(1,), dtype=np.float32, name='reward')
+    return spec
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    """One observation tiled against the CEM action batch
+    (pose_env_models.py:174-178)."""
+    del timestep
+    actions = np.asarray(context, np.float32)
+    num_samples = actions.shape[0]
+    packed = SpecStruct()
+    obs = np.asarray(state)
+    packed['state/image'] = np.broadcast_to(
+        obs, (num_samples,) + obs.shape).copy()
+    packed['action/pose'] = actions
+    return packed

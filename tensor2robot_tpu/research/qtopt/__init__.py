@@ -1,0 +1,13 @@
+"""QT-Opt: grasping Q-function workload (the perf flagship)."""
+
+from tensor2robot_tpu.research.qtopt.networks import Grasping44
+from tensor2robot_tpu.research.qtopt.optimizer_builder import (
+    BuildOpt,
+    build_opt,
+    default_hparams,
+)
+from tensor2robot_tpu.research.qtopt.t2r_models import (
+    DefaultGrasping44ImagePreprocessor,
+    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    GraspingModelWrapper,
+)

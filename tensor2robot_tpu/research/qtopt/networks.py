@@ -1,0 +1,152 @@
+"""QT-Opt grasping critic networks, Flax-native.
+
+Capability-equivalent of ``/root/reference/research/qtopt/networks.py``
+(``GraspingModel`` ``:44-300``, ``Grasping44FlexibleGraspParams``
+``:303-622``, e2e variant ``:623-745``): conv tower over the 472×472 grasp
+image; grasp-param blocks embedded per-block and summed; action context
+broadcast-added to the image embedding; two more conv stages; MLP → logit
+→ sigmoid q.
+
+TPU-first notes: the reference's CEM "megabatch" machinery (tile image
+embeddings ``action_batch_size`` times, ``:419-428,525-527``) exists to
+amortize per-session-call overhead; under jit the same effect comes from
+broadcasting — ``grasp_params`` may be rank-3 ``[B, A, P]`` and the image
+embedding ``[B, 1, ...]`` broadcasts against it, so the conv tower still
+runs once per image. bfloat16 flows through convs/FCs; batch norm runs in
+float32 via Flax defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+GRASP_PARAM_SIZES = {
+    'projected_vector': 2,
+    'tip_vectors_first_finger': 2,
+    'tip_vectors_second_finger': 2,
+    'vertical_rotation': 2,
+    'camera_vector': 3,
+    'world_vector': 3,
+    'wrist_vector': 3,
+}
+
+
+class _ConvBN(nn.Module):
+  features: int
+  kernel: int
+  strides: int = 1
+  padding: str = 'SAME'
+  decay: float = 0.9997
+  epsilon: float = 0.001
+
+  @nn.compact
+  def __call__(self, x, train: bool):
+    x = nn.Conv(
+        self.features, (self.kernel, self.kernel),
+        strides=(self.strides, self.strides), padding=self.padding,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01))(x)
+    x = nn.BatchNorm(
+        use_running_average=not train, momentum=self.decay,
+        epsilon=self.epsilon, use_scale=True, dtype=x.dtype)(x)
+    return nn.relu(x)
+
+
+class Grasping44(nn.Module):
+  """The Grasping44 Q-network (networks.py:303-622).
+
+  ``__call__(images, grasp_params, train)``:
+
+  * ``images``: [B, 472, 472, 3] grasp image (the reference also passes an
+    initial-scene image that this tower ignores, t2r_models.py:155-162).
+  * ``grasp_params``: [B, P] or [B, A, P] for CEM action batches.
+
+  Returns (logits, end_points) with ``predictions`` = sigmoid(logits),
+  shaped [B] or [B, A].
+  """
+
+  num_convs: Tuple[int, int, int] = (6, 6, 3)
+  hid_layers: int = 2
+  num_classes: int = 1
+  batch_norm_decay: float = 0.9997
+  batch_norm_epsilon: float = 0.001
+
+  @nn.compact
+  def __call__(self,
+               images: jnp.ndarray,
+               grasp_params: jnp.ndarray,
+               train: bool = False,
+               softmax: bool = False) -> Tuple[jnp.ndarray, Dict]:
+    end_points: Dict[str, jnp.ndarray] = {}
+    action_batched = grasp_params.ndim == 3
+
+    def bn(x, scale=False):
+      return nn.BatchNorm(
+          use_running_average=not train, momentum=self.batch_norm_decay,
+          epsilon=self.batch_norm_epsilon, use_scale=scale, dtype=x.dtype)(x)
+
+    # --- image tower (networks.py:450-470)
+    net = nn.Conv(
+        64, (6, 6), strides=(2, 2), padding='SAME',
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        name='conv1_1')(images)
+    net = nn.relu(bn(net))
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    for l in range(2, 2 + self.num_convs[0]):
+      net = _ConvBN(64, 5, name=f'conv{l}')(net, train)
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    end_points['pool2'] = net
+
+    # --- grasp-param embedding (networks.py:476-518)
+    fcgrasp = nn.Dense(
+        256, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        name='fcgrasp')(grasp_params)
+    fcgrasp = nn.relu(bn(fcgrasp))
+    fcgrasp = nn.Dense(
+        64, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        name='fcgrasp2')(fcgrasp)
+    end_points['fcgrasp'] = fcgrasp
+
+    # --- merge: broadcast-add action context onto image features
+    # (networks.py:518-530; reference tiles, broadcasting is free here).
+    if action_batched:
+      # net: [B, H, W, C] → [B, 1, H, W, C]; context: [B, A, 1, 1, C]
+      net = net[:, None] + fcgrasp[:, :, None, None, :]
+      batch, actions = net.shape[0], net.shape[1]
+      net = net.reshape((batch * actions,) + net.shape[2:])
+    else:
+      net = net + fcgrasp[:, None, None, :]
+    end_points['vsum'] = net
+
+    for l in range(2 + self.num_convs[0],
+                   2 + self.num_convs[0] + self.num_convs[1]):
+      net = _ConvBN(64, 3, name=f'conv{l}')(net, train)
+    net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
+    for l in range(2 + self.num_convs[0] + self.num_convs[1],
+                   2 + sum(self.num_convs)):
+      net = _ConvBN(64, 3, padding='VALID', name=f'conv{l}')(net, train)
+    end_points['final_conv'] = net
+
+    net = net.reshape((net.shape[0], -1))
+    for l in range(self.hid_layers):
+      net = nn.Dense(
+          64, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+          name=f'fc{l}')(net)
+      net = nn.relu(bn(net, scale=True))
+    name = 'logit' if self.num_classes == 1 else f'logit_{self.num_classes}'
+    logits = nn.Dense(
+        self.num_classes,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        name=name)(net)
+    end_points['logits'] = logits
+
+    predictions = (nn.softmax(logits) if softmax else nn.sigmoid(logits))
+    if self.num_classes == 1:
+      predictions = jnp.squeeze(predictions, axis=-1)
+    if action_batched:
+      predictions = predictions.reshape((batch, actions) + (
+          () if self.num_classes == 1 else (self.num_classes,)))
+    end_points['predictions'] = predictions
+    return logits, end_points

@@ -1,0 +1,69 @@
+"""QT-Opt optimizer builder: hparams → optax transformation.
+
+Capability-equivalent of
+``/root/reference/research/qtopt/optimizer_builder.py:29-100``
+(``BuildOpt``): exponential-decay LR feeding momentum / RMSProp / Adam.
+The reference wraps the result in ``MovingAverageOptimizer`` when
+``use_avg_model_params`` — in this framework parameter averaging is the
+trainer's ``ema_params`` (model flag ``use_avg_model_params``), so the
+builder returns the plain transformation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import optax
+
+
+def default_hparams() -> Dict[str, Any]:
+  """The wrapper's default hparams (t2r_models.py:80-94)."""
+  return dict(
+      batch_size=32,
+      examples_per_epoch=3000000,
+      learning_rate_decay_factor=0.999,
+      learning_rate=1e-4,
+      model_weights_averaging=0.9999,
+      momentum=0.9,
+      num_epochs_per_decay=2.0,
+      optimizer='momentum',
+      rmsprop_decay=0.9,
+      rmsprop_epsilon=1.0,
+      adam_beta2=0.999,
+      adam_epsilon=1e-8,
+      use_avg_model_params=True,
+  )
+
+
+def build_opt(hparams: Dict[str, Any]) -> optax.GradientTransformation:
+  """hparams → optax optimizer (optimizer_builder.py:29-100)."""
+  merged = default_hparams()
+  merged.update(hparams or {})
+  hparams = merged
+
+  decay_steps = int(hparams['examples_per_epoch'] / hparams['batch_size'] *
+                    hparams['num_epochs_per_decay'])
+  learning_rate = optax.exponential_decay(
+      init_value=hparams['learning_rate'],
+      transition_steps=decay_steps,
+      decay_rate=hparams['learning_rate_decay_factor'],
+      staircase=True)
+
+  optimizer = hparams['optimizer']
+  if optimizer == 'momentum':
+    return optax.sgd(learning_rate, momentum=hparams['momentum'])
+  if optimizer == 'rmsprop':
+    return optax.rmsprop(
+        learning_rate,
+        decay=hparams['rmsprop_decay'],
+        momentum=hparams['momentum'],
+        eps=hparams['rmsprop_epsilon'])
+  return optax.adam(
+      learning_rate,
+      b1=hparams['momentum'],
+      b2=hparams['adam_beta2'],
+      eps=hparams['adam_epsilon'])
+
+
+# Reference-name alias.
+BuildOpt = build_opt

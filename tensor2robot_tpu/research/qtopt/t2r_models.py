@@ -1,0 +1,233 @@
+"""QT-Opt T2R models: grasping critic wrapper + preprocessor.
+
+Capability-equivalent of ``/root/reference/research/qtopt/t2r_models.py``:
+
+* :class:`GraspingModelWrapper` (``LegacyGraspingModelWrapper``,
+  ``:66-404``) — CriticModel over the Grasping44 network with log loss,
+  QT-Opt's momentum+EMA optimizer (via :mod:`optimizer_builder`), and the
+  exported ``global_step`` broadcast output (``:136-141``).
+* :class:`DefaultGrasping44ImagePreprocessor` (``:247-313``) — on-disk
+  512×640 uint8 JPEG → train: random crop 472×472 + photometric
+  distortions; eval: center crop; float32 [0,1] on device.
+* :class:`Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom`
+  (``:317-404``) — the full e2e action space.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models import critic_model
+from tensor2robot_tpu.models.base import merge_variables
+from tensor2robot_tpu.models.critic_model import log_loss
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors.base import SpecTransformationPreprocessor
+from tensor2robot_tpu.research.qtopt import networks, optimizer_builder
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
+
+INPUT_SHAPE = (512, 640, 3)
+TARGET_SHAPE = (472, 472)
+
+
+class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
+  """Crop + photometric distortions (t2r_models.py:247-313)."""
+
+  def __init__(self,
+               input_shape=INPUT_SHAPE,
+               target_shape=TARGET_SHAPE,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._input_shape = tuple(input_shape)
+    self._target_shape = tuple(target_shape)
+
+  def _transform_in_feature_specification(self, spec_struct, mode):
+    self.update_spec(
+        spec_struct, 'state/image',
+        shape=self._input_shape, dtype=np.uint8, data_format='JPEG')
+    return spec_struct
+
+  def _preprocess_fn(self, features, labels, mode, rng):
+    image = features['state/image']
+    if mode == ModeKeys.TRAIN:
+      crop_rng, distort_rng = (
+          jax.random.split(rng) if rng is not None else
+          (jax.random.PRNGKey(0), jax.random.PRNGKey(1)))
+      image = image_transformations.random_crop_images(
+          crop_rng, image, self._target_shape)
+      image = image.astype(jnp.float32) / 255.0
+      image = image_transformations.apply_photometric_image_distortions(
+          distort_rng, image)
+    else:
+      image = image_transformations.center_crop_images(
+          image, self._target_shape)
+      image = image.astype(jnp.float32) / 255.0
+    features['state/image'] = image
+    return features, labels
+
+
+class GraspingModelWrapper(critic_model.CriticModel):
+  """Critic over Grasping44 with QT-Opt training hyperparameters."""
+
+  def __init__(self,
+               loss_function=log_loss,
+               learning_rate: float = 1e-4,
+               model_weights_averaging: float = 0.9999,
+               momentum: float = 0.9,
+               export_batch_size: int = 1,
+               use_avg_model_params: bool = True,
+               learning_rate_decay_factor: float = 0.999,
+               input_shape=INPUT_SHAPE,
+               target_shape=TARGET_SHAPE,
+               num_convs=(6, 6, 3),
+               **kwargs):
+    self.hparams = optimizer_builder.default_hparams()
+    self.hparams.update(
+        learning_rate=learning_rate,
+        model_weights_averaging=model_weights_averaging,
+        momentum=momentum,
+        learning_rate_decay_factor=learning_rate_decay_factor,
+        use_avg_model_params=use_avg_model_params)
+    self._export_batch_size = export_batch_size
+    self._input_shape = tuple(input_shape)
+    self._target_shape = tuple(target_shape)
+    self._num_convs = tuple(num_convs)
+    kwargs.setdefault('create_optimizer_fn',
+                      lambda: optimizer_builder.build_opt(self.hparams))
+    super().__init__(
+        loss_function=loss_function,
+        use_avg_model_params=use_avg_model_params,
+        avg_model_params_decay=model_weights_averaging,
+        **kwargs)
+
+  @property
+  def default_preprocessor_cls(self):
+    input_shape, target_shape = self._input_shape, self._target_shape
+
+    class _Preprocessor(DefaultGrasping44ImagePreprocessor):
+
+      def __init__(self, **kwargs):
+        super().__init__(
+            input_shape=input_shape, target_shape=target_shape, **kwargs)
+
+    return _Preprocessor
+
+  def create_module(self) -> networks.Grasping44:
+    return networks.Grasping44(num_convs=self._num_convs)
+
+  def get_state_specification(self) -> SpecStruct:
+    spec = SpecStruct()
+    spec['image'] = TensorSpec(
+        shape=self._target_shape + (3,), dtype=np.float32,
+        name='state/image', data_format='JPEG')
+    return spec
+
+  def get_action_specification(self) -> SpecStruct:
+    spec = SpecStruct()
+    spec['world_vector'] = TensorSpec(
+        shape=(3,), dtype=np.float32, name='world_vector')
+    spec['vertical_rotation'] = TensorSpec(
+        shape=(2,), dtype=np.float32, name='vertical_rotation')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['reward'] = TensorSpec(
+        shape=(1,), dtype=np.float32, name='grasp_success')
+    return spec
+
+  def grasp_params(self, features) -> jnp.ndarray:
+    """Concatenates the action blocks (networks.py:66-79)."""
+    return jnp.concatenate([
+        features['action/world_vector'].astype(jnp.float32),
+        features['action/vertical_rotation'].astype(jnp.float32),
+    ], axis=-1)
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    features, _ = self.validated_features(features, mode)
+    module = self.module
+    train = mode == ModeKeys.TRAIN
+    images = features['state/image'].astype(jnp.float32)
+    grasp_params = self.grasp_params(features)
+    mutable = [k for k in variables if k != 'params'] if train else False
+    if mutable:
+      (_, end_points), mutated = module.apply(
+          variables, images, grasp_params, train=True, mutable=mutable)
+      new_variables = merge_variables(variables['params'], mutated)
+    else:
+      _, end_points = module.apply(variables, images, grasp_params,
+                                   train=False)
+      new_variables = variables
+    outputs = SpecStruct()
+    outputs['q_predicted'] = end_points['predictions']
+    return outputs, new_variables
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    images = features['state/image'].astype(jnp.float32)
+    grasp_params = self.grasp_params(features)
+    return self.module.init(
+        {'params': rng}, images, grasp_params, train=False)
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    """One image + CEM action batch (t2r_models.py:200-230)."""
+    del timestep
+    actions = np.asarray(context, np.float32)
+    num_samples = actions.shape[0]
+    packed = SpecStruct()
+    obs = np.asarray(state)
+    packed['state/image'] = np.broadcast_to(
+        obs, (num_samples,) + obs.shape).copy()
+    packed['action/world_vector'] = actions[:, :3]
+    packed['action/vertical_rotation'] = actions[:, 3:5]
+    return packed
+
+
+class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+    GraspingModelWrapper):
+  """Full e2e action space (t2r_models.py:317-404)."""
+
+  def get_action_specification(self) -> SpecStruct:
+    spec = SpecStruct()
+    for name, size in (
+        ('world_vector', 3),
+        ('vertical_rotation', 2),
+        ('close_gripper', 1),
+        ('open_gripper', 1),
+        ('terminate_episode', 1),
+        ('gripper_closed', 1),
+        ('height_to_bottom', 1),
+    ):
+      spec[name] = TensorSpec(shape=(size,), dtype=np.float32, name=name)
+    return spec
+
+  def grasp_params(self, features) -> jnp.ndarray:
+    blocks = [
+        'world_vector', 'vertical_rotation', 'close_gripper', 'open_gripper',
+        'terminate_episode', 'gripper_closed', 'height_to_bottom'
+    ]
+    return jnp.concatenate(
+        [features[f'action/{b}'].astype(jnp.float32) for b in blocks],
+        axis=-1)
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    del timestep
+    actions = np.asarray(context, np.float32)
+    num_samples = actions.shape[0]
+    packed = SpecStruct()
+    obs = np.asarray(state)
+    packed['state/image'] = np.broadcast_to(
+        obs, (num_samples,) + obs.shape).copy()
+    offsets = (('world_vector', 0, 3), ('vertical_rotation', 3, 5),
+               ('close_gripper', 5, 6), ('open_gripper', 6, 7),
+               ('terminate_episode', 7, 8), ('gripper_closed', 8, 9),
+               ('height_to_bottom', 9, 10))
+    for name, start, end in offsets:
+      packed[f'action/{name}'] = actions[:, start:end]
+    return packed

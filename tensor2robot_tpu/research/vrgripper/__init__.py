@@ -1,0 +1,30 @@
+"""VRGripper / Watch-Try-Learn workloads."""
+
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    DefaultVRGripperPreprocessor,
+    VRGripperDomainAdaptiveModel,
+    VRGripperRegressionModel,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_wtl_models import (
+    VRGripperEnvSimpleTrialModel,
+    VRGripperEnvVisionTrialModel,
+    pack_wtl_meta_features,
+)
+from tensor2robot_tpu.research.vrgripper.decoders import (
+    DiscreteDecoder,
+    MAFDecoder,
+    MSEDecoder,
+    get_discrete_action_loss,
+    get_discrete_actions,
+    get_discrete_bins,
+)
+from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
+    episode_to_transitions_metareacher,
+    episode_to_transitions_reacher,
+    make_fixed_length,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_meta_models import (
+    VRGripperEnvRegressionModelMAML,
+    VRGripperEnvTecModel,
+    pack_vrgripper_meta_features,
+)

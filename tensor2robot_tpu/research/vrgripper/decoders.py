@@ -1,0 +1,196 @@
+"""Action decoders: MSE, discrete bins, masked autoregressive flow.
+
+Capability-equivalents of ``/root/reference/research/vrgripper/
+{mse_decoder,discrete,maf}.py``. Decoders share one contract:
+``__call__(params_features, output_size) -> (action, loss_state)`` and
+``loss(loss_state, action_labels) -> scalar`` — the stateless form of the
+reference's stateful decoder objects (its maml_model TODO).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------- MSE
+
+
+class MSEDecoder(nn.Module):
+  """Plain regression head (mse_decoder.py:31-42)."""
+
+  @nn.compact
+  def __call__(self, params: jnp.ndarray,
+               output_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    action = nn.Dense(output_size)(params)
+    return action, action
+
+  @staticmethod
+  def loss(predicted_action, action_labels) -> jnp.ndarray:
+    return jnp.mean(jnp.square(
+        predicted_action.astype(jnp.float32) -
+        action_labels.astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------- discrete
+
+
+def get_discrete_bins(num_bins: int, output_min: np.ndarray,
+                      output_max: np.ndarray) -> np.ndarray:
+  """[num_bins, action_dim] bin centers (discrete.py:36-53)."""
+  output_min = np.asarray(output_min, np.float32)
+  output_max = np.asarray(output_max, np.float32)
+  bin_sizes = (output_max - output_min) / float(num_bins)
+  return np.stack([
+      output_min + bin_sizes * (bin_i + 0.5) for bin_i in range(num_bins)
+  ])
+
+
+def get_discrete_actions(logits: jnp.ndarray, action_size: int,
+                         num_bins: int,
+                         bin_centers: np.ndarray) -> jnp.ndarray:
+  """Mode action from per-dim bin logits (discrete.py:55-82)."""
+  lead_shape = logits.shape[:-1]
+  probs = jax.nn.softmax(logits.reshape((-1, action_size, num_bins)))
+  best_bins = jnp.argmax(probs, axis=-1)  # [N, action_size]
+  centers = jnp.asarray(bin_centers.T, jnp.float32)  # [action_dim, num_bins]
+  onehot = jax.nn.one_hot(best_bins, num_bins, dtype=jnp.float32)
+  actions = jnp.sum(onehot * centers[None], axis=-1)
+  return actions.reshape(lead_shape + (action_size,))
+
+
+def get_discrete_action_loss(logits: jnp.ndarray,
+                             action_labels: jnp.ndarray,
+                             bin_centers: np.ndarray,
+                             num_bins: int) -> jnp.ndarray:
+  """Cross-entropy against nearest-bin labels (discrete.py:85-110)."""
+  action_size = action_labels.shape[-1]
+  centers = jnp.asarray(bin_centers, jnp.float32)  # [num_bins, action_dim]
+  labels = action_labels.reshape((-1, 1, action_size))
+  discrete_labels = jnp.argmin(
+      jnp.square(labels - centers[None]), axis=-2)  # [N, action_dim]
+  onehot = jax.nn.one_hot(discrete_labels.reshape(-1), num_bins)
+  flat_logits = logits.reshape((-1, num_bins))
+  log_probs = jax.nn.log_softmax(flat_logits)
+  return -jnp.mean(jnp.sum(onehot * log_probs, axis=-1))
+
+
+class DiscreteDecoder(nn.Module):
+  """Discretized action head (discrete.py:113-151)."""
+
+  num_bins: int = 1
+  output_min: Optional[Sequence[float]] = None
+  output_max: Optional[Sequence[float]] = None
+
+  @nn.compact
+  def __call__(self, params: jnp.ndarray,
+               output_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    logits = nn.Dense(output_size * self.num_bins)(params)
+    bin_centers = self.bin_centers(output_size)
+    action = get_discrete_actions(logits, output_size, self.num_bins,
+                                  bin_centers)
+    return action, logits
+
+  def bin_centers(self, output_size: int) -> np.ndarray:
+    output_min = (np.asarray(self.output_min, np.float32)
+                  if self.output_min is not None else
+                  -np.ones(output_size, np.float32))
+    output_max = (np.asarray(self.output_max, np.float32)
+                  if self.output_max is not None else
+                  np.ones(output_size, np.float32))
+    return get_discrete_bins(self.num_bins, output_min, output_max)
+
+  def loss(self, logits, action_labels) -> jnp.ndarray:
+    output_size = action_labels.shape[-1]
+    return get_discrete_action_loss(
+        logits, action_labels, self.bin_centers(output_size), self.num_bins)
+
+
+# --------------------------------------------------------------------- MAF
+
+
+class _MADE(nn.Module):
+  """Masked autoencoder for distribution estimation: one flow layer."""
+
+  event_size: int
+  hidden: int = 64
+
+  @nn.compact
+  def __call__(self, x, context):
+    # Autoregressive masks: degree(input i) = i+1; hidden degrees cycle.
+    in_deg = np.arange(1, self.event_size + 1)
+    hid_deg = (np.arange(self.hidden) % max(self.event_size - 1, 1)) + 1
+    mask1 = (hid_deg[:, None] >= in_deg[None, :]).astype(np.float32)
+    mask2 = (in_deg[:, None] > hid_deg[None, :]).astype(np.float32)
+
+    w1 = self.param('w1', nn.initializers.lecun_normal(),
+                    (self.hidden, self.event_size))
+    b1 = self.param('b1', nn.initializers.zeros, (self.hidden,))
+    ctx_proj = nn.Dense(self.hidden, name='ctx')(context)
+    h = jnp.tanh(x @ (w1 * mask1).T + b1 + ctx_proj)
+    w_mu = self.param('w_mu', nn.initializers.lecun_normal(),
+                      (self.event_size, self.hidden))
+    b_mu = self.param('b_mu', nn.initializers.zeros, (self.event_size,))
+    w_sig = self.param('w_sig', nn.initializers.zeros,
+                       (self.event_size, self.hidden))
+    b_sig = self.param('b_sig', nn.initializers.zeros, (self.event_size,))
+    mu = h @ (w_mu * mask2).T + b_mu
+    log_sigma = jnp.clip(h @ (w_sig * mask2).T + b_sig, -5.0, 5.0)
+    return mu, log_sigma
+
+
+class MAFDecoder(nn.Module):
+  """Masked autoregressive flow action decoder (maf.py:72-103).
+
+  ``__call__`` returns (sampled action, loss_state); ``loss`` computes the
+  exact NLL through the inverse flow.
+  """
+
+  num_flows: int = 1
+  hidden: int = 64
+
+  @nn.compact
+  def __call__(self, params: jnp.ndarray, output_size: int,
+               rng: Optional[jax.Array] = None):
+    mades = [
+        _MADE(event_size=output_size, hidden=self.hidden, name=f'made_{i}')
+        for i in range(self.num_flows)
+    ]
+    context = params
+    # Sample: z ~ N(0, I), pass forward through flows autoregressively.
+    if rng is None:
+      z = jnp.zeros(params.shape[:-1] + (output_size,))
+    else:
+      z = jax.random.normal(rng, params.shape[:-1] + (output_size,))
+    x = z
+    for made in mades:
+      out = jnp.zeros_like(x)
+      for dim in range(output_size):
+        mu, log_sigma = made(out, context)
+        out = out.at[..., dim].set(
+            x[..., dim] * jnp.exp(log_sigma[..., dim]) + mu[..., dim])
+      x = out
+    # loss state: (context,) — NLL evaluates the inverse pass on labels.
+    return x, context
+
+  def loss(self, variables, context, action_labels, output_size: int):
+    """Exact NLL of labels under the flow (inverse direction is parallel)."""
+
+    def inverse_nll(x):
+      log_det = jnp.zeros(x.shape[:-1])
+      u = x
+      for i in reversed(range(self.num_flows)):
+        made = _MADE(event_size=output_size, hidden=self.hidden)
+        mu, log_sigma = made.apply(
+            {'params': variables['params'][f'made_{i}']}, u, context)
+        u = (u - mu) * jnp.exp(-log_sigma)
+        log_det = log_det - jnp.sum(log_sigma, axis=-1)
+      base_ll = -0.5 * jnp.sum(u**2, axis=-1) - 0.5 * output_size * jnp.log(
+          2 * jnp.pi)
+      return -(base_ll + log_det)
+
+    return jnp.mean(inverse_nll(action_labels.astype(jnp.float32)))

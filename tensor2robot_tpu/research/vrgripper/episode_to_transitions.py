@@ -1,0 +1,104 @@
+"""VRGripper episode → transition Examples.
+
+Capability-equivalent of
+``/root/reference/research/vrgripper/episode_to_transitions.py:45-130``:
+fixed-length episode subsampling and reacher/meta-reacher converters.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def make_fixed_length(input_list: Sequence,
+                      fixed_length: int,
+                      always_include_endpoints: bool = True,
+                      randomized: bool = True,
+                      rng: Optional[np.random.RandomState] = None
+                      ) -> Optional[List]:
+  """Samples a fixed-length list (episode_to_transitions.py:45-83)."""
+  rng = rng or np.random
+  original_length = len(input_list)
+  if original_length <= 2:
+    return None
+  if not randomized:
+    indices = np.sort(np.mod(np.arange(fixed_length), original_length))
+    return [input_list[i] for i in indices]
+  if always_include_endpoints:
+    endpoint_indices = np.array([0, original_length - 1])
+    other_indices = 1 + rng.choice(
+        original_length - 2, fixed_length - 2, replace=True)
+    indices = np.concatenate((endpoint_indices, other_indices), axis=0)
+  else:
+    indices = rng.choice(original_length, fixed_length, replace=True)
+  indices = np.sort(indices)
+  return [input_list[i] for i in indices]
+
+
+def _tf():
+  import tensorflow as tf
+
+  return tf
+
+
+def _float_feature(values):
+  tf = _tf()
+  return tf.train.Feature(
+      float_list=tf.train.FloatList(
+          value=np.asarray(values, np.float32).flatten().tolist()))
+
+
+def _int64_feature(values):
+  tf = _tf()
+  return tf.train.Feature(
+      int64_list=tf.train.Int64List(
+          value=np.asarray(values, np.int64).flatten().tolist()))
+
+
+def episode_to_transitions_reacher(episode_data, is_demo: bool = False):
+  """Reacher episode → per-step Examples (episode_to_transitions.py:88-106)."""
+  tf = _tf()
+  transitions = []
+  for (obs_t, action, reward, obs_tp1, done, _) in episode_data:
+    feature_dict = {
+        'pose_t': _float_feature(obs_t),
+        'pose_tp1': _float_feature(obs_tp1),
+        'action': _float_feature(action),
+        'reward': _float_feature([reward]),
+        'done': _int64_feature([int(done)]),
+        'is_demo': _int64_feature([int(is_demo)]),
+    }
+    transitions.append(
+        tf.train.Example(
+            features=tf.train.Features(feature=feature_dict)))
+  return transitions
+
+
+def episode_to_transitions_metareacher(episode_data):
+  """Meta-reacher episode → one SequenceExample
+  (episode_to_transitions.py:108-130)."""
+  tf = _tf()
+  context_features = {
+      'is_demo': _int64_feature([int(episode_data[0][-1]['is_demo'])]),
+      'target_idx': _int64_feature([episode_data[0][-1]['target_idx']]),
+  }
+  feature_lists = collections.defaultdict(list)
+  for (obs_t, action, reward, obs_tp1, done, _) in episode_data:
+    feature_lists['pose_t'].append(_float_feature(obs_t))
+    feature_lists['pose_tp1'].append(_float_feature(obs_tp1))
+    feature_lists['action'].append(_float_feature(action))
+    feature_lists['reward'].append(_float_feature([reward]))
+    feature_lists['done'].append(_int64_feature([int(done)]))
+  tf_feature_lists = {
+      key: tf.train.FeatureList(feature=features)
+      for key, features in feature_lists.items()
+  }
+  return [
+      tf.train.SequenceExample(
+          context=tf.train.Features(feature=context_features),
+          feature_lists=tf.train.FeatureLists(
+              feature_list=tf_feature_lists))
+  ]

@@ -1,0 +1,95 @@
+"""VRGripper meta models: MAML variant + TEC model.
+
+Capability-equivalent of
+``/root/reference/research/vrgripper/vrgripper_env_meta_models.py``:
+
+* :func:`pack_vrgripper_meta_features` (``:46-120``) — obs + cached demo
+  episodes → MetaExample feature layout.
+* :class:`VRGripperEnvRegressionModelMAML` (``:122-140``) — MAMLModel over
+  the VRGripper regression model with policy-side packing.
+* :class:`VRGripperEnvTecModel` (``:143-571``) — the vision TEC model is
+  provided by :class:`..vrgripper_env_wtl_models.VRGripperEnvVisionTrialModel`
+  (same embedding→policy pipeline); this alias keeps the reference name.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.meta_learning import maml_model
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_wtl_models import (
+    VRGripperEnvVisionTrialModel,
+)
+from tensor2robot_tpu.specs import SpecStruct
+
+
+def pack_vrgripper_meta_features(state,
+                                 prev_episode_data,
+                                 timestep: int,
+                                 episode_length: int,
+                                 num_condition_samples_per_task: int
+                                 ) -> SpecStruct:
+  """Packs (image, pose) obs + demo episodes (meta_models.py:46-120)."""
+  image, pose = state
+  image = np.asarray(image, np.float32)
+  pose = np.asarray(pose, np.float32)
+  meta_features = SpecStruct()
+  # Inference episode: current obs broadcast over the episode dim.
+  inf_images = np.broadcast_to(image, (episode_length,) + image.shape).copy()
+  inf_poses = np.broadcast_to(pose, (episode_length,) + pose.shape).copy()
+  meta_features['inference/features/image/0'] = inf_images[None]
+  meta_features['inference/features/gripper_pose/0'] = inf_poses[None]
+
+  def pack_condition_features(episode_data, idx):
+    images = np.stack([np.asarray(t[0][0], np.float32)
+                       for t in episode_data])[:episode_length]
+    poses = np.stack([np.asarray(t[0][1], np.float32)
+                      for t in episode_data])[:episode_length]
+    actions = np.stack([np.asarray(t[1], np.float32)
+                        for t in episode_data])[:episode_length]
+    pad = episode_length - images.shape[0]
+    if pad > 0:
+      images = np.concatenate(
+          [images, np.repeat(images[-1:], pad, axis=0)])
+      poses = np.concatenate([poses, np.repeat(poses[-1:], pad, axis=0)])
+      actions = np.concatenate(
+          [actions, np.repeat(actions[-1:], pad, axis=0)])
+    meta_features[f'condition/features/image/{idx}'] = images[None]
+    meta_features[f'condition/features/gripper_pose/{idx}'] = poses[None]
+    meta_features[f'condition/labels/action/{idx}'] = actions[None]
+
+  for idx in range(num_condition_samples_per_task):
+    if prev_episode_data and idx < len(prev_episode_data):
+      pack_condition_features(prev_episode_data[idx], idx)
+    else:
+      dummy = [((image, pose), np.zeros(7, np.float32), 0.0, None, True, {})]
+      pack_condition_features(dummy, idx)
+  return meta_features
+
+
+class VRGripperEnvRegressionModelMAML(maml_model.MAMLModel):
+  """MAML over the VRGripper regression model (meta_models.py:122-140)."""
+
+  def select_inference_output(self, predictions: SpecStruct) -> SpecStruct:
+    predictions['condition_output'] = predictions[
+        'full_condition_output/output_0/inference_output']
+    predictions['inference_output'] = predictions[
+        'full_inference_output/inference_output']
+    return predictions
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    return self.select_inference_output(inference_outputs)
+
+  def pack_features(self, state, prev_episode_data, timestep) -> SpecStruct:
+    return pack_vrgripper_meta_features(
+        state, prev_episode_data, timestep,
+        self._base_model._episode_length,  # pylint: disable=protected-access
+        1)
+
+
+# The TEC model (meta_models.py:143-571) shares its implementation with the
+# WTL vision trial model: condition episodes → temporal embedding →
+# policy conditioning (+ contrastive embedding loss).
+VRGripperEnvTecModel = VRGripperEnvVisionTrialModel

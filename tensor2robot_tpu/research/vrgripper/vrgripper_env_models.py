@@ -1,0 +1,327 @@
+"""VRGripper models: episode BC with vision + MDN/MSE action heads.
+
+Capability-equivalent of
+``/root/reference/research/vrgripper/vrgripper_env_models.py``:
+
+* :class:`DefaultVRGripperPreprocessor` (``:45-143``) — 220×300 uint8
+  episodes → crop (random train / center eval) → resize to the model's
+  100×100 → float32, optional mixup.
+* :class:`VRGripperRegressionModel` (``:145-330``) — per-step vision
+  tower + gripper-pose concat + MDN (num_mixture_components > 1) or MLP
+  action head; batch layout [B, T, ...] handled by one merged batch
+  (the reference's ``multi_batch_apply``).
+* :class:`VRGripperDomainAdaptiveModel` (``:331-448``) — conditions on
+  video only; gripper pose predicted from features (or zeros) in the
+  inner loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.meta_learning import meta_tfdata
+from tensor2robot_tpu.models import regression_model
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors.base import AbstractPreprocessor
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
+
+
+class DefaultVRGripperPreprocessor(AbstractPreprocessor):
+  """Episode image preprocessing (vrgripper_env_models.py:45-143)."""
+
+  def __init__(self,
+               src_img_res: Tuple[int, int] = (220, 300),
+               crop_size: Tuple[int, int] = (200, 280),
+               mixup_alpha: float = 0.0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._src_img_res = tuple(src_img_res)
+    self._crop_size = tuple(crop_size)
+    self._mixup_alpha = mixup_alpha
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    feature_spec = algebra.flatten_spec_structure(
+        self._model_feature_specification_fn(mode)).copy()
+    if mode != ModeKeys.PREDICT and 'original_image' in feature_spec:
+      del feature_spec['original_image']
+    if 'image' in feature_spec:
+      shape = list(feature_spec['image'].shape)
+      shape[-3:-1] = self._src_img_res
+      feature_spec['image'] = TensorSpec.from_spec(
+          feature_spec['image'], shape=tuple(shape), dtype=np.uint8)
+    return feature_spec
+
+  def get_in_label_specification(self, mode: str):
+    return self.model_label_specification(mode)
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode: str):
+    return self.model_label_specification(mode)
+
+  def _preprocess_fn(self, features, labels, mode, rng):
+    if 'image' in features:
+      image = features['image']
+      lead_shape = image.shape[:-3]
+      merged = image.reshape((-1,) + tuple(image.shape[-3:]))
+      if mode == ModeKeys.TRAIN and rng is not None:
+        crop_rng, mix_rng = jax.random.split(rng)
+        cropped = image_transformations.random_crop_images(
+            crop_rng, merged, self._crop_size)
+      else:
+        mix_rng = rng
+        cropped = image_transformations.center_crop_images(
+            merged, self._crop_size)
+      cropped = cropped.astype(jnp.float32) / 255.0
+      out_spec = self.get_out_feature_specification(mode)
+      target_hw = tuple(out_spec['image'].shape[-3:-1])
+      if target_hw != self._crop_size:
+        cropped = jax.image.resize(
+            cropped, (cropped.shape[0],) + target_hw + (cropped.shape[-1],),
+            method='bilinear')
+      features['original_image'] = features['image']
+      features['image'] = cropped.reshape(
+          tuple(lead_shape) + cropped.shape[1:])
+
+      if (self._mixup_alpha > 0.0 and labels is not None and
+          mode == ModeKeys.TRAIN and rng is not None):
+        lmbda = jax.random.beta(mix_rng, self._mixup_alpha, self._mixup_alpha)
+        for key, x in list(features.items()):
+          if jnp.issubdtype(x.dtype, jnp.floating):
+            features[key] = lmbda * x + (1 - lmbda) * jnp.flip(x, axis=0)
+        for key, x in list(labels.items()):
+          if jnp.issubdtype(x.dtype, jnp.floating):
+            labels[key] = lmbda * x + (1 - lmbda) * jnp.flip(x, axis=0)
+    return features, labels
+
+
+class _VRGripperNet(nn.Module):
+  """Per-step vision + action head (vrgripper_env_models.py:231-276)."""
+
+  action_size: int
+  use_gripper_input: bool = True
+  num_mixture_components: int = 1
+  condition_mixture_stddev: bool = False
+
+  @nn.compact
+  def __call__(self, image, gripper_pose, train: bool = False):
+    feature_points, end_points = vision_layers.ImagesToFeaturesModel(
+        name='state_features')(image, train=train)
+    if self.use_gripper_input:
+      fc_input = jnp.concatenate([feature_points, gripper_pose], axis=-1)
+    else:
+      fc_input = feature_points
+    outputs = {}
+    if self.num_mixture_components > 1:
+      dist_params = mdn_lib.MDNParams(
+          num_alphas=self.num_mixture_components,
+          sample_size=self.action_size,
+          condition_sigmas=self.condition_mixture_stddev)(fc_input)
+      outputs['dist_params'] = dist_params
+      gm = mdn_lib.get_mixture_distribution(
+          dist_params.astype(jnp.float32), self.num_mixture_components,
+          self.action_size)
+      action = gm.approximate_mode()
+    else:
+      action, _ = vision_layers.ImageFeaturesToPoseModel(
+          num_outputs=self.action_size)(fc_input)
+    outputs.update({
+        'inference_output': action,
+        'feature_points': feature_points,
+        'softmax': end_points['softmax'],
+    })
+    return outputs
+
+
+class VRGripperRegressionModel(regression_model.RegressionModel):
+  """Episode BC model (vrgripper_env_models.py:145-330)."""
+
+  def __init__(self,
+               use_gripper_input: bool = True,
+               normalize_outputs: bool = False,
+               output_mean: Optional[Sequence[float]] = None,
+               output_stddev: Optional[Sequence[float]] = None,
+               outer_loss_multiplier: float = 1.0,
+               num_mixture_components: int = 1,
+               output_mixture_sample: bool = False,
+               condition_mixture_stddev: bool = False,
+               episode_length: int = 40,
+               action_size: int = 7,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._use_gripper_input = use_gripper_input
+    self._normalize_outputs = normalize_outputs
+    self._outer_loss_multiplier = outer_loss_multiplier
+    self._num_mixture_components = num_mixture_components
+    self._output_mixture_sample = output_mixture_sample
+    self._condition_mixture_stddev = condition_mixture_stddev
+    self._episode_length = episode_length
+    self._action_size = action_size
+    self._output_mean = None
+    self._output_stddev = None
+    if output_mean and output_stddev:
+      if not len(output_mean) == len(output_stddev) == self.action_size:
+        raise ValueError(
+            f'Output mean and stddev have lengths {len(output_mean)} '
+            f'and {len(output_stddev)}.')
+      self._output_mean = np.array([output_mean], np.float32)
+      self._output_stddev = np.array([output_stddev], np.float32)
+
+  @property
+  def action_size(self) -> int:
+    return self._action_size
+
+  @property
+  def default_preprocessor_cls(self):
+    return DefaultVRGripperPreprocessor
+
+  def create_module(self):
+    return _VRGripperNet(
+        action_size=self._action_size,
+        use_gripper_input=self._use_gripper_input,
+        num_mixture_components=self._num_mixture_components,
+        condition_mixture_stddev=self._condition_mixture_stddev)
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['image'] = TensorSpec(
+        shape=(self._episode_length, 100, 100, 3), dtype=np.float32,
+        name='image0', data_format='JPEG')
+    spec['gripper_pose'] = TensorSpec(
+        shape=(self._episode_length, 14), dtype=np.float32,
+        name='world_pose_gripper')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['action'] = TensorSpec(
+        shape=(self._episode_length, self._action_size), dtype=np.float32,
+        name='action_world')
+    return spec
+
+  # --------------------------------------------------------------- forward
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    image = features['image'].astype(jnp.float32)
+    pose = features['gripper_pose'].astype(jnp.float32)
+    merged_image = image.reshape((-1,) + tuple(image.shape[-3:]))
+    merged_pose = pose.reshape((-1, pose.shape[-1]))
+    return self.create_module().init(
+        {'params': rng}, merged_image, merged_pose, train=False)
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    train = mode == ModeKeys.TRAIN
+    image = features['image'].astype(jnp.float32)
+    pose = features['gripper_pose'].astype(jnp.float32)
+
+    def single_batch(image, pose):
+      return self.create_module().apply(variables, image, pose, train=train)
+
+    outputs = meta_tfdata.multi_batch_apply(single_batch, 2, image, pose)
+    if self._num_mixture_components > 1 and self._normalize_outputs:
+      gm = mdn_lib.get_mixture_distribution(
+          outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, self._action_size,
+          jnp.asarray(self._output_mean))
+      outputs['inference_output'] = gm.approximate_mode()
+    elif (self._output_mean is not None and
+          self._num_mixture_components == 1):
+      outputs['inference_output'] = (
+          self._output_mean +
+          self._output_stddev * outputs['inference_output'])
+    return algebra.flatten_spec_structure(outputs), variables
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    """MDN NLL or scaled MSE (vrgripper_env_models.py:313-330)."""
+    action = labels['action'].astype(jnp.float32)
+    if self._num_mixture_components > 1:
+      gm = mdn_lib.get_mixture_distribution(
+          inference_outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, self._action_size,
+          jnp.asarray(self._output_mean)
+          if self._normalize_outputs else None)
+      loss = -jnp.mean(gm.log_prob(action))
+    else:
+      prediction = inference_outputs['inference_output'].astype(jnp.float32)
+      loss = self._outer_loss_multiplier * jnp.mean(
+          jnp.square(prediction - action))
+    return loss, {}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, _ = self.model_train_fn(features, labels, inference_outputs,
+                                  ModeKeys.EVAL)
+    action = labels['action'].astype(jnp.float32)
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    return {
+        'loss': loss,
+        'action_mse': jnp.mean(jnp.square(prediction - action)),
+    }
+
+  def pack_features(self, state, context, timestep) -> SpecStruct:
+    """Single observation → episode-shaped features for the predictor."""
+    del context, timestep
+    packed = SpecStruct()
+    image, pose = state
+    packed['image'] = np.asarray(image)[None]
+    packed['gripper_pose'] = np.asarray(pose)[None]
+    return packed
+
+
+class VRGripperDomainAdaptiveModel(VRGripperRegressionModel):
+  """Video-only conditioning variant (vrgripper_env_models.py:331-448)."""
+
+  def __init__(self,
+               predict_con_gripper_pose: bool = False,
+               **kwargs):
+    kwargs.setdefault('num_mixture_components', 1)
+    super().__init__(**kwargs)
+    self._predict_con_gripper_pose = predict_con_gripper_pose
+
+  def create_module(self):
+    return _DomainAdaptiveNet(
+        action_size=self._action_size,
+        predict_gripper_pose=self._predict_con_gripper_pose)
+
+
+class _DomainAdaptiveNet(nn.Module):
+  """Vision net that can predict its own gripper pose input
+  (vrgripper_env_models.py:365-399)."""
+
+  action_size: int
+  predict_gripper_pose: bool = False
+
+  @nn.compact
+  def __call__(self, image, gripper_pose, train: bool = False,
+               inner_loop: bool = False):
+    feature_points, end_points = vision_layers.ImagesToFeaturesModel(
+        name='state_features')(image, train=train)
+    if inner_loop:
+      if self.predict_gripper_pose:
+        out = nn.Dense(40, use_bias=False)(feature_points)
+        out = nn.LayerNorm()(out)
+        out = nn.relu(out)
+        gripper_pose = nn.Dense(14)(out)
+      else:
+        gripper_pose = jnp.zeros_like(gripper_pose)
+    action, _ = vision_layers.ImageFeaturesToPoseModel(
+        num_outputs=self.action_size)(feature_points, aux_input=gripper_pose)
+    return {
+        'inference_output': action,
+        'feature_points': feature_points,
+        'softmax': end_points['softmax'],
+    }

@@ -1,0 +1,409 @@
+"""Watch-Try-Learn trial models: condition on demo episodes via TEC.
+
+Capability-equivalent of
+``/root/reference/research/vrgripper/vrgripper_env_wtl_models.py``:
+
+* :class:`VRGripperEnvSimpleTrialModel` (``:139-357``) — state-space
+  model: the condition demo episode is reduced to a temporal embedding
+  (``tec.reduce_temporal_embeddings``), tiled across time, concatenated
+  with the inference states, decoded by an MDN/MLP action head. The
+  ``retrial`` variant additionally embeds a (demo, trial) pair with the
+  trial's success signal.
+* :class:`VRGripperEnvVisionTrialModel` (``:359-574``) — TEC with image
+  episodes: condition images embedded per-frame, reduced temporally, and
+  used to condition the policy vision net (FiLM-style concat).
+* :func:`pack_wtl_meta_features` — packs robot observations + cached demo
+  episodes into the MetaExample feature layout for predictors.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.layers import tec, vision_layers
+from tensor2robot_tpu.meta_learning import meta_tfdata, preprocessors
+from tensor2robot_tpu.models.base import FlaxModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    DefaultVRGripperPreprocessor,
+)
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
+
+
+def pack_wtl_meta_features(state,
+                           prev_episode_data,
+                           timestep: int,
+                           episode_length: int,
+                           num_condition_samples_per_task: int) -> SpecStruct:
+  """Packs obs + demo episodes into MetaExample features (wtl_models:339-357).
+
+  ``state`` is the per-step observation array (or (image, pose) tuple);
+  ``prev_episode_data`` is a list of episodes of transition tuples.
+  """
+  packed = SpecStruct()
+  obs = np.asarray(state, np.float32)
+  inference = np.zeros((1, episode_length) + obs.shape[-1:], np.float32)
+  inference[0, :] = obs  # broadcast the current state over the episode dim
+  packed['inference/features/full_state_pose/0'] = inference[0][None]
+  for i in range(num_condition_samples_per_task):
+    if prev_episode_data and i < len(prev_episode_data):
+      episode = prev_episode_data[i]
+      states = np.stack(
+          [np.asarray(t[0], np.float32) for t in episode])[:episode_length]
+      actions = np.stack(
+          [np.asarray(t[1], np.float32) for t in episode])[:episode_length]
+      rewards = np.asarray([[float(t[2])] for t in episode])[:episode_length]
+      pad = episode_length - states.shape[0]
+      if pad:
+        states = np.pad(states, ((0, pad),) + ((0, 0),) * (states.ndim - 1))
+        actions = np.pad(actions, ((0, pad), (0, 0)))
+        rewards = np.pad(rewards, ((0, pad), (0, 0)))
+    else:
+      states = np.zeros((episode_length,) + obs.shape[-1:], np.float32)
+      actions = np.zeros((episode_length, 7), np.float32)
+      rewards = np.zeros((episode_length, 1), np.float32)
+    packed[f'condition/features/full_state_pose/{i}'] = states[None]
+    packed[f'condition/labels/action/{i}'] = actions[None]
+    packed[f'condition/labels/success/{i}'] = rewards[None]
+  return packed
+
+
+class _SimpleTrialNet(nn.Module):
+  """Demo embedding + state → action (wtl_models:222-288)."""
+
+  action_size: int
+  fc_embed_size: int
+  episode_length: int
+  ignore_embedding: bool
+  num_mixture_components: int
+  retrial: bool
+  embed_type: str
+
+  @nn.compact
+  def __call__(self, inf_full_state_pose, con_full_state_pose, con_success):
+    # Shapes: inf [B, num_inf, T, obs], con [B, num_con, T, obs],
+    # success [B, num_con, T, 1].
+    con_success = 2.0 * con_success - 1.0
+    batch = inf_full_state_pose.shape[0]
+    t = inf_full_state_pose.shape[2]
+
+    if self.embed_type == 'temporal':
+      demo = con_full_state_pose[:, 0]  # [B, T, obs]
+      fc_embedding = tec.ReduceTemporalEmbeddings(
+          output_size=self.fc_embed_size, name='demo_embedding')(demo)
+      fc_embedding = fc_embedding[:, None, None, :]
+    elif self.embed_type == 'mean':
+      fc_embedding = con_full_state_pose[:, 0:1, -1:, :]
+    else:
+      raise ValueError(f'Invalid embed_type: {self.embed_type}.')
+    fc_embedding = jnp.broadcast_to(
+        fc_embedding,
+        (batch, 1, t, fc_embedding.shape[-1]))
+
+    if self.retrial:
+      con_input = jnp.concatenate([
+          con_full_state_pose[:, 1:2], con_success[:, 1:2], fc_embedding
+      ], -1)
+      trial_embedding = tec.ReduceTemporalEmbeddings(
+          output_size=self.fc_embed_size, name='trial_embedding')(
+              con_input[:, 0])
+      trial_embedding = jnp.broadcast_to(
+          trial_embedding[:, None, None, :],
+          (batch, 1, t, self.fc_embed_size))
+      fc_embedding = jnp.concatenate([fc_embedding, trial_embedding], -1)
+
+    if self.ignore_embedding:
+      fc_inputs = inf_full_state_pose
+    else:
+      num_inf = inf_full_state_pose.shape[1]
+      tiled = jnp.broadcast_to(
+          fc_embedding, (batch, num_inf, t, fc_embedding.shape[-1]))
+      fc_inputs = [inf_full_state_pose, tiled]
+      if self.retrial:
+        tiled_success = jnp.broadcast_to(
+            con_success[:, 1:2], (batch, num_inf, t, 1))
+        fc_inputs.append(tiled_success)
+      fc_inputs = jnp.concatenate(fc_inputs, -1)
+
+    outputs = {}
+    merged = fc_inputs.reshape((-1, fc_inputs.shape[-1]))
+    if self.num_mixture_components > 1:
+      hidden, _ = vision_layers.ImageFeaturesToPoseModel(
+          num_outputs=None, name='a_func')(merged)
+      dist_params = mdn_lib.MDNParams(
+          num_alphas=self.num_mixture_components,
+          sample_size=self.action_size)(hidden)
+      dist_params = dist_params.reshape(
+          fc_inputs.shape[:-1] + (dist_params.shape[-1],))
+      outputs['dist_params'] = dist_params
+      gm = mdn_lib.get_mixture_distribution(
+          dist_params.astype(jnp.float32), self.num_mixture_components,
+          self.action_size)
+      action = gm.approximate_mode()
+    else:
+      action, _ = vision_layers.ImageFeaturesToPoseModel(
+          num_outputs=self.action_size, name='a_func')(merged)
+      action = action.reshape(fc_inputs.shape[:-1] + (self.action_size,))
+    outputs['inference_output'] = action
+    return outputs
+
+
+class VRGripperEnvSimpleTrialModel(FlaxModel):
+  """State-space WTL trial model (wtl_models:139-357)."""
+
+  def __init__(self,
+               action_size: int = 7,
+               episode_length: int = 40,
+               fc_embed_size: int = 32,
+               ignore_embedding: bool = False,
+               num_mixture_components: int = 1,
+               num_condition_samples_per_task: int = 1,
+               retrial: bool = False,
+               embed_type: str = 'temporal',
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._episode_length = episode_length
+    self._fc_embed_size = fc_embed_size
+    self._ignore_embedding = ignore_embedding
+    self._num_mixture_components = num_mixture_components
+    self._num_condition_samples_per_task = num_condition_samples_per_task
+    self._retrial = retrial
+    self._embed_type = embed_type
+    self._obs_size = 32
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['full_state_pose'] = TensorSpec(
+        shape=(self._episode_length, self._obs_size), dtype=np.float32,
+        name='full_state_pose')
+    return spec
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['action'] = TensorSpec(
+        shape=(self._episode_length, self._action_size), dtype=np.float32,
+        name='action_world')
+    spec['success'] = TensorSpec(
+        shape=(self._episode_length, 1), dtype=np.float32, name='success')
+    return spec
+
+  @property
+  def preprocessor(self):
+    base_preprocessor = DefaultVRGripperPreprocessor(
+        model_feature_specification_fn=self._episode_feature_specification,
+        model_label_specification_fn=self._episode_label_specification)
+    return preprocessors.FixedLenMetaExamplePreprocessor(
+        base_preprocessor=base_preprocessor,
+        num_condition_samples_per_task=(
+            self._num_condition_samples_per_task))
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_feature_spec(
+        self._episode_feature_specification(mode),
+        self._episode_label_specification(mode))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_label_spec(
+        self._episode_label_specification(mode))
+
+  def create_module(self):
+    return _SimpleTrialNet(
+        action_size=self._action_size,
+        fc_embed_size=self._fc_embed_size,
+        episode_length=self._episode_length,
+        ignore_embedding=self._ignore_embedding,
+        num_mixture_components=self._num_mixture_components,
+        retrial=self._retrial,
+        embed_type=self._embed_type)
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    return self.create_module().init(
+        {'params': rng},
+        features['inference/features/full_state_pose'],
+        features['condition/features/full_state_pose'],
+        features['condition/labels/success'])
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    outputs = self.create_module().apply(
+        variables,
+        features['inference/features/full_state_pose'],
+        features['condition/features/full_state_pose'],
+        features['condition/labels/success'])
+    return algebra.flatten_spec_structure(outputs), variables
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    action = labels['action'].astype(jnp.float32)
+    if self._num_mixture_components > 1:
+      gm = mdn_lib.get_mixture_distribution(
+          inference_outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, self._action_size)
+      bc_loss = -jnp.mean(gm.log_prob(action))
+    else:
+      prediction = inference_outputs['inference_output'].astype(jnp.float32)
+      bc_loss = jnp.mean(jnp.square(prediction - action))
+    return bc_loss, {'bc_loss': bc_loss}
+
+  def pack_features(self, state, prev_episode_data, timestep) -> SpecStruct:
+    return pack_wtl_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition_samples_per_task)
+
+
+class _VisionTrialNet(nn.Module):
+  """TEC vision trial net (wtl_models:359-574, compact form)."""
+
+  action_size: int
+  embed_size: int
+
+  @nn.compact
+  def __call__(self, inf_images, inf_gripper_pose, con_images,
+               train: bool = False):
+    # inf_images: [B, num_inf, T, H, W, C]; con_images same for condition.
+    b, num_inf, t = inf_images.shape[:3]
+    num_con, t_con = con_images.shape[1:3]
+
+    # Embed condition frames → temporal reduce → task embedding.
+    con_merged = con_images.reshape((-1,) + tuple(con_images.shape[3:]))
+    con_embedded = tec.EmbedConditionImages(
+        fc_layers=(self.embed_size,), name='con_embed')(
+            con_merged, train=train)
+    con_embedded = con_embedded.reshape((b * num_con, t_con, -1))
+    task_embedding = tec.ReduceTemporalEmbeddings(
+        output_size=self.embed_size, name='task_embed')(con_embedded)
+    task_embedding = task_embedding.reshape((b, num_con, -1)).mean(axis=1)
+    norm = jnp.maximum(
+        jnp.linalg.norm(task_embedding, axis=-1, keepdims=True), 1e-12)
+    task_embedding = task_embedding / norm
+
+    # Policy: per-step vision features + task embedding + gripper pose.
+    inf_merged = inf_images.reshape((-1,) + tuple(inf_images.shape[3:]))
+    feature_points, _ = vision_layers.ImagesToFeaturesModel(
+        name='state_features')(inf_merged, train=train)
+    feature_points = feature_points.reshape((b, num_inf, t, -1))
+    tiled_task = jnp.broadcast_to(
+        task_embedding[:, None, None, :],
+        (b, num_inf, t, task_embedding.shape[-1]))
+    fc_inputs = jnp.concatenate(
+        [feature_points, tiled_task, inf_gripper_pose], -1)
+    merged = fc_inputs.reshape((-1, fc_inputs.shape[-1]))
+    action, _ = vision_layers.ImageFeaturesToPoseModel(
+        num_outputs=self.action_size, name='a_func')(merged)
+    action = action.reshape((b, num_inf, t, self.action_size))
+    return {
+        'inference_output': action,
+        'task_embedding': task_embedding,
+    }
+
+
+class VRGripperEnvVisionTrialModel(FlaxModel):
+  """TEC vision trial model (wtl_models:359-574).
+
+  Adds the TEC contrastive embedding loss between inference and condition
+  episode embeddings (``tec.compute_embedding_contrastive_loss``).
+  """
+
+  def __init__(self,
+               action_size: int = 7,
+               episode_length: int = 40,
+               embed_size: int = 32,
+               image_size: Tuple[int, int] = (100, 100),
+               num_condition_samples_per_task: int = 1,
+               embed_loss_weight: float = 0.0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._episode_length = episode_length
+    self._embed_size = embed_size
+    self._image_size = tuple(image_size)
+    self._num_condition_samples_per_task = num_condition_samples_per_task
+    self._embed_loss_weight = embed_loss_weight
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['image'] = TensorSpec(
+        shape=(self._episode_length,) + self._image_size + (3,),
+        dtype=np.float32, name='image0', data_format='JPEG')
+    spec['gripper_pose'] = TensorSpec(
+        shape=(self._episode_length, 14), dtype=np.float32,
+        name='world_pose_gripper')
+    return spec
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['action'] = TensorSpec(
+        shape=(self._episode_length, self._action_size), dtype=np.float32,
+        name='action_world')
+    return spec
+
+  @property
+  def preprocessor(self):
+    base_preprocessor = DefaultVRGripperPreprocessor(
+        model_feature_specification_fn=self._episode_feature_specification,
+        model_label_specification_fn=self._episode_label_specification)
+    return preprocessors.FixedLenMetaExamplePreprocessor(
+        base_preprocessor=base_preprocessor,
+        num_condition_samples_per_task=(
+            self._num_condition_samples_per_task))
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_feature_spec(
+        self._episode_feature_specification(mode),
+        self._episode_label_specification(mode))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_label_spec(
+        self._episode_label_specification(mode))
+
+  def create_module(self):
+    return _VisionTrialNet(
+        action_size=self._action_size, embed_size=self._embed_size)
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    return self.create_module().init(
+        {'params': rng},
+        features['inference/features/image'],
+        features['inference/features/gripper_pose'],
+        features['condition/features/image'],
+        train=False)
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    outputs = self.create_module().apply(
+        variables,
+        features['inference/features/image'],
+        features['inference/features/gripper_pose'],
+        features['condition/features/image'],
+        train=mode == ModeKeys.TRAIN)
+    return algebra.flatten_spec_structure(outputs), variables
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    action = labels['action'].astype(jnp.float32)
+    prediction = inference_outputs['inference_output'].astype(jnp.float32)
+    bc_loss = jnp.mean(jnp.square(prediction - action))
+    scalars = {'bc_loss': bc_loss}
+    loss = bc_loss
+    if self._embed_loss_weight > 0.0:
+      embedding = inference_outputs['task_embedding']
+      embed_loss = tec.compute_embedding_contrastive_loss(
+          embedding[:, None, :], embedding[:, None, :])
+      scalars['embed_loss'] = embed_loss
+      loss = loss + self._embed_loss_weight * embed_loss
+    return loss, scalars

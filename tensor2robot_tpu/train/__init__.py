@@ -1,0 +1,20 @@
+"""Training: jitted train/eval steps, checkpointing, driver entry points."""
+
+from tensor2robot_tpu.train.checkpoints import (
+    CheckpointManager,
+    checkpoints_iterator,
+    latest_checkpoint_step,
+)
+from tensor2robot_tpu.train.train_state import (
+    TrainState,
+    apply_ema,
+    create_train_state,
+)
+from tensor2robot_tpu.train.trainer import (
+    Trainer,
+    TrainerCallback,
+    TrainerConfig,
+    predict_from_model,
+    provide_input_generator_with_model_information,
+    train_eval_model,
+)

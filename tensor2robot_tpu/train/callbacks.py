@@ -1,0 +1,103 @@
+"""Trainer callbacks: logging, profiling, variable stats.
+
+The callback protocol (``trainer.TrainerCallback``) replaces the
+reference's SessionRunHook/HookBuilder machinery (``hooks/hook_builder.py``,
+``hooks/variable_logger_hook.py``); these are the stock implementations.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.train.trainer import TrainerCallback
+
+
+class VariableLoggerCallback(TrainerCallback):
+  """Logs mean/std (optionally values) of all params.
+
+  Capability-equivalent of ``hooks/variable_logger_hook.py:33-68``.
+  """
+
+  def __init__(self, log_interval_steps: int = 100,
+               log_values: bool = False):
+    self._log_interval_steps = log_interval_steps
+    self._log_values = log_values
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if self._log_interval_steps and step % self._log_interval_steps:
+      return
+    flat = jax.tree_util.tree_leaves_with_path(trainer.state.params)
+    for path, value in flat:
+      value = np.asarray(value)
+      name = jax.tree_util.keystr(path)
+      logging.info('var %s mean=%.6f std=%.6f', name, value.mean(),
+                   value.std())
+      if self._log_values:
+        logging.info('var %s value=%s', name, value)
+
+
+class MetricsLoggerCallback(TrainerCallback):
+  """Appends train/eval scalars as JSON lines under the model dir."""
+
+  def __init__(self, filename: str = 'metrics.jsonl'):
+    self._filename = filename
+
+  def _write(self, trainer, record: dict) -> None:
+    if not trainer.config.model_dir:
+      return
+    os.makedirs(trainer.config.model_dir, exist_ok=True)
+    path = os.path.join(trainer.config.model_dir, self._filename)
+    with open(path, 'a') as f:
+      f.write(json.dumps(record) + '\n')
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if not scalars or (trainer.config.log_interval_steps and
+                       step % trainer.config.log_interval_steps):
+      return
+    record = {'kind': 'train', 'step': int(step)}
+    record.update({k: float(v) for k, v in scalars.items()})
+    self._write(trainer, record)
+
+  def after_eval(self, trainer, step: int, metrics) -> None:
+    record = {'kind': 'eval', 'step': int(step)}
+    record.update({k: float(v) for k, v in metrics.items()})
+    self._write(trainer, record)
+
+
+class ProfilerCallback(TrainerCallback):
+  """Captures a ``jax.profiler`` trace over a step window.
+
+  The tracing capability the reference delegates to TF summaries /
+  TensorBoard (SURVEY §5); traces are viewable in TensorBoard or Perfetto.
+  """
+
+  def __init__(self,
+               start_step: int = 10,
+               num_steps: int = 5,
+               logdir: Optional[str] = None):
+    self._start_step = start_step
+    self._stop_step = start_step + num_steps
+    self._logdir = logdir
+    self._active = False
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if step == self._start_step and not self._active:
+      logdir = self._logdir or os.path.join(
+          trainer.config.model_dir or '/tmp', 'profile')
+      os.makedirs(logdir, exist_ok=True)
+      jax.profiler.start_trace(logdir)
+      self._active = True
+    elif step >= self._stop_step and self._active:
+      jax.profiler.stop_trace()
+      self._active = False
+
+  def end(self, trainer) -> None:
+    if self._active:
+      jax.profiler.stop_trace()
+      self._active = False

@@ -1,0 +1,121 @@
+"""Checkpointing: Orbax-backed save/restore of the TrainState.
+
+Capability-equivalent of the reference's checkpoint machinery:
+``tf.train.Saver`` registration with ``max_to_keep`` /
+``keep_checkpoint_every_n_hours`` (``models/abstract_model.py:782-793``),
+async checkpointing (``hooks/async_export_hook_builder.py:124-137``), and
+restart-from-latest Estimator semantics. Orbax provides atomic writes,
+retention policies, and async saves natively; eval-side checkpoint backup
+(``utils/train_eval.py:590-707``) becomes unnecessary because finalized
+Orbax steps are immutable until GC'd by this manager alone.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+  """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+  def __init__(self,
+               directory: str,
+               max_to_keep: Optional[int] = 5,
+               keep_period: Optional[int] = None,
+               save_interval_steps: int = 1,
+               async_save: bool = True):
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        keep_period=keep_period,
+        save_interval_steps=save_interval_steps,
+        enable_async_checkpointing=async_save,
+        step_prefix='ckpt')
+    self._manager = ocp.CheckpointManager(directory, options=options)
+    self._directory = directory
+
+  @property
+  def directory(self) -> str:
+    return self._directory
+
+  def save(self, step: int, state, force: bool = False) -> bool:
+    step = int(step)
+    if step in self._manager.all_steps():
+      return False  # already saved (e.g. final forced save after an in-loop one)
+    state = jax.device_get(state)
+    return self._manager.save(
+        step, args=ocp.args.StandardSave(state), force=force)
+
+  def restore(self, state, step: Optional[int] = None):
+    """Restores into the structure of ``state`` (an abstract/concrete tree)."""
+    if step is None:
+      step = self.latest_step()
+    if step is None:
+      return None
+    return self._manager.restore(
+        int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+
+  def latest_step(self) -> Optional[int]:
+    return self._manager.latest_step()
+
+  def all_steps(self):
+    return sorted(self._manager.all_steps())
+
+  def wait_until_finished(self) -> None:
+    self._manager.wait_until_finished()
+
+  def close(self) -> None:
+    self._manager.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def latest_checkpoint_step(directory: str) -> Optional[int]:
+  """Latest finalized step in ``directory`` without opening a manager."""
+  try:
+    steps = [
+        int(name.rsplit('_', 1)[-1])
+        for name in os.listdir(directory)
+        if name.startswith('ckpt_') and not name.endswith('.orbax-checkpoint-tmp')
+    ]
+  except FileNotFoundError:
+    return None
+  return max(steps) if steps else None
+
+
+def checkpoints_iterator(directory: str,
+                         min_interval_secs: float = 1.0,
+                         timeout: Optional[float] = None,
+                         stop_after_step: Optional[int] = None
+                         ) -> Iterator[int]:
+  """Yields new checkpoint steps as they appear (continuous evaluator).
+
+  The filesystem-watching contract of
+  ``tf.contrib.training.checkpoints_iterator`` used by the reference's
+  continuous eval loop (``utils/train_eval.py:550-585``).
+  """
+  last_seen = None
+  deadline = None if timeout is None else time.time() + timeout
+  while True:
+    step = latest_checkpoint_step(directory)
+    if step is not None and step != last_seen:
+      last_seen = step
+      deadline = None if timeout is None else time.time() + timeout
+      yield step
+      if stop_after_step is not None and step >= stop_after_step:
+        return
+      continue
+    if deadline is not None and time.time() > deadline:
+      return
+    time.sleep(min_interval_secs)

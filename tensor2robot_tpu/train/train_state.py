@@ -1,0 +1,83 @@
+"""Train state: one pytree holding everything a training step mutates.
+
+Replaces the reference's implicit graph state (global_step variable, slot
+variables, moving averages, batch-norm stats living in TF collections —
+``models/abstract_model.py:739-799``) with a single explicit, shardable
+pytree. Because it is a pytree, the whole state can be donated to the jitted
+step, checkpointed by Orbax in one call, and sharded by pjit.
+
+``ema_params`` realises the reference's ``MovingAverageOptimizer`` +
+swapping-saver capability (``models/optimizers.py:140-167``): when enabled,
+eval and export read the averaged weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+  step: jax.Array
+  params: Any
+  model_state: Dict[str, Any]  # non-trainable Flax collections
+  opt_state: Any
+  ema_params: Optional[Any] = None
+  rng: Optional[jax.Array] = None
+
+  @property
+  def eval_params(self) -> Any:
+    """Params eval/export should use (EMA when enabled)."""
+    return self.params if self.ema_params is None else self.ema_params
+
+  @property
+  def variables(self) -> Mapping[str, Any]:
+    merged = dict(self.model_state or {})
+    merged['params'] = self.params
+    return merged
+
+  @property
+  def eval_variables(self) -> Mapping[str, Any]:
+    merged = dict(self.model_state or {})
+    merged['params'] = self.eval_params
+    return merged
+
+
+def create_train_state(model,
+                       optimizer: optax.GradientTransformation,
+                       rng: jax.Array,
+                       features,
+                       mode: str = 'train') -> TrainState:
+  """Initializes variables + optimizer state for spec-shaped ``features``."""
+  init_rng, state_rng = jax.random.split(rng)
+  variables = model.init_variables(init_rng, features, mode)
+  variables = dict(variables)
+  params = variables.pop('params')
+  if model.init_from_checkpoint_fn is not None:
+    params, variables = model.init_from_checkpoint_fn(params, variables)
+  opt_state = optimizer.init(params)
+  # EMA starts as a *copy*: sharing buffers with params would donate the
+  # same buffer twice in the jitted step (donate_argnums on the state).
+  ema_params = (jax.tree_util.tree_map(jnp.copy, params)
+                if model.use_avg_model_params else None)
+  return TrainState(
+      step=jnp.zeros((), jnp.int32),
+      params=params,
+      model_state=variables,
+      opt_state=opt_state,
+      ema_params=ema_params,
+      rng=state_rng)
+
+
+def apply_ema(state: TrainState, new_params, decay: float) -> Optional[Any]:
+  """One EMA update; returns the new ema tree (or None when disabled)."""
+  if state.ema_params is None:
+    return None
+  return jax.tree_util.tree_map(
+      lambda ema, p: ema * decay + p.astype(ema.dtype) * (1.0 - decay),
+      state.ema_params, new_params)

@@ -1,0 +1,455 @@
+"""Train/eval driver: one generic jitted step over a device mesh.
+
+TPU-native replacement of ``utils/train_eval.py:394-587``. The reference
+drives ``tf.estimator.train_and_evaluate`` with Estimator/TPUEstimator,
+wrapper models, and SessionRunHooks. Here a single SPMD program owns the
+step: host-side input generators yield numpy batches; the jitted step runs
+preprocess → forward → loss → grad → update entirely on device, sharded
+over a ``jax.sharding.Mesh`` (data/fsdp axes shard the batch, XLA inserts
+the gradient all-reduce the reference got from ``CrossShardOptimizer``).
+
+Composition mirrors ``abstract_model.py:683-821``:
+
+  preprocess (device, bf16 cast) → inference_network_fn → model_train_fn
+  → optax update [→ EMA update]           (TRAIN, donated state)
+  preprocess → inference_network_fn → model_eval_fn      (EVAL, averaged)
+
+Checkpoints are Orbax (``train/checkpoints.py``); export and hooks attach
+through the callback protocol (the reference's HookBuilder surface).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.specs import SpecStruct, algebra
+from tensor2robot_tpu.train import checkpoints as ckpt_lib
+from tensor2robot_tpu.train.train_state import (TrainState, apply_ema,
+                                                create_train_state)
+
+Batch = Tuple[Any, Any]
+MetricDict = Dict[str, float]
+
+
+class TrainerCallback:
+  """Hook surface, replacing SessionRunHooks/HookBuilders (hooks/*.py)."""
+
+  def begin(self, trainer: 'Trainer') -> None:
+    ...
+
+  def after_step(self, trainer: 'Trainer', step: int,
+                 scalars: MetricDict) -> None:
+    ...
+
+  def after_checkpoint(self, trainer: 'Trainer', step: int) -> None:
+    ...
+
+  def after_eval(self, trainer: 'Trainer', step: int,
+                 metrics: MetricDict) -> None:
+    ...
+
+  def end(self, trainer: 'Trainer') -> None:
+    ...
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+  """Run configuration (the reference's RunConfig + TrainSpec/EvalSpec)."""
+
+  model_dir: str = ''
+  max_train_steps: int = 1000
+  eval_steps: int = 10          # batches per eval pass
+  eval_interval_steps: int = 500  # train steps between eval passes
+  save_interval_steps: int = 500
+  max_checkpoints_to_keep: Optional[int] = 5
+  keep_checkpoint_period: Optional[int] = None
+  log_interval_steps: int = 100
+  seed: int = 0
+  async_checkpoints: bool = True
+
+
+def _mean_metrics(metric_batches: List[MetricDict]) -> MetricDict:
+  if not metric_batches:
+    return {}
+  keys = metric_batches[0].keys()
+  return {
+      k: float(np.mean([float(m[k]) for m in metric_batches])) for k in keys
+  }
+
+
+class Trainer:
+  """Owns the jitted step functions, state, and checkpoint manager."""
+
+  def __init__(self,
+               model,
+               config: TrainerConfig,
+               mesh: Optional[jax.sharding.Mesh] = None,
+               callbacks: Sequence[TrainerCallback] = ()):
+    self._model = model
+    self._config = config
+    self._mesh = mesh if mesh is not None else mesh_lib.single_device_mesh()
+    self._callbacks = list(callbacks)
+    self._preprocessor = model.preprocessor
+    self._optimizer = model.create_optimizer()
+    self._state: Optional[TrainState] = None
+    self._train_step_fn = None
+    self._eval_step_fn = None
+    self._manager: Optional[ckpt_lib.CheckpointManager] = None
+    if config.model_dir:
+      self._manager = ckpt_lib.CheckpointManager(
+          os.path.join(config.model_dir, 'checkpoints'),
+          max_to_keep=config.max_checkpoints_to_keep,
+          keep_period=config.keep_checkpoint_period,
+          save_interval_steps=config.save_interval_steps,
+          async_save=config.async_checkpoints)
+
+  # ------------------------------------------------------------- properties
+
+  @property
+  def model(self):
+    return self._model
+
+  @property
+  def config(self) -> TrainerConfig:
+    return self._config
+
+  @property
+  def mesh(self) -> jax.sharding.Mesh:
+    return self._mesh
+
+  @property
+  def state(self) -> Optional[TrainState]:
+    return self._state
+
+  @property
+  def step(self) -> int:
+    return 0 if self._state is None else int(self._state.step)
+
+  @property
+  def checkpoint_manager(self) -> Optional[ckpt_lib.CheckpointManager]:
+    return self._manager
+
+  # ------------------------------------------------------------ step builds
+
+  def _build_train_step(self):
+    model = self._model
+    preprocessor = self._preprocessor
+    optimizer = self._optimizer
+    decay = model.avg_model_params_decay
+
+    def train_step(state: TrainState, features, labels):
+      step_rng = jax.random.fold_in(state.rng, state.step)
+      pre_rng, net_rng = jax.random.split(step_rng)
+      features_p, labels_p = preprocessor.preprocess(
+          features, labels, ModeKeys.TRAIN, pre_rng)
+
+      def loss_fn(params):
+        variables = dict(state.model_state)
+        variables['params'] = params
+        outputs, new_variables = model.inference_network_fn(
+            variables, features_p, labels_p, ModeKeys.TRAIN, net_rng)
+        loss, scalars = model.model_train_fn(
+            features_p, labels_p, outputs, ModeKeys.TRAIN)
+        new_model_state = {
+            k: v for k, v in dict(new_variables).items() if k != 'params'
+        }
+        return loss, (scalars, new_model_state)
+
+      grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+      (loss, (scalars, new_model_state)), grads = grad_fn(state.params)
+      updates, new_opt_state = optimizer.update(
+          grads, state.opt_state, state.params)
+      new_params = optax.apply_updates(state.params, updates)
+      new_state = state.replace(
+          step=state.step + 1,
+          params=new_params,
+          model_state=new_model_state,
+          opt_state=new_opt_state,
+          ema_params=apply_ema(state, new_params, decay))
+      scalars = dict(scalars)
+      scalars['loss'] = loss
+      return new_state, scalars
+
+    state_sharding = self._state_sharding()
+    batch_sharding = mesh_lib.batch_sharding(self._mesh)
+    return jax.jit(
+        train_step,
+        in_shardings=(state_sharding, batch_sharding, batch_sharding),
+        out_shardings=(state_sharding, None),
+        donate_argnums=(0,))
+
+  def _build_eval_step(self):
+    model = self._model
+    preprocessor = self._preprocessor
+
+    def eval_step(state: TrainState, features, labels):
+      features_p, labels_p = preprocessor.preprocess(
+          features, labels, ModeKeys.EVAL, None)
+      outputs, _ = model.inference_network_fn(
+          dict(state.eval_variables), features_p, labels_p, ModeKeys.EVAL)
+      return model.model_eval_fn(features_p, labels_p, outputs)
+
+    state_sharding = self._state_sharding()
+    batch_sharding = mesh_lib.batch_sharding(self._mesh)
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_sharding, batch_sharding, batch_sharding))
+
+  def _state_sharding(self):
+    if self._state is None:
+      raise ValueError('State must be initialized before building steps.')
+    return mesh_lib.state_shardings_for(self._mesh, self._state)
+
+  # ------------------------------------------------------- state lifecycle
+
+  def initialize(self, features, labels=None) -> TrainState:
+    """Creates (or restores) the train state from spec-shaped features."""
+    del labels
+    rng = jax.random.PRNGKey(self._config.seed)
+    pre_rng, init_rng = jax.random.split(rng)
+    # Initialize from *preprocessed* features: the device-side contract.
+    features_p, _ = self._preprocessor.preprocess(
+        features, None, ModeKeys.TRAIN, pre_rng)
+    self._state = create_train_state(
+        self._model, self._optimizer, init_rng, features_p, ModeKeys.TRAIN)
+    if self._manager is not None and self._manager.latest_step() is not None:
+      restored = self._manager.restore(self._state)
+      if restored is not None:
+        self._state = restored
+    # Place the state according to mesh rules (replicated or fsdp-sharded).
+    sharding = self._state_sharding()
+    self._state = jax.tree_util.tree_map(
+        lambda x, s: x if x is None else jax.device_put(x, s),
+        self._state, sharding, is_leaf=lambda x: x is None)
+    self._train_step_fn = self._build_train_step()
+    self._eval_step_fn = self._build_eval_step()
+    return self._state
+
+  def save_checkpoint(self, force: bool = False) -> None:
+    if self._manager is None or self._state is None:
+      return
+    if self._manager.save(self.step, self._state, force=force):
+      for cb in self._callbacks:
+        cb.after_checkpoint(self, self.step)
+
+  # ------------------------------------------------------------------ loops
+
+  def train(self,
+            train_iter: Iterator[Batch],
+            eval_iter_fn: Optional[Callable[[], Iterator[Batch]]] = None
+            ) -> MetricDict:
+    """Interleaved train/eval loop (train_and_evaluate semantics)."""
+    config = self._config
+    if self._state is None:
+      features, labels = next(train_iter)
+      self.initialize(features)
+      first_batch: Optional[Batch] = (features, labels)
+    else:
+      first_batch = None
+
+    for cb in self._callbacks:
+      cb.begin(self)
+
+    scalars: MetricDict = {}
+    eval_metrics: MetricDict = {}
+    last_log = time.time()
+    while self.step < config.max_train_steps:
+      if first_batch is not None:
+        features, labels = first_batch
+        first_batch = None
+      else:
+        features, labels = next(train_iter)
+      features = mesh_lib.shard_batch(features, self._mesh)
+      labels = mesh_lib.shard_batch(labels, self._mesh)
+      self._state, scalars = self._train_step_fn(
+          self._state, features, labels)
+      step = self.step
+      if config.log_interval_steps and step % config.log_interval_steps == 0:
+        scalars = {k: float(v) for k, v in scalars.items()}
+        dt = time.time() - last_log
+        last_log = time.time()
+        scalars['steps_per_sec'] = config.log_interval_steps / max(dt, 1e-9)
+      for cb in self._callbacks:
+        cb.after_step(self, step, scalars)
+      if (self._manager is not None and
+          step % config.save_interval_steps == 0):
+        self.save_checkpoint()
+      if (eval_iter_fn is not None and config.eval_interval_steps and
+          (step % config.eval_interval_steps == 0 or
+           step >= config.max_train_steps)):
+        eval_metrics = self.evaluate(eval_iter_fn())
+    self.save_checkpoint(force=True)
+    if self._manager is not None:
+      self._manager.wait_until_finished()
+    if eval_iter_fn is not None and not eval_metrics:
+      eval_metrics = self.evaluate(eval_iter_fn())
+    for cb in self._callbacks:
+      cb.end(self)
+    return eval_metrics or scalars
+
+  def evaluate(self, eval_iter: Iterator[Batch]) -> MetricDict:
+    config = self._config
+    if self._state is None:
+      features, labels = next(eval_iter)
+      self.initialize(features)
+      batches: List[Batch] = [(features, labels)]
+    else:
+      batches = []
+    metric_batches: List[MetricDict] = []
+    for _ in range(config.eval_steps):
+      if batches:
+        features, labels = batches.pop()
+      else:
+        try:
+          features, labels = next(eval_iter)
+        except StopIteration:
+          break
+      features = mesh_lib.shard_batch(features, self._mesh)
+      labels = mesh_lib.shard_batch(labels, self._mesh)
+      metrics = self._eval_step_fn(self._state, features, labels)
+      metric_batches.append({k: float(v) for k, v in metrics.items()})
+    metrics = _mean_metrics(metric_batches)
+    for cb in self._callbacks:
+      cb.after_eval(self, self.step, metrics)
+    return metrics
+
+  def predict(self, features) -> SpecStruct:
+    """Single PREDICT-mode forward pass on numpy features."""
+    if self._state is None:
+      self.initialize(features)
+    features_p, _ = self._preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT, None)
+    outputs, _ = self._model.inference_network_fn(
+        dict(self._state.eval_variables), features_p, None, ModeKeys.PREDICT)
+    return self._model.create_export_outputs_fn(features_p, outputs)
+
+  def close(self) -> None:
+    if self._manager is not None:
+      self._manager.wait_until_finished()
+      self._manager.close()
+
+
+# ------------------------------------------------------------ driver entry
+
+
+def provide_input_generator_with_model_information(input_generator, model,
+                                                   mode: str):
+  """Spec handshake (utils/train_eval.py:101-129)."""
+  input_generator.set_specification_from_model(model, mode)
+  return input_generator
+
+
+def train_eval_model(model=None,
+                     model_dir: str = '',
+                     train_input_generator=None,
+                     eval_input_generator=None,
+                     max_train_steps: int = 1000,
+                     eval_steps: int = 10,
+                     eval_interval_steps: int = 500,
+                     save_interval_steps: int = 500,
+                     max_checkpoints_to_keep: Optional[int] = 5,
+                     log_interval_steps: int = 100,
+                     seed: int = 0,
+                     mesh: Optional[jax.sharding.Mesh] = None,
+                     callbacks: Sequence[TrainerCallback] = (),
+                     create_exporters_fn=None,
+                     use_continuous_eval: bool = False,
+                     eval_timeout_secs: Optional[float] = 30.0
+                     ) -> MetricDict:
+  """The reference's `train_eval_model` entry (utils/train_eval.py:394-587).
+
+  * train + eval generators → interleaved train/eval (+ export on eval).
+  * train generator only → train-only job.
+  * eval generator only + ``use_continuous_eval`` → watch ``model_dir`` for
+    new checkpoints, evaluate each, and run exporters.
+  """
+  if model is None:
+    raise ValueError('train_eval_model requires a model.')
+  config = TrainerConfig(
+      model_dir=model_dir,
+      max_train_steps=max_train_steps,
+      eval_steps=eval_steps,
+      eval_interval_steps=eval_interval_steps,
+      save_interval_steps=save_interval_steps,
+      max_checkpoints_to_keep=max_checkpoints_to_keep,
+      log_interval_steps=log_interval_steps,
+      seed=seed)
+  callbacks = list(callbacks)
+  exporters = []
+  if create_exporters_fn is not None:
+    exporters = list(create_exporters_fn(model))
+
+  trainer = Trainer(model, config, mesh=mesh, callbacks=callbacks)
+
+  if train_input_generator is not None:
+    provide_input_generator_with_model_information(
+        train_input_generator, model, ModeKeys.TRAIN)
+  if eval_input_generator is not None:
+    provide_input_generator_with_model_information(
+        eval_input_generator, model, ModeKeys.EVAL)
+
+  def run_exporters(metrics: MetricDict) -> None:
+    for exporter in exporters:
+      exporter.export(trainer, metrics)
+
+  try:
+    if train_input_generator is not None:
+      train_iter = train_input_generator.create_iterator(ModeKeys.TRAIN)
+      eval_iter_fn = None
+      if eval_input_generator is not None:
+        eval_iter_fn = lambda: eval_input_generator.create_iterator(
+            ModeKeys.EVAL)
+      metrics = trainer.train(train_iter, eval_iter_fn)
+      if exporters:
+        run_exporters(metrics)
+      return metrics
+    if eval_input_generator is None:
+      raise ValueError('Need a train or eval input generator.')
+    # Continuous-eval job over appearing checkpoints
+    # (utils/train_eval.py:550-585).
+    metrics = {}
+    ckpt_dir = os.path.join(model_dir, 'checkpoints')
+    for step in ckpt_lib.checkpoints_iterator(
+        ckpt_dir,
+        timeout=eval_timeout_secs,
+        stop_after_step=max_train_steps if use_continuous_eval else None):
+      eval_iter = eval_input_generator.create_iterator(ModeKeys.EVAL)
+      if trainer.state is None:
+        features, _ = next(eval_input_generator.create_iterator(ModeKeys.EVAL))
+        trainer.initialize(features)
+      restored = trainer.checkpoint_manager.restore(trainer.state, step=step)
+      if restored is not None:
+        trainer._state = restored  # pylint: disable=protected-access
+      metrics = trainer.evaluate(eval_iter)
+      if exporters:
+        run_exporters(metrics)
+      if not use_continuous_eval:
+        break
+    return metrics
+  finally:
+    trainer.close()
+
+
+def predict_from_model(model=None,
+                       input_generator=None,
+                       model_dir: str = '',
+                       mesh: Optional[jax.sharding.Mesh] = None):
+  """Streams predictions batch-by-batch (utils/train_eval.py:364-391)."""
+  if model is None or input_generator is None:
+    raise ValueError('predict_from_model requires model and input generator.')
+  config = TrainerConfig(model_dir=model_dir, async_checkpoints=False)
+  trainer = Trainer(model, config, mesh=mesh)
+  provide_input_generator_with_model_information(
+      input_generator, model, ModeKeys.PREDICT)
+  for features, _ in input_generator.create_iterator(ModeKeys.PREDICT):
+    yield trainer.predict(features)

@@ -1,0 +1,75 @@
+"""Robot-side collect/eval loop: restore policy → run episodes → repeat.
+
+Capability-equivalent of
+``/root/reference/utils/continuous_collect_eval.py:32-113``. The
+trainer↔robot distribution pattern is identical: the trainer writes
+versioned exports/checkpoints to a shared filesystem and this loop polls,
+hot-reloads the policy, and rolls out collect + eval episodes until the
+policy's global step reaches ``max_steps``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+
+def collect_eval_loop(collect_env,
+                      eval_env,
+                      policy_class: Callable,
+                      num_collect: int = 2000,
+                      num_eval: int = 100,
+                      run_agent_fn: Optional[Callable] = None,
+                      root_dir: str = '',
+                      continuous: bool = False,
+                      min_collect_eval_step: int = 0,
+                      max_steps: int = 1,
+                      pre_collect_eval_fn: Optional[Callable] = None,
+                      record_eval_env_video: bool = False,
+                      init_with_random_variables: bool = False,
+                      poll_interval_secs: float = 10.0) -> None:
+  """Runs the collect/eval agent loop (continuous_collect_eval.py:32-113)."""
+  if run_agent_fn is None:
+    from tensor2robot_tpu.research.dql_grasping_lib import run_env
+
+    run_agent_fn = run_env.run_env
+  if pre_collect_eval_fn:
+    pre_collect_eval_fn()
+
+  collect_dir = os.path.join(root_dir, 'policy_collect')
+  eval_dir = os.path.join(root_dir, 'eval')
+
+  policy = policy_class()
+  prev_global_step = -1
+  while True:
+    if hasattr(policy, 'restore'):
+      if init_with_random_variables:
+        policy.init_randomly()
+      else:
+        policy.restore()
+    global_step = policy.global_step
+
+    if (global_step is None or global_step < min_collect_eval_step or
+        global_step <= prev_global_step):
+      if not continuous and init_with_random_variables:
+        pass  # random init always proceeds once
+      else:
+        time.sleep(poll_interval_secs)
+        continue
+
+    if collect_env:
+      run_agent_fn(collect_env, policy=policy, num_episodes=num_collect,
+                   root_dir=collect_dir, global_step=global_step,
+                   tag='collect')
+    if eval_env:
+      if record_eval_env_video and hasattr(eval_env, 'set_video_output_dir'):
+        eval_env.set_video_output_dir(
+            os.path.join(root_dir, 'videos', str(global_step)))
+      run_agent_fn(eval_env, policy=policy, num_episodes=num_eval,
+                   root_dir=eval_dir, global_step=global_step, tag='eval')
+    if not continuous or global_step >= max_steps:
+      logging.info('Completed collect/eval on final ckpt.')
+      break
+    prev_global_step = global_step

@@ -1,0 +1,86 @@
+"""Cross-entropy method (CEM): generic maximizer used by critic policies.
+
+Capability-equivalent of ``/root/reference/utils/cross_entropy.py:35-159``.
+Same functional decomposition (sample_fn / objective_fn / update_fn, elite
+selection, optional early termination) with vectorized numpy selection
+instead of per-sample Python sorts — the objective (a jitted critic call)
+dominates runtime either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+SampleBatch = Union[np.ndarray, Dict[str, np.ndarray]]
+
+
+def cross_entropy_method(sample_fn: Callable[..., SampleBatch],
+                         objective_fn: Callable[[SampleBatch], np.ndarray],
+                         update_fn: Callable[[Dict, SampleBatch], Dict],
+                         initial_params: Dict[str, Any],
+                         num_elites: int,
+                         num_iterations: int = 1,
+                         threshold_to_terminate: Optional[float] = None
+                         ) -> Tuple[SampleBatch, np.ndarray, Dict]:
+  """Maximizes ``objective_fn`` over samples from ``sample_fn``.
+
+  Returns (final_samples, final_values, final_params) — the contract of
+  the reference's ``CrossEntropyMethod``.
+  """
+  updated_params = initial_params
+  samples: SampleBatch = None
+  values = None
+  for _ in range(num_iterations):
+    samples = sample_fn(**updated_params)
+    values = np.asarray(objective_fn(samples)).reshape(-1)
+    elite_idx = np.argsort(values)[-num_elites:]
+    if isinstance(samples, dict):
+      elite_samples = {k: np.asarray(v)[elite_idx] for k, v in samples.items()}
+    else:
+      elite_samples = np.asarray(samples)[elite_idx]
+    updated_params = update_fn(updated_params, elite_samples)
+    if (threshold_to_terminate is not None and
+        float(np.max(values)) > threshold_to_terminate):
+      break
+  return samples, values, updated_params
+
+
+def normal_cross_entropy_method(objective_fn,
+                                mean,
+                                stddev,
+                                num_samples: int,
+                                num_elites: int,
+                                num_iterations: int = 1,
+                                rng: Optional[np.random.RandomState] = None
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+  """CEM with a diagonal-normal sampler (cross_entropy.py:117-159).
+
+  Returns the final (mean, stddev).
+  """
+  rng = rng or np.random
+  size = np.broadcast(np.asarray(mean), np.asarray(stddev)).size
+
+  def sample_fn(mean, stddev):
+    return np.asarray(mean) + np.asarray(stddev) * rng.randn(
+        num_samples, size)
+
+  def update_fn(params, elite_samples):
+    del params
+    return {
+        'mean': np.mean(elite_samples, axis=0),
+        # Bessel's correction, matching the reference.
+        'stddev': np.std(elite_samples, axis=0, ddof=1),
+    }
+
+  _, _, final_params = cross_entropy_method(
+      sample_fn, objective_fn, update_fn,
+      {'mean': mean, 'stddev': stddev},
+      num_elites, num_iterations=num_iterations)
+  return final_params['mean'], final_params['stddev']
+
+
+# Reference-name aliases.
+CrossEntropyMethod = cross_entropy_method
+NormalCrossEntropyMethod = normal_cross_entropy_method

@@ -1,0 +1,51 @@
+"""Step-dependent schedules: piecewise linear + exponential decay.
+
+Capability-equivalent of
+``/root/reference/utils/global_step_functions.py:33-130``. The reference
+returns tensors of the implicit global step; here schedules are pure
+``fn(step) -> value`` callables (optax-compatible) — the explicit-step
+form the trainer's functional state requires.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def piecewise_linear(boundaries: Sequence[float],
+                     values: Sequence[float]):
+  """Linear interpolation between (boundary, value) knots.
+
+  Returns ``values[0]`` before the first boundary, ``values[-1]`` after the
+  last, and the linear interpolation in between
+  (global_step_functions.py:33-100).
+  """
+  if not boundaries or not values:
+    raise AssertionError('Need more than 0 boundaries/values')
+  if len(boundaries) != len(values):
+    raise AssertionError('boundaries and values must be of same size')
+  boundaries = jnp.asarray(boundaries, jnp.float32)
+  values = jnp.asarray(values, jnp.float32)
+
+  def schedule(step):
+    x = jnp.asarray(step, jnp.float32)
+    return jnp.interp(x, boundaries, values)
+
+  return schedule
+
+
+def exponential_decay(initial_value: float = 0.0001,
+                      decay_steps: int = 10000,
+                      decay_rate: float = 0.9,
+                      staircase: bool = True):
+  """value * rate^(step/decay_steps) (global_step_functions.py:104-130)."""
+
+  def schedule(step):
+    exponent = jnp.asarray(step, jnp.float32) / decay_steps
+    if staircase:
+      exponent = jnp.floor(exponent)
+    return initial_value * jnp.power(decay_rate, exponent)
+
+  return schedule

@@ -1,0 +1,32 @@
+"""Image encoding helpers (reference: utils/image.py:29-70)."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+from PIL import Image
+
+
+def jpeg_string(image: Image.Image, jpeg_quality: int = 90) -> bytes:
+  """Encodes a PIL image as JPEG bytes (image.py:29-44)."""
+  buf = io.BytesIO()
+  image.save(buf, 'JPEG', quality=jpeg_quality)
+  return buf.getvalue()
+
+
+def png_string(image: Image.Image) -> bytes:
+  buf = io.BytesIO()
+  image.save(buf, 'PNG')
+  return buf.getvalue()
+
+
+def numpy_to_image_string(image_array: np.ndarray,
+                          image_format: str = 'jpeg',
+                          dtype=np.uint8) -> bytes:
+  """ndarray → encoded image bytes (image.py:47-70)."""
+  image_array = np.asarray(image_array, dtype=dtype)
+  pil_image = Image.fromarray(image_array)
+  buf = io.BytesIO()
+  pil_image.save(buf, image_format.upper(), quality=90)
+  return buf.getvalue()

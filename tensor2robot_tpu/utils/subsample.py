@@ -1,0 +1,120 @@
+"""Sequence subsampling: fixed-length index selection keeping endpoints.
+
+Capability-equivalent of ``/root/reference/utils/subsample.py:25-187``:
+pick ``min_length`` timesteps from each padded sequence, always including
+the first and last frame; without replacement when the sequence is long
+enough, with replacement otherwise; ``min_length == 1`` picks one random
+frame. Implemented with ``jax.vmap`` + masked sort instead of
+``tf.map_fn`` + ``tf.cond`` so it jits onto TPU, plus a numpy twin for
+host-side pipelines (reference ``:162-187``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_subsample_indices(rng: jax.Array,
+                          sequence_lengths: jnp.ndarray,
+                          min_length: int) -> jnp.ndarray:
+  """[B] lengths → [B, min_length] sorted indices (subsample.py:25-82)."""
+  sequence_lengths = jnp.asarray(sequence_lengths, jnp.int32)
+  batch = sequence_lengths.shape[0]
+  max_len = 1 << 30
+
+  def per_sequence(rng, seq_len):
+    if min_length == 1:
+      u = jax.random.uniform(rng, (1,))
+      return jnp.floor(u * seq_len).astype(jnp.int32)
+    # Without replacement: random permutation of [1, seq_len-1) via masked
+    # random keys — padding positions get +inf keys so they sort last.
+    perm_rng, unif_rng = jax.random.split(rng)
+    # Middle candidates are positions 1..T-2 (static upper bound needed; use
+    # uniform keys masked by validity).
+    upper = sequence_lengths.max() if sequence_lengths.size else min_length
+    del upper  # static bound comes from the caller's padded data
+    n = int(_static_upper_bound)
+    positions = jnp.arange(1, n - 1)
+    keys = jax.random.uniform(perm_rng, (n - 2,))
+    valid = positions < (seq_len - 1)
+    keys = jnp.where(valid, keys, jnp.inf)
+    order = jnp.argsort(keys)
+    middle_wo = jnp.sort(positions[order[:min_length - 2]])
+    # With replacement: floor(uniform * seq_len).
+    u = jax.random.uniform(unif_rng, (min_length - 2,))
+    middle_w = jnp.sort(jnp.floor(u * seq_len).astype(jnp.int32))
+    use_wo = seq_len >= min_length
+    middle = jnp.where(use_wo, middle_wo, middle_w)
+    return jnp.sort(jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), middle.astype(jnp.int32),
+         jnp.asarray([seq_len - 1], jnp.int32)]))
+
+  del max_len
+  rngs = jax.random.split(rng, batch)
+  return jax.vmap(per_sequence)(rngs, sequence_lengths)
+
+
+# Static bound for the without-replacement candidate range. Callers with
+# longer sequences should set this before tracing (or use the numpy twin).
+_static_upper_bound = 512
+
+
+def set_max_sequence_length(n: int) -> None:
+  global _static_upper_bound
+  _static_upper_bound = int(n)
+
+
+def get_subsample_indices_randomized_boundary(
+    rng: jax.Array,
+    sequence_lengths: jnp.ndarray,
+    min_length: int,
+    min_delta_t: int,
+    max_delta_t: int) -> jnp.ndarray:
+  """Randomized start/end window variant (subsample.py:84-160).
+
+  Samples a window [t0, t0+delta_t) inside each sequence, then subsamples
+  ``min_length`` indices inside it keeping the window endpoints.
+  """
+  sequence_lengths = jnp.asarray(sequence_lengths, jnp.int32)
+  batch = sequence_lengths.shape[0]
+
+  def per_sequence(rng, seq_len):
+    dt_rng, t0_rng, sub_rng = jax.random.split(rng, 3)
+    max_dt = jnp.minimum(max_delta_t, seq_len)
+    min_dt = jnp.minimum(min_delta_t, max_dt)
+    u = jax.random.uniform(dt_rng)
+    delta_t = (min_dt + jnp.floor(u * (max_dt - min_dt + 1))).astype(
+        jnp.int32)
+    delta_t = jnp.clip(delta_t, 2, seq_len)
+    u0 = jax.random.uniform(t0_rng)
+    t0 = jnp.floor(u0 * (seq_len - delta_t + 1)).astype(jnp.int32)
+    inner = get_subsample_indices(
+        sub_rng, jnp.asarray([delta_t]), min_length)[0]
+    return t0 + inner
+
+  rngs = jax.random.split(rng, batch)
+  return jax.vmap(per_sequence)(rngs, sequence_lengths)
+
+
+def get_np_subsample_indices(sequence_lengths: np.ndarray,
+                             min_length: int,
+                             rng: Optional[np.random.RandomState] = None
+                             ) -> np.ndarray:
+  """Numpy twin for host pipelines (subsample.py:162-187)."""
+  rng = rng or np.random
+  out = []
+  for seq_len in np.asarray(sequence_lengths, np.int64):
+    if min_length == 1:
+      out.append(np.floor(rng.uniform(size=1) * seq_len).astype(np.int64))
+      continue
+    if seq_len >= min_length:
+      middle = rng.permutation(np.arange(1, seq_len - 1))[:min_length - 2]
+    else:
+      middle = np.floor(
+          rng.uniform(size=min_length - 2) * seq_len).astype(np.int64)
+    out.append(np.sort(np.concatenate([[0], middle, [seq_len - 1]])))
+  return np.stack(out).astype(np.int64)

@@ -1,0 +1,86 @@
+"""Test fixture: train any model 2 steps on random/record data.
+
+Capability-equivalent of ``/root/reference/utils/t2r_test_fixture.py:
+37-128`` (``T2RModelFixture``): instantiate a named model, run a short
+train_eval, assert output artifacts. Used by every research-model smoke
+test.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Type
+
+from tensor2robot_tpu.data.input_generators import (
+    DefaultRandomInputGenerator,
+    DefaultRecordInputGenerator,
+)
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.train import latest_checkpoint_step, train_eval_model
+
+TRAIN = ModeKeys.TRAIN
+EVAL = ModeKeys.EVAL
+
+
+def assert_output_files(model_dir: str) -> None:
+  """Trainer artifacts exist (train_eval_test_utils.py:37-68)."""
+  ckpt_dir = os.path.join(model_dir, 'checkpoints')
+  assert latest_checkpoint_step(ckpt_dir) is not None, (
+      f'No checkpoints written under {ckpt_dir}')
+
+
+class T2RModelFixture:
+  """Runs short train/predict cycles for smoke tests."""
+
+  def __init__(self, test_case=None, use_tpu: bool = True):
+    self._test_case = test_case
+    self._use_tpu = use_tpu
+
+  def random_train(self,
+                   module_name: Optional[str] = None,
+                   model_name: Optional[Type] = None,
+                   model_dir: str = '/tmp/t2r_fixture',
+                   batch_size: int = 4,
+                   max_train_steps: int = 2,
+                   model_kwargs: Optional[Dict[str, Any]] = None,
+                   **kwargs) -> Dict[str, float]:
+    """Trains the model N steps on spec-shaped random data."""
+    del module_name
+    model = model_name(**(model_kwargs or {}))
+    metrics = train_eval_model(
+        model=model,
+        model_dir=model_dir,
+        train_input_generator=DefaultRandomInputGenerator(
+            batch_size=batch_size),
+        max_train_steps=max_train_steps,
+        eval_interval_steps=0,
+        save_interval_steps=max_train_steps,
+        log_interval_steps=0,
+        **kwargs)
+    assert_output_files(model_dir)
+    return metrics
+
+  def recordio_train(self,
+                     module_name: Optional[str] = None,
+                     model_name: Optional[Type] = None,
+                     file_patterns: str = '',
+                     model_dir: str = '/tmp/t2r_fixture',
+                     batch_size: int = 4,
+                     max_train_steps: int = 2,
+                     model_kwargs: Optional[Dict[str, Any]] = None,
+                     **kwargs) -> Dict[str, float]:
+    """Trains the model N steps on record data."""
+    del module_name
+    model = model_name(**(model_kwargs or {}))
+    metrics = train_eval_model(
+        model=model,
+        model_dir=model_dir,
+        train_input_generator=DefaultRecordInputGenerator(
+            file_patterns=file_patterns, batch_size=batch_size),
+        max_train_steps=max_train_steps,
+        eval_interval_steps=0,
+        save_interval_steps=max_train_steps,
+        log_interval_steps=0,
+        **kwargs)
+    assert_output_files(model_dir)
+    return metrics

@@ -1,0 +1,54 @@
+"""Test helpers for trainer runs (reference: utils/train_eval_test_utils.py).
+
+``assert_output_files`` checks trainer artifacts; ``test_train_eval_gin``
+runs a full gin config for N steps — the reference's config-level
+integration test entry (``train_eval_test_utils.py:37-120``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from tensor2robot_tpu import config as t2r_config
+from tensor2robot_tpu.train import latest_checkpoint_step
+
+
+def assert_output_files(test_case=None,
+                        model_dir: str = '',
+                        expected_output_filename_patterns=None) -> None:
+  """Asserts trainer artifacts exist under model_dir."""
+  del expected_output_filename_patterns
+  ckpt_dir = os.path.join(model_dir, 'checkpoints')
+  step = latest_checkpoint_step(ckpt_dir)
+  message = f'No checkpoints under {ckpt_dir}'
+  if test_case is not None:
+    test_case.assertIsNotNone(step, message)
+  else:
+    assert step is not None, message
+
+
+def test_train_eval_gin(test_case=None,
+                        model_dir: str = '',
+                        full_gin_path: Optional[str] = None,
+                        max_train_steps: int = 2,
+                        eval_steps: int = 1,
+                        gin_overwrites: Sequence[str] = ()) -> dict:
+  """Runs a full gin config for a few steps and asserts artifacts."""
+  t2r_config.register_framework_configurables()
+  t2r_config.clear_config()
+  bindings = list(gin_overwrites) + [
+      f"train_eval_model.model_dir = '{model_dir}'",
+      f'train_eval_model.max_train_steps = {max_train_steps}',
+      f'train_eval_model.eval_steps = {eval_steps}',
+      'train_eval_model.eval_interval_steps = 0',
+      'train_eval_model.log_interval_steps = 0',
+      f'train_eval_model.save_interval_steps = {max_train_steps}',
+  ]
+  t2r_config.parse_config_files_and_bindings(
+      config_files=[full_gin_path] if full_gin_path else None,
+      bindings=bindings)
+  train_eval_model = t2r_config.get_configurable('train_eval_model')
+  metrics = train_eval_model()
+  assert_output_files(test_case, model_dir)
+  return metrics

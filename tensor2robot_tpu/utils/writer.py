@@ -1,0 +1,45 @@
+"""Replay writer: episode transitions → tfrecord shards.
+
+Capability-equivalent of ``/root/reference/utils/writer.py:31-70``.
+Transitions are serialized tf.Example bytes (as produced by
+``data.example_codec.encode_example``) or objects exposing
+``SerializeToString``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional, Union
+
+from tensor2robot_tpu.data import records
+
+Transition = Union[bytes, object]
+
+
+class TFRecordReplayWriter:
+  """Appends episodes to a tfrecord replay file (writer.py:31-70)."""
+
+  def __init__(self):
+    self._writer: Optional[records.RecordWriter] = None
+
+  def open(self, path: str) -> None:
+    if self._writer is not None:
+      raise ValueError('Writer is already open!')
+    dirname = os.path.dirname(path)
+    if dirname:
+      os.makedirs(dirname, exist_ok=True)
+    self._writer = records.RecordWriter(path + '.tfrecord')
+
+  def close(self) -> None:
+    if self._writer is None:
+      raise ValueError('Writer is not open!')
+    self._writer.close()
+    self._writer = None
+
+  def write(self, transitions: Iterable[Transition]) -> None:
+    if self._writer is None:
+      raise ValueError('Writer is not open!')
+    for transition in transitions:
+      if hasattr(transition, 'SerializeToString'):
+        transition = transition.SerializeToString()
+      self._writer.write(transition)

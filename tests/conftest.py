@@ -7,7 +7,10 @@ driver dry-runs `__graft_entry__.dryrun_multichip`.
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force CPU: the session environment may preset JAX_PLATFORMS to the real
+# TPU tunnel, but tests must run on the virtual 8-device CPU mesh.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
   os.environ['XLA_FLAGS'] = (
@@ -15,3 +18,10 @@ if '--xla_force_host_platform_device_count' not in xla_flags:
 # Keep TF (host data pipeline only) off any accelerator and quiet.
 os.environ.setdefault('CUDA_VISIBLE_DEVICES', '-1')
 os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')
+
+# The image's sitecustomize pre-imports jax to register the 'axon' TPU
+# backend, so the env var alone is too late — pin the platform through
+# jax.config as well (safe: the backend itself is not initialized yet).
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

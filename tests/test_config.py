@@ -1,0 +1,200 @@
+"""gin_lite config-engine tests: syntax, references, scopes, macros."""
+
+import pytest
+
+from tensor2robot_tpu.config import gin_lite
+
+
+@pytest.fixture(autouse=True)
+def clean_config():
+  gin_lite.clear_config()
+  yield
+  gin_lite.clear_config()
+
+
+def _fresh_name(base):
+  import itertools
+  for i in itertools.count():
+    name = f'{base}_{i}'
+    try:
+      gin_lite.get_configurable(name)
+    except gin_lite.ConfigError:
+      return name
+
+
+def test_function_binding():
+  name = _fresh_name('add')
+
+  @gin_lite.configurable(name)
+  def add(a, b=1):
+    return a + b
+
+  gin_lite.parse_config(f'{name}.b = 41')
+  assert add(1) == 42
+  assert add(1, b=2) == 3  # caller wins
+
+
+def test_class_binding_and_reference():
+  cls_name = _fresh_name('Widget')
+  fn_name = _fresh_name('build')
+
+  @gin_lite.configurable(cls_name)
+  class Widget:
+    def __init__(self, size=1, label='x'):
+      self.size = size
+      self.label = label
+
+  @gin_lite.configurable(fn_name)
+  def build(widget=None):
+    return widget
+
+  gin_lite.parse_config([
+      f'{cls_name}.size = 7',
+      f"{cls_name}.label = 'big'",
+      f'{fn_name}.widget = @{cls_name}()',
+  ])
+  w = build()
+  assert isinstance(w, Widget)
+  assert (w.size, w.label) == (7, 'big')
+
+
+def test_uncalled_reference_injects_callable():
+  cls_name = _fresh_name('Thing')
+  fn_name = _fresh_name('make')
+
+  @gin_lite.configurable(cls_name)
+  class Thing:
+    def __init__(self, v=0):
+      self.v = v
+
+  @gin_lite.configurable(fn_name)
+  def make(factory=None):
+    return factory
+
+  gin_lite.parse_config(f'{fn_name}.factory = @{cls_name}')
+  factory = make()
+  assert factory().v == 0
+
+
+def test_scoped_bindings():
+  cls_name = _fresh_name('Gen')
+
+  @gin_lite.configurable(cls_name)
+  class Gen:
+    def __init__(self, n=0):
+      self.n = n
+
+  gin_lite.parse_config([
+      f'{cls_name}.n = 1',
+      f'train/{cls_name}.n = 2',
+  ])
+  assert Gen().n == 1
+  with gin_lite.config_scope('train'):
+    assert Gen().n == 2
+
+
+def test_macros():
+  name = _fresh_name('f')
+
+  @gin_lite.configurable(name)
+  def f(steps=0):
+    return steps
+
+  gin_lite.parse_config([
+      'TRAIN_STEPS = 500',
+      f'{name}.steps = %TRAIN_STEPS',
+  ])
+  assert f() == 500
+
+
+def test_containers_with_references():
+  item = _fresh_name('Item')
+  coll = _fresh_name('collect')
+
+  @gin_lite.configurable(item)
+  class Item:
+    def __init__(self, tag='t'):
+      self.tag = tag
+
+  @gin_lite.configurable(coll)
+  def collect(items=()):
+    return items
+
+  gin_lite.parse_config(f'{coll}.items = [@{item}(), @{item}()]')
+  out = collect()
+  assert len(out) == 2
+  assert all(isinstance(i, Item) for i in out)
+
+
+def test_multiline_and_comments():
+  name = _fresh_name('g')
+
+  @gin_lite.configurable(name)
+  def g(table=None):
+    return table
+
+  gin_lite.parse_config(f"""
+# comment
+{name}.table = {{
+    'a': 1,  # inline comment
+    'b': 2,
+}}
+""")
+  assert g() == {'a': 1, 'b': 2}
+
+
+def test_unknown_parameter_raises():
+  name = _fresh_name('h')
+
+  @gin_lite.configurable(name)
+  def h(a=0):
+    return a
+
+  gin_lite.parse_config(f'{name}.nope = 3')
+  with pytest.raises(gin_lite.ConfigError):
+    h()
+
+
+def test_bind_and_query_parameter():
+  name = _fresh_name('k')
+
+  @gin_lite.configurable(name)
+  def k(x=0):
+    return x
+
+  gin_lite.bind_parameter(f'{name}.x', 9)
+  assert gin_lite.query_parameter(f'{name}.x') == 9
+  assert k() == 9
+
+
+def test_operative_config_tracks_usage():
+  name = _fresh_name('op')
+
+  @gin_lite.configurable(name)
+  def op(y=0):
+    return y
+
+  gin_lite.parse_config(f'{name}.y = 3')
+  assert f'{name}.y' not in gin_lite.operative_config_str()
+  op()
+  assert f'{name}.y = 3' in gin_lite.operative_config_str()
+
+
+def test_e2e_trainer_binary_with_config(tmp_path):
+  """The full binary path: config file → train_eval_model → metrics."""
+  from tensor2robot_tpu.bin import run_t2r_trainer
+
+  config = tmp_path / 'exp.gin'
+  config.write_text(f"""
+train_eval_model.model = @MockT2RModel()
+train_eval_model.train_input_generator = @train/MockInputGenerator()
+train_eval_model.eval_input_generator = @eval/MockInputGenerator()
+train_eval_model.model_dir = '{tmp_path}/model'
+train_eval_model.max_train_steps = 20
+train_eval_model.eval_steps = 2
+train_eval_model.eval_interval_steps = 0
+train_eval_model.log_interval_steps = 0
+MockInputGenerator.batch_size = 8
+""")
+  metrics = run_t2r_trainer.main(['--gin_configs', str(config)])
+  assert 'loss' in metrics

@@ -1,0 +1,241 @@
+"""Export → predictor → policy chain tests.
+
+Mirrors the reference's filesystem-contract tests
+(``hooks/checkpoint_hooks_test.py``, ``hooks/td3_test.py``,
+``predictors/exported_savedmodel_predictor_test.py``,
+``utils/continuous_collect_eval_test.py``).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import export as export_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.policies import RegressionPolicy
+from tensor2robot_tpu.predictors import (CheckpointPredictor,
+                                         ExportedModelPredictor)
+from tensor2robot_tpu.train import Trainer, TrainerConfig
+from tensor2robot_tpu.utils import cross_entropy
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+def _trained_trainer(tmp_path, steps=5, **config_kwargs):
+  model = MockT2RModel(device_type='tpu')
+  config = TrainerConfig(
+      model_dir=str(tmp_path / 'm'), max_train_steps=steps,
+      save_interval_steps=steps, eval_interval_steps=0, log_interval_steps=0,
+      async_checkpoints=False, **config_kwargs)
+  trainer = Trainer(model, config)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+  return trainer, model
+
+
+class TestExporters:
+
+  def test_model_exporter_writes_valid_version(self, tmp_path):
+    trainer, model = _trained_trainer(tmp_path)
+    root = str(tmp_path / 'export')
+    path = export_lib.ModelExporter().export(model, trainer.state, root)
+    assert export_lib.valid_export_dirs(root) == [path]
+    from tensor2robot_tpu.specs import load_specs_from_export_dir
+
+    feature_spec, _, global_step = load_specs_from_export_dir(path)
+    assert global_step == 5
+    assert 'measured_position' in feature_spec
+
+  def test_gc_keeps_newest(self, tmp_path):
+    trainer, model = _trained_trainer(tmp_path)
+    root = str(tmp_path / 'export')
+    exporter = export_lib.ModelExporter(keep=2)
+    paths = [exporter.export(model, trainer.state, root, version=v)
+             for v in (1, 2, 3, 4)]
+    remaining = export_lib.valid_export_dirs(root)
+    assert remaining == paths[-2:]
+
+  def test_best_exporter_only_improves(self, tmp_path):
+    trainer, _ = _trained_trainer(tmp_path)
+    exporter = export_lib.BestExporter(
+        compare_fn=export_lib.create_valid_result_smaller('loss'))
+    assert exporter.export(trainer, {'loss': 1.0}) is not None
+    assert exporter.export(trainer, {'loss': 2.0}) is None  # worse
+    assert exporter.export(trainer, {'loss': 0.5}) is not None
+
+  def test_async_export_callback(self, tmp_path):
+    model = MockT2RModel(device_type='tpu')
+    callback = export_lib.AsyncExportCallback()
+    config = TrainerConfig(
+        model_dir=str(tmp_path / 'm'), max_train_steps=4,
+        save_interval_steps=2, eval_interval_steps=0, log_interval_steps=0,
+        async_checkpoints=False)
+    trainer = Trainer(model, config, callbacks=[callback])
+    gen = MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    callback.join()
+    export_root = os.path.join(
+        str(tmp_path / 'm'), 'export', 'latest_exporter_numpy')
+    assert len(export_lib.valid_export_dirs(export_root)) >= 1
+
+  def test_td3_lagged_export(self, tmp_path):
+    model = MockT2RModel(device_type='tpu')
+    export_dir = str(tmp_path / 'export')
+    lagged_dir = str(tmp_path / 'lagged')
+    callback = export_lib.TD3ExportCallback(export_dir, lagged_dir)
+    config = TrainerConfig(
+        model_dir=str(tmp_path / 'm'), max_train_steps=4,
+        save_interval_steps=2, eval_interval_steps=0, log_interval_steps=0,
+        async_checkpoints=False)
+    trainer = Trainer(model, config, callbacks=[callback])
+    gen = MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    current = export_lib.valid_export_dirs(export_dir)
+    lagged = export_lib.valid_export_dirs(lagged_dir)
+    assert current and lagged
+    from tensor2robot_tpu.specs import load_specs_from_export_dir
+
+    _, _, current_step = load_specs_from_export_dir(current[-1])
+    _, _, lagged_step = load_specs_from_export_dir(lagged[-1])
+    assert lagged_step < current_step  # one version behind
+
+
+class TestPredictors:
+
+  def test_checkpoint_predictor(self, tmp_path):
+    _, _ = _trained_trainer(tmp_path)
+    model = MockT2RModel(device_type='tpu')
+    predictor = CheckpointPredictor(model, model_dir=str(tmp_path / 'm'))
+    assert not predictor.is_loaded
+    assert predictor.restore()
+    assert predictor.global_step == 5
+    features = {'measured_position': np.zeros((4, 2), np.float32)}
+    out = predictor.predict(features)
+    assert out['a_predicted'].shape == (4,)
+
+  def test_checkpoint_predictor_init_randomly(self):
+    model = MockT2RModel(device_type='tpu')
+    predictor = CheckpointPredictor(model, model_dir='/nonexistent')
+    predictor.init_randomly()
+    out = predictor.predict(
+        {'measured_position': np.zeros((2, 2), np.float32)})
+    assert out['a_predicted'].shape == (2,)
+
+  def test_checkpoint_predictor_restore_timeout(self, tmp_path):
+    model = MockT2RModel(device_type='tpu')
+    predictor = CheckpointPredictor(
+        model, model_dir=str(tmp_path / 'none'), restore_timeout_secs=0.1)
+    assert not predictor.restore()
+
+  def test_exported_model_predictor(self, tmp_path):
+    trainer, model = _trained_trainer(tmp_path)
+    root = str(tmp_path / 'export')
+    export_lib.ModelExporter().export(model, trainer.state, root)
+    predictor = ExportedModelPredictor(root)  # rebuilds model from meta
+    assert predictor.restore()
+    assert predictor.global_step == 5
+    out = predictor.predict(
+        {'measured_position': np.zeros((3, 2), np.float32)})
+    assert out['a_predicted'].shape == (3,)
+
+  def test_exported_model_predictor_hot_reload(self, tmp_path):
+    trainer, model = _trained_trainer(tmp_path)
+    root = str(tmp_path / 'export')
+    exporter = export_lib.ModelExporter()
+    exporter.export(model, trainer.state, root, version=1)
+    predictor = ExportedModelPredictor(root, t2r_model=model)
+    assert predictor.restore()
+    state2 = trainer.state.replace(step=trainer.state.step + 100)
+    exporter.export(model, state2, root, version=2)
+    assert predictor.restore()
+    assert predictor.global_step == 105
+
+  def test_predictor_expands_missing_batch_dim(self, tmp_path):
+    model = MockT2RModel(device_type='tpu')
+    predictor = CheckpointPredictor(model, model_dir='')
+    predictor.init_randomly()
+    out = predictor.predict(
+        {'measured_position': np.zeros((2,), np.float32)})  # no batch dim
+    assert out['a_predicted'].shape == (1,)
+
+
+class TestCEM:
+
+  def test_normal_cem_finds_maximum(self):
+    # Objective peaked at x = 3.
+    objective = lambda xs: -np.sum((np.asarray(xs) - 3.0)**2, axis=-1)
+    rng = np.random.RandomState(0)
+    mean, stddev = cross_entropy.normal_cross_entropy_method(
+        objective, mean=np.zeros(2), stddev=np.ones(2) * 2,
+        num_samples=128, num_elites=16, num_iterations=10, rng=rng)
+    np.testing.assert_allclose(mean, [3.0, 3.0], atol=0.2)
+
+  def test_cem_early_termination(self):
+    calls = []
+
+    def sample_fn(mean):
+      calls.append(1)
+      return np.asarray(mean) + np.random.randn(8, 1)
+
+    def objective_fn(samples):
+      return np.sum(samples, axis=-1)
+
+    def update_fn(params, elites):
+      return {'mean': np.mean(elites, axis=0)}
+
+    cross_entropy.cross_entropy_method(
+        sample_fn, objective_fn, update_fn, {'mean': np.zeros(1)},
+        num_elites=2, num_iterations=50, threshold_to_terminate=0.0)
+    assert len(calls) < 50  # terminated early
+
+  def test_dict_sample_batches(self):
+    def sample_fn(mean):
+      return {'a': np.asarray(mean) + np.random.randn(8, 1)}
+
+    def objective_fn(samples):
+      return np.sum(samples['a'], axis=-1)
+
+    def update_fn(params, elites):
+      assert set(elites.keys()) == {'a'}
+      assert elites['a'].shape[0] == 2
+      return {'mean': np.mean(elites['a'], axis=0)}
+
+    samples, values, _ = cross_entropy.cross_entropy_method(
+        sample_fn, objective_fn, update_fn, {'mean': np.zeros(1)},
+        num_elites=2, num_iterations=2)
+    assert set(samples.keys()) == {'a'}
+    assert values.shape == (8,)
+
+
+class TestPolicies:
+
+  def test_regression_policy_with_predictor(self, tmp_path):
+    """Policy → predictor → model chain with a regression mock."""
+
+    class _Pred:
+      global_step = 7
+
+      def predict(self, features):
+        return {'inference_output': np.tile(
+            np.asarray([[1.0, 2.0]]), (len(features['x']), 1))}
+
+      def restore(self):
+        return True
+
+      def init_randomly(self):
+        pass
+
+    class _Model:
+
+      def pack_features(self, state, context, timestep):
+        return {'x': np.asarray([state])}
+
+    policy = RegressionPolicy(t2r_model=_Model(), predictor=_Pred())
+    action = policy.SelectAction(np.zeros(3), None, 0)
+    np.testing.assert_allclose(action, [1.0, 2.0])
+    assert policy.global_step == 7
+    action, debug = policy.sample_action(np.zeros(3), 0.5)
+    assert debug is None

@@ -1,0 +1,111 @@
+"""Grasp2Vec tests (mirrors research/grasp2vec/losses_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.grasp2vec import (
+    Grasp2VecModel,
+    losses,
+    visualization,
+)
+
+
+class TestLosses:
+
+  def test_npairs_loss_prefers_consistent_arithmetic(self):
+    rng = np.random.RandomState(0)
+    goal = rng.randn(8, 16).astype(np.float32)
+    post = rng.randn(8, 16).astype(np.float32)
+    pre_consistent = post + goal
+    pre_random = rng.randn(8, 16).astype(np.float32)
+    loss_good = float(losses.npairs_loss(
+        jnp.asarray(pre_consistent), jnp.asarray(goal), jnp.asarray(post)))
+    loss_bad = float(losses.npairs_loss(
+        jnp.asarray(pre_random), jnp.asarray(goal), jnp.asarray(post)))
+    assert loss_good < loss_bad
+
+  def test_l2_arithmetic_loss_masked(self):
+    pre = jnp.ones((4, 8))
+    goal = jnp.ones((4, 8))
+    post = jnp.zeros((4, 8))
+    # pre - goal - post = 0 → zero loss for all-ones mask.
+    mask = jnp.ones((4,), jnp.int32)
+    assert float(losses.l2_arithmetic_loss(pre, goal, post, mask)) == 0.0
+    # Zero mask → zero loss, not NaN.
+    mask0 = jnp.zeros((4,), jnp.int32)
+    assert float(losses.l2_arithmetic_loss(pre, goal, post, mask0)) == 0.0
+
+  def test_cosine_arithmetic_loss(self):
+    rng = np.random.RandomState(1)
+    goal = rng.randn(4, 8).astype(np.float32)
+    post = rng.randn(4, 8).astype(np.float32)
+    pre = post + goal
+    mask = jnp.ones((4,), jnp.int32)
+    loss = float(losses.cosine_arithmetic_loss(
+        jnp.asarray(pre), jnp.asarray(goal), jnp.asarray(post), mask))
+    assert loss < 0.1  # consistent arithmetic → near-zero cosine distance
+
+  def test_triplet_loss_runs(self):
+    rng = np.random.RandomState(2)
+    loss, pairs, labels = losses.triplet_loss(
+        jnp.asarray(rng.randn(6, 8).astype(np.float32)),
+        jnp.asarray(rng.randn(6, 8).astype(np.float32)),
+        jnp.asarray(rng.randn(6, 8).astype(np.float32)))
+    assert np.isfinite(float(loss))
+    assert pairs.shape == (12, 8)
+    assert labels.shape == (12,)
+
+  def test_keypoint_accuracy_perfect(self):
+    keypoints = jnp.asarray([[0.5, -0.5], [-0.5, 0.5]], jnp.float32)
+    labels = jnp.asarray([0, 3])
+    accuracy, loss = losses.keypoint_accuracy(keypoints, labels)
+    assert float(accuracy) == 1.0
+    assert np.isfinite(float(loss))
+
+
+class TestVisualization:
+
+  def test_softmax_response_localizes(self):
+    scene = np.zeros((1, 4, 4, 8), np.float32)
+    goal = np.zeros((1, 8), np.float32)
+    goal[0, 0] = 1.0
+    scene[0, 2, 3, 0] = 10.0  # goal feature present at (2, 3)
+    heatmap, response = visualization.get_softmax_response(
+        jnp.asarray(goal), jnp.asarray(scene))
+    assert heatmap.shape == (1, 4, 4, 1)
+    idx = np.unravel_index(np.argmax(np.asarray(heatmap)[0, :, :, 0]), (4, 4))
+    assert idx == (2, 3)
+    assert float(response[0]) == pytest.approx(10.0)
+
+
+class TestGrasp2VecModel:
+
+  def test_small_model_trains_step(self):
+    """Tiny resnet18 at 64x64: one full train step on random data."""
+    model = Grasp2VecModel(
+        scene_size=(64, 64), goal_size=(64, 64), resnet_size=18,
+        device_type='cpu')
+    spec = model.preprocessor.get_out_feature_specification(ModeKeys.TRAIN)
+    from tensor2robot_tpu.specs import make_random_numpy
+
+    features = make_random_numpy(spec, batch_size=2)
+    features = {k: jnp.asarray(v) for k, v in features.items()}
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, new_vars = model.inference_network_fn(
+        variables, features, None, ModeKeys.TRAIN)
+    assert outputs['pre_vector'].shape[0] == 2
+    assert outputs['goal_spatial'].ndim == 4
+    loss, scalars = model.model_train_fn(features, None, outputs,
+                                         ModeKeys.TRAIN)
+    assert np.isfinite(float(loss))
+    assert 'embed_loss' in scalars
+
+  def test_preprocessor_specs(self):
+    model = Grasp2VecModel(scene_size=(472, 472), goal_size=(472, 472),
+                           device_type='cpu')
+    in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+    assert in_spec['pregrasp_image'].shape == (512, 640, 3)
+    assert in_spec['pregrasp_image'].dtype == np.uint8

@@ -1,0 +1,260 @@
+"""Layer tests: shapes + numerics, mirroring reference layers/*_test.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import layers
+
+
+class TestSpatialSoftmax:
+
+  def test_expected_points_shape_and_order(self):
+    feats = np.zeros((2, 8, 10, 3), np.float32)
+    points, softmax = layers.spatial_softmax(jnp.asarray(feats))
+    assert points.shape == (2, 6)  # [x1..x3, y1..y3]
+    assert softmax.shape == (2, 8, 10, 3)
+    np.testing.assert_allclose(
+        np.sum(np.asarray(softmax), axis=(1, 2)), np.ones((2, 3)), rtol=1e-5)
+
+  def test_peak_localization(self):
+    # A sharp peak at a known pixel → expected point ≈ that pixel's coords.
+    feats = np.zeros((1, 9, 9, 1), np.float32)
+    feats[0, 2, 6, 0] = 100.0  # row 2, col 6
+    points, _ = layers.spatial_softmax(jnp.asarray(feats))
+    x, y = float(points[0, 0]), float(points[0, 1])
+    assert abs(x - (2 * 6 / 8 - 1)) < 1e-3  # col → x
+    assert abs(y - (2 * 2 / 8 - 1)) < 1e-3  # row → y
+
+  def test_uniform_features_center(self):
+    feats = np.zeros((1, 5, 7, 2), np.float32)
+    points, _ = layers.spatial_softmax(jnp.asarray(feats))
+    np.testing.assert_allclose(np.asarray(points), np.zeros((1, 4)),
+                               atol=1e-6)
+
+  def test_gumbel_softmax_runs(self):
+    feats = np.random.RandomState(0).randn(2, 4, 4, 2).astype(np.float32)
+    points, _ = layers.spatial_softmax(
+        jnp.asarray(feats), spatial_gumbel_softmax=True,
+        rng=jax.random.PRNGKey(0))
+    assert points.shape == (2, 4)
+
+
+class TestMDN:
+
+  def test_param_packing_roundtrip(self):
+    k, d = 3, 2
+    params = np.random.RandomState(0).randn(5, k + 2 * k * d).astype(
+        np.float32)
+    gm = layers.get_mixture_distribution(jnp.asarray(params), k, d)
+    assert gm.logits.shape == (5, k)
+    assert gm.mus.shape == (5, k, d)
+    assert gm.sigmas.shape == (5, k, d)
+    assert np.all(np.asarray(gm.sigmas) > 0)
+
+  def test_log_prob_matches_single_gaussian(self):
+    # K=1 mixture → plain gaussian log density.
+    d = 3
+    params = np.zeros((1, 1 + 2 * d), np.float32)
+    params[0, 1 + d:] = np.log(np.e - 1)  # softplus → 1.0
+    gm = layers.get_mixture_distribution(jnp.asarray(params), 1, d)
+    x = np.zeros((1, d), np.float32)
+    expected = -0.5 * d * np.log(2 * np.pi)
+    np.testing.assert_allclose(
+        np.asarray(gm.log_prob(jnp.asarray(x))), [expected], rtol=1e-3)
+
+  def test_approximate_mode_picks_top_component(self):
+    params = np.zeros((1, 2 + 2 * 2 * 1), np.float32)
+    # logits: comp0=5, comp1=0; mus: comp0=1.5, comp1=-9
+    params[0, 0] = 5.0
+    params[0, 2] = 1.5
+    params[0, 3] = -9.0
+    gm = layers.get_mixture_distribution(jnp.asarray(params), 2, 1)
+    mode = np.asarray(gm.approximate_mode())
+    np.testing.assert_allclose(mode, [[1.5]], rtol=1e-6)
+
+  def test_mdn_decoder_trains(self):
+    decoder = layers.MDNDecoder(num_mixture_components=2)
+    x = jnp.ones((4, 8))
+    variables = decoder.init(jax.random.PRNGKey(0), x, 3)
+    action, gm = decoder.apply(variables, x, 3)
+    assert action.shape == (4, 3)
+    loss = layers.mdn_nll_loss(gm, jnp.zeros((4, 3)))
+    assert np.isfinite(float(loss))
+
+  def test_sample_shape(self):
+    k, d = 4, 2
+    params = np.random.RandomState(0).randn(6, k + 2 * k * d).astype(
+        np.float32)
+    gm = layers.get_mixture_distribution(jnp.asarray(params), k, d)
+    sample = gm.sample(jax.random.PRNGKey(1))
+    assert sample.shape == (6, d)
+
+
+class TestSnail:
+
+  def test_causal_conv_shape(self):
+    conv = layers.CausalConv(filters=8, dilation_rate=2)
+    x = jnp.ones((2, 10, 4))
+    variables = conv.init(jax.random.PRNGKey(0), x)
+    y = conv.apply(variables, x)
+    assert y.shape == (2, 10, 8)
+
+  def test_causal_conv_is_causal(self):
+    conv = layers.CausalConv(filters=4, dilation_rate=1)
+    x1 = np.random.RandomState(0).randn(1, 10, 3).astype(np.float32)
+    x2 = x1.copy()
+    x2[0, 5:] += 10.0  # perturb the future
+    variables = conv.init(jax.random.PRNGKey(0), jnp.asarray(x1))
+    y1 = conv.apply(variables, jnp.asarray(x1))
+    y2 = conv.apply(variables, jnp.asarray(x2))
+    np.testing.assert_allclose(np.asarray(y1)[0, :5], np.asarray(y2)[0, :5],
+                               rtol=1e-5)
+
+  def test_tc_block_output_channels(self):
+    # T=8 → ceil(log2(8)) = 3 dense blocks, each adds `filters` channels.
+    block = layers.TCBlock(sequence_length=8, filters=5)
+    x = jnp.ones((2, 8, 3))
+    variables = block.init(jax.random.PRNGKey(0), x)
+    y = block.apply(variables, x)
+    assert y.shape == (2, 8, 3 + 3 * 5)
+
+  def test_causally_masked_softmax(self):
+    logits = jnp.zeros((1, 4, 4))
+    probs = np.asarray(layers.causally_masked_softmax(logits))
+    assert np.allclose(np.triu(probs[0], k=1), 0.0)
+    np.testing.assert_allclose(probs.sum(-1), np.ones((1, 4)), rtol=1e-6)
+    np.testing.assert_allclose(probs[0, 1, :2], [0.5, 0.5], rtol=1e-6)
+
+  def test_attention_block(self):
+    block = layers.AttentionBlock(key_size=6, value_size=7)
+    x = jnp.ones((2, 5, 3))
+    variables = block.init(jax.random.PRNGKey(0), x)
+    y, end_points = block.apply(variables, x)
+    assert y.shape == (2, 5, 3 + 7)
+    assert end_points['attn_prob'].shape == (2, 5, 5)
+
+
+class TestVisionLayers:
+
+  def test_images_to_features(self):
+    module = layers.ImagesToFeaturesModel(num_output_maps=16)
+    images = jnp.ones((2, 64, 64, 3))
+    variables = module.init(jax.random.PRNGKey(0), images)
+    points, end_points = module.apply(variables, images)
+    assert points.shape == (2, 32)
+    assert end_points['softmax'].shape[0] == 2
+
+  def test_images_to_features_with_film(self):
+    module = layers.ImagesToFeaturesModel(num_blocks=3)
+    film = layers.FILMParams(film_output_size=layers.film_params_size(3))
+    images = jnp.ones((2, 32, 32, 3))
+    embedding = jnp.ones((2, 10))
+    film_vars = film.init(jax.random.PRNGKey(0), embedding)
+    film_params = film.apply(film_vars, embedding)
+    variables = module.init(jax.random.PRNGKey(1), images, film_params)
+    points, _ = module.apply(variables, images, film_params)
+    assert points.shape == (2, 64)
+
+  def test_high_res_variant(self):
+    # VALID convs need enough spatial extent for 3 pool/conv blocks.
+    module = layers.ImagesToFeaturesModelHighRes(num_blocks=3)
+    images = jnp.ones((1, 128, 128, 3))
+    variables = module.init(jax.random.PRNGKey(0), images)
+    points, _ = module.apply(variables, images)
+    assert points.shape == (1, 64)
+
+  def test_features_to_pose(self):
+    module = layers.ImageFeaturesToPoseModel(num_outputs=7)
+    feats = jnp.ones((3, 64))
+    variables = module.init(jax.random.PRNGKey(0), feats)
+    pose, aux = module.apply(variables, feats)
+    assert pose.shape == (3, 7)
+    assert aux is None
+
+  def test_features_to_pose_with_aux(self):
+    module = layers.ImageFeaturesToPoseModel(num_outputs=7, aux_output_dim=3)
+    feats = jnp.ones((3, 64))
+    aux_in = jnp.ones((3, 5))
+    variables = module.init(jax.random.PRNGKey(0), feats, aux_in)
+    pose, aux = module.apply(variables, feats, aux_in)
+    assert pose.shape == (3, 7)
+    assert aux.shape == (3, 3)
+
+
+class TestTEC:
+
+  def test_embed_fullstate(self):
+    module = layers.EmbedFullstate(embed_size=20)
+    x = jnp.ones((4, 10))
+    variables = module.init(jax.random.PRNGKey(0), x)
+    y = module.apply(variables, x)
+    assert y.shape == (4, 20)
+
+  def test_reduce_temporal_embeddings(self):
+    module = layers.ReduceTemporalEmbeddings(output_size=12)
+    x = jnp.ones((4, 40, 8))
+    variables = module.init(jax.random.PRNGKey(0), x)
+    y = module.apply(variables, x)
+    assert y.shape == (4, 12)
+
+  def test_contrastive_loss_prefers_close_positive(self):
+    anchor = jnp.asarray([[1.0, 0.0]])
+    good = np.stack([[1.0, 0.0], [0.0, 1.0]])  # positive close, negative far
+    bad = np.stack([[-1.0, 0.0], [1.0, 0.01]])  # positive far, negative close
+    labels = jnp.asarray([True, False])
+    loss_good = float(layers.contrastive_loss(labels, anchor,
+                                              jnp.asarray(good)))
+    loss_bad = float(layers.contrastive_loss(labels, anchor,
+                                             jnp.asarray(bad)))
+    assert loss_good < loss_bad
+
+  def test_compute_embedding_contrastive_loss(self):
+    rng = np.random.RandomState(0)
+    inf_emb = jnp.asarray(rng.randn(3, 2, 8).astype(np.float32))
+    con_emb = jnp.asarray(rng.randn(3, 2, 8).astype(np.float32))
+    loss = layers.compute_embedding_contrastive_loss(inf_emb, con_emb)
+    assert np.isfinite(float(loss))
+
+
+class TestResNet:
+
+  @pytest.mark.parametrize('size', [18, 50])
+  @pytest.mark.parametrize('version', [1, 2])
+  def test_forward_shapes(self, size, version):
+    model = layers.ResNet(resnet_size=size, num_classes=10, version=version)
+    images = jnp.ones((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), images)
+    logits, endpoints = model.apply(variables, images)
+    assert logits.shape == (2, 10)
+    expected_channels = 512 * (4 if size >= 50 else 1)
+    assert endpoints['pre_final_pool'].shape[-1] == expected_channels
+    for i in range(1, 5):
+      assert f'block_layer{i}' in endpoints
+
+  def test_feature_mode_no_classes(self):
+    model = layers.ResNet(resnet_size=18, num_classes=None)
+    images = jnp.ones((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), images)
+    feats, endpoints = model.apply(variables, images)
+    assert feats.shape == (1, 512)
+    assert 'final_dense' not in endpoints
+
+  def test_film_resnet_conditioning_changes_output(self):
+    model = layers.FilmResNet(resnet_size=18, num_classes=4)
+    images = jnp.ones((2, 32, 32, 3))
+    emb1 = jnp.zeros((2, 6))
+    emb2 = jnp.ones((2, 6)) * 3.0
+    variables = model.init(jax.random.PRNGKey(0), images, emb1)
+    out1, _ = model.apply(variables, images, emb1)
+    out2, _ = model.apply(variables, images, emb2)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+  def test_batch_stats_update_in_train(self):
+    model = layers.ResNet(resnet_size=18, num_classes=2)
+    images = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), images)
+    _, new_state = model.apply(
+        variables, images, train=True, mutable=['batch_stats'])
+    assert 'batch_stats' in new_state

@@ -1,0 +1,187 @@
+"""Pose-env workload tests: env, data, models, policies, collect loop.
+
+Mirrors ``research/pose_env/pose_env_models_test.py:50-80`` and
+``research/pose_env/pose_env_test.py``.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.input_generators import DefaultRecordInputGenerator
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.policies import CEMPolicy, RegressionPolicy
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.research import dql_grasping_lib
+from tensor2robot_tpu.research.pose_env import (
+    PoseEnvContinuousMCModel,
+    PoseEnvRandomPolicy,
+    PoseEnvRegressionModel,
+    PoseToyEnv,
+    episode_to_transitions_pose_toy,
+)
+from tensor2robot_tpu.train import train_eval_model
+from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+from tensor2robot_tpu.utils.writer import TFRecordReplayWriter
+
+TEST_DATA = os.path.join(
+    os.path.dirname(__file__), 'test_data', 'pose_env_test_data.tfrecord')
+
+
+class TestPoseToyEnv:
+
+  def test_observation_and_step(self):
+    env = PoseToyEnv(seed=3)
+    obs = env.reset()
+    assert obs.shape == (64, 64, 3)
+    assert obs.dtype == np.uint8
+    new_obs, reward, done, debug = env.step(np.zeros(2))
+    assert done
+    assert reward <= 0
+    assert debug['target_pose'].shape == (2,)
+
+  def test_reward_zero_at_target(self):
+    env = PoseToyEnv(seed=4)
+    env.reset()
+    target = env._target_pose[:2]
+    _, reward, _, _ = env.step(target)
+    assert abs(reward) < 1e-6
+
+  def test_hidden_drift_offsets_target(self):
+    env = PoseToyEnv(hidden_drift=True, seed=5)
+    env.reset_task()
+    assert env._hidden_drift_xyz is not None
+    drift_xy = env._hidden_drift_xyz[:2]
+    np.testing.assert_allclose(
+        env._target_pose[:2] - env._rendered_pose[:2], drift_xy, atol=1e-6)
+
+  def test_image_depends_on_pose(self):
+    env = PoseToyEnv(seed=6)
+    obs1 = env.reset()
+    env.set_new_pose()
+    obs2 = env.reset()
+    assert not np.array_equal(obs1, obs2)
+
+
+class TestPoseEnvData:
+
+  def test_dataset_parses_with_model_specs(self):
+    model = PoseEnvRegressionModel(device_type='cpu')
+    gen = DefaultRecordInputGenerator(
+        file_patterns=TEST_DATA, batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(gen.create_iterator(ModeKeys.TRAIN))
+    assert features['state/image'].shape == (8, 64, 64, 3)
+    assert features['state/image'].dtype == np.uint8
+    assert labels['target_pose'].shape == (8, 2)
+    assert labels['reward'].shape == (8, 1)
+
+  @pytest.mark.skipif(
+      not os.path.exists(
+          '/root/reference/test_data/pose_env_test_data.tfrecord'),
+      reason='reference dataset unavailable')
+  def test_reference_dataset_parses_identically(self):
+    """Parser fidelity vs the reference's own checked-in records."""
+    model = PoseEnvRegressionModel(device_type='cpu')
+    gen = DefaultRecordInputGenerator(
+        file_patterns='/root/reference/test_data/pose_env_test_data.tfrecord',
+        batch_size=4)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(gen.create_iterator(ModeKeys.TRAIN))
+    assert features['state/image'].shape == (4, 64, 64, 3)
+    assert labels['target_pose'].shape == (4, 2)
+
+  def test_episode_to_transitions_roundtrip(self, tmp_path):
+    env = PoseToyEnv(seed=7)
+    obs = env.reset()
+    action = np.asarray([0.1, -0.2])
+    new_obs, rew, done, debug = env.step(action)
+    transitions = episode_to_transitions_pose_toy(
+        [(obs, action, rew, new_obs, done, debug)])
+    assert len(transitions) == 1
+    writer = TFRecordReplayWriter()
+    writer.open(str(tmp_path / 'replay'))
+    writer.write(transitions)
+    writer.close()
+    model = PoseEnvRegressionModel(device_type='cpu')
+    gen = DefaultRecordInputGenerator(
+        file_patterns=str(tmp_path / 'replay.tfrecord'), batch_size=1)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(gen.create_iterator(ModeKeys.TRAIN))
+    np.testing.assert_allclose(labels['reward'][0, 0], rew, rtol=1e-5)
+
+
+class TestPoseEnvModels:
+
+  def test_regression_fixture_smoke(self, tmp_path):
+    fixture = T2RModelFixture()
+    fixture.recordio_train(
+        model_name=PoseEnvRegressionModel,
+        file_patterns=TEST_DATA,
+        model_dir=str(tmp_path / 'm'),
+        max_train_steps=2)
+
+  def test_mc_fixture_smoke(self, tmp_path):
+    fixture = T2RModelFixture()
+    fixture.random_train(
+        model_name=PoseEnvContinuousMCModel,
+        model_dir=str(tmp_path / 'm'),
+        max_train_steps=2)
+
+  def test_regression_trains_on_records(self, tmp_path):
+    """Eval-loss improvement on the checked-in dataset (parity workload)."""
+    model = PoseEnvRegressionModel(device_type='tpu')
+    gen = DefaultRecordInputGenerator(file_patterns=TEST_DATA, batch_size=16)
+    eval_gen = DefaultRecordInputGenerator(
+        file_patterns=TEST_DATA, batch_size=16)
+    metrics = train_eval_model(
+        model=model,
+        model_dir=str(tmp_path / 'm'),
+        train_input_generator=gen,
+        eval_input_generator=eval_gen,
+        max_train_steps=50,
+        eval_steps=4,
+        eval_interval_steps=0,
+        save_interval_steps=50,
+        log_interval_steps=0)
+    assert np.isfinite(metrics['pose_mse'])
+    assert metrics['pose_mse'] < 1.0  # random poses have var ~0.16/0.07
+
+
+class TestPoseEnvPolicies:
+
+  def test_regression_policy_e2e(self, tmp_path):
+    model = PoseEnvRegressionModel(device_type='tpu')
+    predictor = CheckpointPredictor(model, model_dir=str(tmp_path / 'none'))
+    predictor.init_randomly()
+    policy = RegressionPolicy(t2r_model=model, predictor=predictor)
+    env = PoseToyEnv(seed=8)
+    rewards = dql_grasping_lib.run_env(
+        env, policy=policy, num_episodes=2, root_dir=str(tmp_path),
+        tag='eval')
+    assert len(rewards) == 2
+
+  def test_cem_policy_e2e(self, tmp_path):
+    model = PoseEnvContinuousMCModel(device_type='tpu')
+    predictor = CheckpointPredictor(model, model_dir=str(tmp_path / 'none'))
+    predictor.init_randomly()
+    policy = CEMPolicy(
+        t2r_model=model, predictor=predictor, action_size=2,
+        cem_samples=16, cem_iters=2, num_elites=4)
+    env = PoseToyEnv(seed=9)
+    obs = env.reset()
+    action = policy.SelectAction(obs, None, 0)
+    assert np.asarray(action).shape == (2,)
+
+  def test_collect_writes_replay(self, tmp_path):
+    env = PoseToyEnv(seed=10)
+    policy = PoseEnvRandomPolicy()
+    writer = TFRecordReplayWriter()
+    dql_grasping_lib.run_env(
+        env, policy=policy, num_episodes=3,
+        episode_to_transitions_fn=episode_to_transitions_pose_toy,
+        replay_writer=writer, root_dir=str(tmp_path), tag='collect')
+    files = glob.glob(str(tmp_path / 'policy_collect' / '*.tfrecord'))
+    assert len(files) == 1

@@ -1,0 +1,82 @@
+"""QT-Opt workload tests (mirrors research/qtopt/t2r_models_test.py:34-55)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.qtopt import (
+    Grasping44,
+    GraspingModelWrapper,
+    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    build_opt,
+)
+
+
+class TestGrasping44:
+
+  def test_forward_shapes(self):
+    net = Grasping44()
+    images = jnp.ones((2, 472, 472, 3))
+    params = jnp.ones((2, 5))
+    variables = net.init(jax.random.PRNGKey(0), images, params)
+    logits, end_points = net.apply(variables, images, params)
+    assert logits.shape == (2, 1)
+    assert end_points['predictions'].shape == (2,)
+    assert np.all(np.asarray(end_points['predictions']) >= 0)
+    assert np.all(np.asarray(end_points['predictions']) <= 1)
+
+  def test_action_batched_forward(self):
+    """[B, A, P] grasp params broadcast against one conv tower pass."""
+    net = Grasping44()
+    images = jnp.ones((2, 472, 472, 3))
+    params = jnp.ones((2, 3, 5))
+    variables = net.init(jax.random.PRNGKey(0), images, jnp.ones((2, 5)))
+    _, end_points = net.apply(variables, images, params)
+    assert end_points['predictions'].shape == (2, 3)
+
+
+class TestOptimizerBuilder:
+
+  @pytest.mark.parametrize('name', ['momentum', 'rmsprop', 'adam'])
+  def test_build_opt_variants(self, name):
+    opt = build_opt({'optimizer': name})
+    params = {'w': jnp.ones(3)}
+    state = opt.init(params)
+    updates, _ = opt.update({'w': jnp.ones(3)}, state, params)
+    assert updates['w'].shape == (3,)
+
+
+class TestGraspingModelWrapper:
+
+  def test_specs(self):
+    model = GraspingModelWrapper(device_type='cpu')
+    feature_spec = model.get_feature_specification(ModeKeys.TRAIN)
+    assert 'state/image' in feature_spec
+    assert feature_spec['state/image'].shape == (472, 472, 3)
+    in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+    assert in_spec['state/image'].shape == (512, 640, 3)
+    assert in_spec['state/image'].dtype == np.uint8
+    label_spec = model.get_label_specification(ModeKeys.TRAIN)
+    assert label_spec['reward'].name == 'grasp_success'
+
+  def test_random_train_smoke(self, tmp_path):
+    from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+
+    fixture = T2RModelFixture()
+    fixture.random_train(
+        model_name=GraspingModelWrapper,
+        model_dir=str(tmp_path / 'm'),
+        batch_size=2,
+        max_train_steps=2,
+        model_kwargs={'device_type': 'cpu'})
+
+  def test_e2e_action_space_pack(self):
+    model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+        device_type='cpu')
+    actions = np.random.rand(4, 10).astype(np.float32)
+    state = np.zeros((472, 472, 3), np.uint8)
+    packed = model.pack_features(state, actions, 0)
+    assert packed['state/image'].shape == (4, 472, 472, 3)
+    assert packed['action/height_to_bottom'].shape == (4, 1)

@@ -1,0 +1,166 @@
+"""Tests: subsampling, schedules, decoders, callbacks, workload configs."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.utils import global_step_functions, subsample
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestSubsample:
+
+  def test_indices_keep_endpoints(self):
+    rng = jax.random.PRNGKey(0)
+    lengths = jnp.asarray([10, 7, 4])
+    indices = subsample.get_subsample_indices(rng, lengths, 4)
+    indices = np.asarray(indices)
+    assert indices.shape == (3, 4)
+    np.testing.assert_array_equal(indices[:, 0], [0, 0, 0])
+    np.testing.assert_array_equal(indices[:, -1], [9, 6, 3])
+    # Sorted and within range.
+    for row, length in zip(indices, [10, 7, 4]):
+      assert np.all(np.diff(row) >= 0)
+      assert np.all(row < length)
+
+  def test_with_replacement_when_short(self):
+    rng = jax.random.PRNGKey(1)
+    lengths = jnp.asarray([3])
+    indices = np.asarray(
+        subsample.get_subsample_indices(rng, lengths, 6))
+    assert indices.shape == (1, 6)
+    assert indices[0, 0] == 0 and indices[0, -1] == 2
+
+  def test_min_length_one(self):
+    rng = jax.random.PRNGKey(2)
+    indices = np.asarray(
+        subsample.get_subsample_indices(rng, jnp.asarray([5, 9]), 1))
+    assert indices.shape == (2, 1)
+    assert np.all(indices[:, 0] < np.asarray([5, 9]))
+
+  def test_numpy_twin(self):
+    indices = subsample.get_np_subsample_indices(
+        np.asarray([10, 5]), 4, rng=np.random.RandomState(0))
+    assert indices.shape == (2, 4)
+    np.testing.assert_array_equal(indices[:, 0], [0, 0])
+    np.testing.assert_array_equal(indices[:, -1], [9, 4])
+
+  def test_randomized_boundary(self):
+    rng = jax.random.PRNGKey(3)
+    indices = np.asarray(
+        subsample.get_subsample_indices_randomized_boundary(
+            rng, jnp.asarray([20, 12]), 4, min_delta_t=6, max_delta_t=10))
+    assert indices.shape == (2, 4)
+    for row, length in zip(indices, [20, 12]):
+      assert np.all(np.diff(row) >= 0)
+      assert np.all(row < length)
+
+
+class TestGlobalStepFunctions:
+
+  def test_piecewise_linear(self):
+    schedule = global_step_functions.piecewise_linear(
+        boundaries=[0, 100, 200], values=[1.0, 0.5, 0.0])
+    assert float(schedule(0)) == pytest.approx(1.0)
+    assert float(schedule(50)) == pytest.approx(0.75)
+    assert float(schedule(150)) == pytest.approx(0.25)
+    assert float(schedule(500)) == pytest.approx(0.0)
+
+  def test_exponential_decay(self):
+    schedule = global_step_functions.exponential_decay(
+        initial_value=1.0, decay_steps=10, decay_rate=0.5, staircase=True)
+    assert float(schedule(0)) == pytest.approx(1.0)
+    assert float(schedule(9)) == pytest.approx(1.0)
+    assert float(schedule(10)) == pytest.approx(0.5)
+    assert float(schedule(25)) == pytest.approx(0.25)
+
+
+class TestDecoders:
+
+  def test_mse_decoder(self):
+    from tensor2robot_tpu.research.vrgripper.decoders import MSEDecoder
+
+    decoder = MSEDecoder()
+    x = jnp.ones((4, 8))
+    variables = decoder.init(jax.random.PRNGKey(0), x, 3)
+    action, state = decoder.apply(variables, x, 3)
+    assert action.shape == (4, 3)
+    loss = MSEDecoder.loss(state, jnp.zeros((4, 3)))
+    assert np.isfinite(float(loss))
+
+  def test_discrete_decoder_bins(self):
+    from tensor2robot_tpu.research.vrgripper import decoders
+
+    bins = decoders.get_discrete_bins(
+        4, np.asarray([-1.0, 0.0]), np.asarray([1.0, 4.0]))
+    assert bins.shape == (4, 2)
+    np.testing.assert_allclose(bins[:, 0], [-0.75, -0.25, 0.25, 0.75])
+    np.testing.assert_allclose(bins[:, 1], [0.5, 1.5, 2.5, 3.5])
+
+  def test_discrete_decoder_roundtrip(self):
+    from tensor2robot_tpu.research.vrgripper.decoders import DiscreteDecoder
+
+    decoder = DiscreteDecoder(num_bins=5)
+    x = jnp.ones((4, 8))
+    variables = decoder.init(jax.random.PRNGKey(0), x, 2)
+    action, logits = decoder.apply(variables, x, 2)
+    assert action.shape == (4, 2)
+    loss = decoder.loss(logits, jnp.zeros((4, 2)))
+    assert np.isfinite(float(loss))
+
+  def test_maf_decoder(self):
+    from tensor2robot_tpu.research.vrgripper.decoders import MAFDecoder
+
+    decoder = MAFDecoder(num_flows=2, hidden=16)
+    x = jnp.ones((4, 8))
+    variables = decoder.init(jax.random.PRNGKey(0), x, 3)
+    action, context = decoder.apply(
+        variables, x, 3, rng=jax.random.PRNGKey(1))
+    assert action.shape == (4, 3)
+    nll = decoder.loss(variables, context, jnp.zeros((4, 3)), 3)
+    assert np.isfinite(float(nll))
+
+
+class TestCallbacks:
+
+  def test_metrics_logger(self, tmp_path):
+    from tensor2robot_tpu.modes import ModeKeys
+    from tensor2robot_tpu.train import Trainer, TrainerConfig
+    from tensor2robot_tpu.train.callbacks import MetricsLoggerCallback
+    from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+    model = MockT2RModel(device_type='cpu')
+    config = TrainerConfig(
+        model_dir=str(tmp_path / 'm'), max_train_steps=4,
+        save_interval_steps=4, eval_interval_steps=0, log_interval_steps=2,
+        async_checkpoints=False)
+    trainer = Trainer(model, config, callbacks=[MetricsLoggerCallback()])
+    gen = MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    path = os.path.join(str(tmp_path / 'm'), 'metrics.jsonl')
+    assert os.path.exists(path)
+    assert len(open(path).read().splitlines()) >= 1
+
+
+class TestWorkloadConfigs:
+  """Every shipped gin config parses and wires a real model."""
+
+  @pytest.mark.parametrize('config_path', sorted(glob.glob(
+      os.path.join(REPO, 'tensor2robot_tpu', 'research', '*', 'configs',
+                   '*.gin'))))
+  def test_config_parses_and_builds_model(self, config_path):
+    from tensor2robot_tpu import config as t2r_config
+
+    t2r_config.register_framework_configurables()
+    t2r_config.clear_config()
+    t2r_config.parse_config_files_and_bindings(config_files=[config_path])
+    model_ref = t2r_config.query_parameter('train_eval_model.model')
+    model = model_ref.resolve()
+    assert hasattr(model, 'get_feature_specification')
+    t2r_config.clear_config()

@@ -1,0 +1,110 @@
+"""VRGripper/WTL tests (mirror vrgripper model tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.vrgripper import (
+    VRGripperEnvSimpleTrialModel,
+    VRGripperRegressionModel,
+    pack_wtl_meta_features,
+)
+from tensor2robot_tpu.specs import SpecStruct, make_random_numpy
+
+
+class TestVRGripperRegression:
+
+  def _features(self, model, batch=2):
+    spec = model.preprocessor.get_out_feature_specification(ModeKeys.TRAIN)
+    label_spec = model.preprocessor.get_out_label_specification(ModeKeys.TRAIN)
+    f = make_random_numpy(spec, batch_size=batch)
+    l = make_random_numpy(label_spec, batch_size=batch)
+    return (SpecStruct({k: jnp.asarray(v) for k, v in f.items()}),
+            SpecStruct({k: jnp.asarray(v) for k, v in l.items()}))
+
+  def test_mse_head_forward_and_loss(self):
+    model = VRGripperRegressionModel(
+        episode_length=3, action_size=4, device_type='cpu')
+    features, labels = self._features(model)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, labels, ModeKeys.TRAIN)
+    assert outputs['inference_output'].shape == (2, 3, 4)
+    loss, _ = model.model_train_fn(features, labels, outputs, ModeKeys.TRAIN)
+    assert np.isfinite(float(loss))
+
+  def test_mdn_head(self):
+    model = VRGripperRegressionModel(
+        episode_length=3, action_size=4, num_mixture_components=3,
+        device_type='cpu')
+    features, labels = self._features(model)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, labels, ModeKeys.TRAIN)
+    assert 'dist_params' in outputs
+    assert outputs['dist_params'].shape[-1] == 3 + 2 * 3 * 4
+    loss, _ = model.model_train_fn(features, labels, outputs, ModeKeys.TRAIN)
+    assert np.isfinite(float(loss))
+
+  def test_preprocessor_in_spec_uint8_src_res(self):
+    model = VRGripperRegressionModel(episode_length=3, device_type='cpu')
+    in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+    assert in_spec['image'].dtype == np.uint8
+    assert in_spec['image'].shape == (3, 220, 300, 3)
+
+
+class TestWTLSimpleTrial:
+
+  def _meta_features(self, model, batch=2, num_con=1, num_inf=1):
+    t, obs, act = model._episode_length, 32, model._action_size
+    rng = np.random.RandomState(0)
+    features = SpecStruct()
+    features['condition/features/full_state_pose'] = jnp.asarray(
+        rng.rand(batch, num_con, t, obs).astype(np.float32))
+    features['condition/labels/action'] = jnp.asarray(
+        rng.rand(batch, num_con, t, act).astype(np.float32))
+    features['condition/labels/success'] = jnp.asarray(
+        rng.rand(batch, num_con, t, 1).astype(np.float32))
+    features['inference/features/full_state_pose'] = jnp.asarray(
+        rng.rand(batch, num_inf, t, obs).astype(np.float32))
+    labels = SpecStruct()
+    labels['action'] = jnp.asarray(
+        rng.rand(batch, num_inf, t, act).astype(np.float32))
+    labels['success'] = jnp.asarray(
+        rng.rand(batch, num_inf, t, 1).astype(np.float32))
+    return features, labels
+
+  def test_forward_and_loss(self):
+    model = VRGripperEnvSimpleTrialModel(
+        episode_length=10, action_size=7, device_type='cpu')
+    features, labels = self._meta_features(model)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, labels, ModeKeys.TRAIN)
+    assert outputs['inference_output'].shape == (2, 1, 10, 7)
+    loss, scalars = model.model_train_fn(features, labels, outputs,
+                                         ModeKeys.TRAIN)
+    assert np.isfinite(float(loss))
+    assert 'bc_loss' in scalars
+
+  def test_retrial_variant(self):
+    model = VRGripperEnvSimpleTrialModel(
+        episode_length=10, action_size=7, retrial=True,
+        num_condition_samples_per_task=2, device_type='cpu')
+    features, labels = self._meta_features(model, num_con=2)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, labels, ModeKeys.TRAIN)
+    assert outputs['inference_output'].shape == (2, 1, 10, 7)
+
+  def test_pack_features(self):
+    model = VRGripperEnvSimpleTrialModel(
+        episode_length=5, action_size=7, device_type='cpu')
+    obs = np.zeros(32, np.float32)
+    episode = [(np.zeros(32), np.zeros(7), 1.0, np.zeros(32), True, {})] * 3
+    packed = model.pack_features(obs, [episode], 0)
+    assert packed['inference/features/full_state_pose/0'].shape == (1, 5, 32)
+    assert packed['condition/features/full_state_pose/0'].shape == (1, 5, 32)
+    assert packed['condition/labels/action/0'].shape == (1, 5, 7)
