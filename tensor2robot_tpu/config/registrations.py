@@ -76,7 +76,10 @@ def register() -> None:
 
   # Research workloads (research/*/configs/*.gin surface).
   from tensor2robot_tpu.meta_learning import maml_model as maml_model_lib
-  from tensor2robot_tpu.meta_learning import run_meta_env as run_meta_env_lib
+  # NOTE: the meta_learning package __init__ re-exports the *function*
+  # run_meta_env under the same name as its module, so `from ... import
+  # run_meta_env` yields the function itself, not the module.
+  from tensor2robot_tpu.meta_learning import run_meta_env as run_meta_env_fn
   from tensor2robot_tpu.research import dql_grasping_lib
   from tensor2robot_tpu.research import grasp2vec as grasp2vec_lib
   from tensor2robot_tpu.research import pose_env as pose_env_lib
@@ -84,7 +87,7 @@ def register() -> None:
   from tensor2robot_tpu.research import vrgripper as vrgripper_lib
 
   reg(maml_model_lib.MAMLModel, 'MAMLModel')
-  reg(run_meta_env_lib.run_meta_env, 'run_meta_env')
+  reg(run_meta_env_fn, 'run_meta_env')
   reg(dql_grasping_lib.run_env, 'run_env')
   reg(pose_env_lib.PoseToyEnv, 'PoseToyEnv')
   reg(pose_env_lib.PoseEnvRegressionModel, 'PoseEnvRegressionModel')
@@ -105,3 +108,6 @@ def register() -> None:
       'VRGripperEnvVisionTrialModel')
   reg(vrgripper_lib.VRGripperEnvRegressionModelMAML,
       'VRGripperEnvRegressionModelMAML')
+  reg(vrgripper_lib.VRGripperEnvTecModel, 'VRGripperEnvTecModel')
+  reg(vrgripper_lib.VRGripperEnvSequentialModel,
+      'VRGripperEnvSequentialModel')

@@ -11,9 +11,18 @@ An export directory ``<export_root>/<version>/`` contains:
   enabled — the reference's swapping-saver capability).
 * ``assets.extra/t2r_assets.pbtxt`` (+ JSON twin) — feature/label specs and
   global_step (``hooks/async_export_hook_builder.py:66-88``).
-* ``export_meta.json`` — model class path + ctor kwargs, so predictors can
-  rebuild the serving fn without the training script (the role the
-  SavedModel GraphDef plays in the reference).
+* ``serving_fn.jax_export`` — the SELF-CONTAINED serving function
+  (preprocessing + forward + export outputs) serialized with
+  ``jax.export`` (StableHLO). This is the SavedModel-GraphDef equivalent:
+  a robot host deserializes and calls it with only jax installed — no
+  model class, no training script
+  (``export_generators/default_export_generator.py:47-87``: preprocessing
+  inside the serving graph).
+* ``assets.extra/warmup_requests.npz`` + ``warmup_requests.tfexamples`` —
+  spec-shaped warmup inputs, as numpy and as serialized tf.Example bytes
+  (``abstract_export_generator.py:114-147``).
+* ``export_meta.json`` — model class path + global step; the model-class
+  fallback path for predictors when the StableHLO artifact is absent.
 
 Versions are numeric timestamps exactly like SavedModel export dirs, and
 old versions are GC'd to N newest (``hooks/checkpoint_hooks.py:36-53``).
@@ -25,19 +34,139 @@ import importlib
 import json
 import os
 import shutil
+import struct
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import algebra
 from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs import numpy_gen
 from tensor2robot_tpu.specs.spec_struct import SpecStruct
 
 EXPORT_META_FILENAME = 'export_meta.json'
 STATE_DIRNAME = 'state'
+SERVING_FN_FILENAME = 'serving_fn.jax_export'
+WARMUP_NPZ_FILENAME = 'warmup_requests.npz'
+WARMUP_EXAMPLES_FILENAME = 'warmup_requests.tfexamples'
+
+
+def to_plain_tree(obj):
+  """Mappings → plain dicts (stable pytree structure for jax.export)."""
+  if isinstance(obj, Mapping):
+    return {k: to_plain_tree(v) for k, v in obj.items()}
+  return obj
+
+
+def build_serving_fn(model):
+  """The hermetic PREDICT chain: preprocess → network → export outputs.
+
+  Takes/returns PLAIN dicts so the serialized calling convention doesn't
+  depend on framework pytree types.
+  """
+  preprocessor = model.preprocessor
+
+  def serving_fn(variables, features):
+    features_p, _ = preprocessor.preprocess(
+        SpecStruct(features), None, ModeKeys.PREDICT, None)
+    outputs, _ = model.inference_network_fn(
+        dict(variables), features_p, None, ModeKeys.PREDICT)
+    return dict(model.create_export_outputs_fn(features_p, outputs))
+
+  return serving_fn
+
+
+def serialize_serving_fn(model, serving_variables,
+                         batch_size: Optional[int] = None) -> bytes:
+  """Serializes the serving fn with ``jax.export`` (StableHLO).
+
+  ``batch_size=None`` exports a symbolic batch dimension (the reference's
+  unknown-batch serving signature, ``README.md:180-184``); pass an int to
+  pin it if a model's preprocessing can't trace symbolically.
+  """
+  from jax import export as jax_export
+
+  serving_fn = build_serving_fn(model)
+  in_spec = algebra.filter_required_flat_tensor_spec(
+      model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
+  if batch_size is None:
+    (batch,) = jax_export.symbolic_shape('b')
+  else:
+    batch = int(batch_size)
+  feature_args = {
+      key: jax.ShapeDtypeStruct((batch,) + tuple(spec.shape), spec.dtype)
+      for key, spec in in_spec.items()
+  }
+  var_args = jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+      to_plain_tree(serving_variables))
+  # cpu + tpu: robots serve on CPU hosts, servers on TPU.
+  platforms = sorted({'cpu', jax.default_backend()} | {'tpu'})
+  try:
+    exported = jax_export.export(
+        jax.jit(serving_fn), platforms=platforms)(var_args, feature_args)
+  except Exception:
+    # Some lowering rules are platform-gated; fall back to the current one.
+    exported = jax_export.export(jax.jit(serving_fn))(var_args, feature_args)
+  return exported.serialize()
+
+
+def write_warmup_requests(export_dir: str,
+                          model,
+                          batch_size: int = 1,
+                          num_requests: int = 2) -> None:
+  """Spec-shaped warmup inputs (abstract_export_generator.py:114-147).
+
+  Written both as an ``.npz`` of numpy feature dicts (suffix ``/<i>``)
+  and as length-prefixed serialized tf.Example records, so robot hosts
+  can warm up either receiver path.
+  """
+  in_spec = algebra.filter_required_flat_tensor_spec(
+      model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
+  assets_dir = os.path.join(export_dir, assets_lib.EXTRA_ASSETS_DIRECTORY)
+  os.makedirs(assets_dir, exist_ok=True)
+  arrays = {}
+  example_records: List[bytes] = []
+  for i in range(num_requests):
+    features = numpy_gen.make_random_numpy(
+        in_spec, batch_size=batch_size, seed=i)
+    for key, value in features.items():
+      arrays[f'{key}/{i}'] = value
+    try:
+      from tensor2robot_tpu.data import example_codec
+
+      for b in range(batch_size):
+        single = SpecStruct(
+            {k: np.asarray(v)[b] for k, v in features.items()})
+        example_records.append(
+            example_codec.encode_example(in_spec, single))
+    except Exception:
+      pass  # TF host lib unavailable: npz warmup only
+  np.savez(os.path.join(assets_dir, WARMUP_NPZ_FILENAME), **arrays)
+  if example_records:
+    with open(os.path.join(assets_dir, WARMUP_EXAMPLES_FILENAME), 'wb') as f:
+      for record in example_records:
+        f.write(struct.pack('<Q', len(record)))
+        f.write(record)
+
+
+def read_warmup_examples(export_dir: str) -> List[bytes]:
+  """Reads the length-prefixed serialized warmup examples."""
+  path = os.path.join(export_dir, assets_lib.EXTRA_ASSETS_DIRECTORY,
+                      WARMUP_EXAMPLES_FILENAME)
+  records = []
+  with open(path, 'rb') as f:
+    while True:
+      header = f.read(8)
+      if len(header) < 8:
+        break
+      (length,) = struct.unpack('<Q', header)
+      records.append(f.read(length))
+  return records
 
 
 def _numeric_version_dirs(export_root: str) -> List[str]:
@@ -80,10 +209,22 @@ def gc_export_versions(export_root: str, keep: int = 5) -> None:
 
 
 class ModelExporter:
-  """Writes one export version from a trainer state."""
+  """Writes one export version from a trainer state.
 
-  def __init__(self, keep: int = 5):
+  ``serialize_serving`` controls whether the self-contained StableHLO
+  serving fn + warmup requests are written (slower export; on by default).
+  ``serving_batch_size=None`` exports a symbolic batch dim.
+  """
+
+  def __init__(self,
+               keep: int = 5,
+               serialize_serving: bool = True,
+               serving_batch_size: Optional[int] = None,
+               warmup_batch_size: int = 1):
     self._keep = keep
+    self._serialize_serving = serialize_serving
+    self._serving_batch_size = serving_batch_size
+    self._warmup_batch_size = warmup_batch_size
     self._checkpointer = ocp.StandardCheckpointer()
 
   def export(self, model, state, export_root: str,
@@ -112,10 +253,28 @@ class ModelExporter:
     assets_lib.write_assets_to_export_dir(
         tmp_dir, feature_spec, label_spec, global_step=int(state.step))
 
-    # 3. Reconstruction metadata.
+    # 3. Self-contained serving fn + warmup requests.
+    serving_fn_ok = False
+    if self._serialize_serving:
+      try:
+        data = serialize_serving_fn(
+            model, serving_variables, batch_size=self._serving_batch_size)
+        with open(os.path.join(tmp_dir, SERVING_FN_FILENAME), 'wb') as f:
+          f.write(data)
+        serving_fn_ok = True
+      except Exception:
+        pass  # model-class fallback path still works
+      try:
+        write_warmup_requests(
+            tmp_dir, model, batch_size=self._warmup_batch_size)
+      except Exception:
+        pass  # warmup is best-effort; never abort the export for it
+
+    # 4. Reconstruction metadata.
     meta = {
         'model_class': f'{type(model).__module__}.{type(model).__qualname__}',
         'global_step': int(state.step),
+        'self_contained_serving_fn': serving_fn_ok,
     }
     with open(os.path.join(tmp_dir, EXPORT_META_FILENAME), 'w') as f:
       json.dump(meta, f, indent=2)
@@ -143,6 +302,23 @@ def load_state_from_export_dir(export_dir: str):
   checkpointer = ocp.StandardCheckpointer()
   return checkpointer.restore(
       os.path.abspath(os.path.join(export_dir, STATE_DIRNAME)))
+
+
+def load_serving_fn_from_export_dir(export_dir: str):
+  """Deserializes the self-contained serving fn, or None if absent.
+
+  Returns ``fn(variables, features) -> outputs`` over plain dicts; needs
+  only jax on the host — the SavedModel-load equivalent
+  (``predictors/exported_savedmodel_predictor.py:179``).
+  """
+  path = os.path.join(export_dir, SERVING_FN_FILENAME)
+  if not os.path.exists(path):
+    return None
+  from jax import export as jax_export
+
+  with open(path, 'rb') as f:
+    exported = jax_export.deserialize(f.read())
+  return exported.call
 
 
 # ------------------------------------------------------------ eval exporters

@@ -54,7 +54,14 @@ class EmbedConditionImages(nn.Module):
 
 
 class ReduceTemporalEmbeddings(nn.Module):
-  """[N, T, F] → [N, output_size] via 1-D convs + MLP (tec.py:90-133)."""
+  """[N, T, F] → [N, output_size] via 1-D convs + MLP (tec.py:90-133).
+
+  For sequences shorter than ``kernel_size`` the conv kernel is clipped to
+  T (a VALID conv would otherwise produce an empty time axis). Parameter
+  shapes therefore depend on the episode length the module is first built
+  with — one module instance serves ONE episode length, which is also the
+  reference's contract (fixed ``episode_length`` per model).
+  """
 
   output_size: int
   conv1d_layers: Optional[Sequence[int]] = (64,)
@@ -70,8 +77,11 @@ class ReduceTemporalEmbeddings(nn.Module):
     net = temporal_embedding
     if self.conv1d_layers is not None:
       for i, num_filters in enumerate(self.conv1d_layers):
+        # Clip the kernel to the (possibly short) sequence so VALID conv
+        # never produces an empty time axis (short test episodes).
+        kernel = min(self.kernel_size, net.shape[1])
         net = nn.Conv(
-            num_filters, (self.kernel_size,), padding='VALID',
+            num_filters, (kernel,), padding='VALID',
             use_bias=False, name=f'conv1d_{i}')(net)
         net = nn.relu(net)
         net = nn.LayerNorm()(net)

@@ -141,6 +141,19 @@ class AbstractT2RModel(ModelInterface):
   def is_device_tpu(self) -> bool:
     return self._device_type == DEVICE_TYPE_TPU
 
+  @property
+  def compute_dtype(self):
+    """Activation dtype for the network (params stay float32).
+
+    On TPU this is bfloat16 — the MXU's native input dtype — matching the
+    dtype the :class:`DtypePolicyPreprocessor` delivers at the device
+    boundary (capability of ``models/tpu_model_wrapper.py:105-118``: specs
+    re-typed to bfloat16 so compute runs in bf16 on TPU hardware).
+    """
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if self.is_device_tpu else jnp.float32
+
   # ------------------------------------------------------------ preprocessor
 
   @property

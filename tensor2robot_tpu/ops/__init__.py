@@ -1,0 +1,6 @@
+"""Custom Pallas TPU ops (the hot non-MXU paths)."""
+
+from tensor2robot_tpu.ops.photometric import (
+    fused_brightness_contrast,
+    random_brightness_contrast,
+)

@@ -142,8 +142,23 @@ def apply_photometric_image_distortions(
   Each enabled distortion draws independent per-image parameters, mirroring
   the reference's per-image loop (image_transformations.py:181-272) but
   vectorized over the batch.
+
+  When exactly brightness + contrast are enabled (no saturation / hue /
+  noise) on TPU, the chain dispatches to the fused Pallas kernel in
+  :mod:`tensor2robot_tpu.ops.photometric` — one HBM pass instead of
+  separate add / reduce / scale / clip stages.
   """
   batch = images.shape[0]
+  if (random_brightness and random_contrast and not random_saturation and
+      not random_hue and not random_noise_level and
+      jax.default_backend() == 'tpu'):
+    from tensor2robot_tpu.ops import photometric
+
+    return photometric.random_brightness_contrast(
+        rng, images,
+        max_delta_brightness=max_delta_brightness,
+        lower_contrast=lower_contrast,
+        upper_contrast=upper_contrast)
   keys = jax.random.split(rng, 6)
   if random_brightness:
     delta = jax.random.uniform(

@@ -41,16 +41,21 @@ class _ConvBN(nn.Module):
   padding: str = 'SAME'
   decay: float = 0.9997
   epsilon: float = 0.001
+  # Activation dtype (bfloat16 on TPU); params stay float32 (param_dtype
+  # default). Flax BatchNorm computes mean/var in float32 internally even
+  # when dtype is bfloat16, so statistics stay accurate.
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x, train: bool):
     x = nn.Conv(
         self.features, (self.kernel, self.kernel),
         strides=(self.strides, self.strides), padding=self.padding,
+        dtype=self.dtype,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01))(x)
     x = nn.BatchNorm(
         use_running_average=not train, momentum=self.decay,
-        epsilon=self.epsilon, use_scale=True, dtype=x.dtype)(x)
+        epsilon=self.epsilon, use_scale=True, dtype=self.dtype)(x)
     return nn.relu(x)
 
 
@@ -72,6 +77,7 @@ class Grasping44(nn.Module):
   num_classes: int = 1
   batch_norm_decay: float = 0.9997
   batch_norm_epsilon: float = 0.001
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self,
@@ -81,31 +87,37 @@ class Grasping44(nn.Module):
                softmax: bool = False) -> Tuple[jnp.ndarray, Dict]:
     end_points: Dict[str, jnp.ndarray] = {}
     action_batched = grasp_params.ndim == 3
+    if self.dtype is not None:
+      images = images.astype(self.dtype)
+      grasp_params = grasp_params.astype(self.dtype)
 
     def bn(x, scale=False):
       return nn.BatchNorm(
           use_running_average=not train, momentum=self.batch_norm_decay,
-          epsilon=self.batch_norm_epsilon, use_scale=scale, dtype=x.dtype)(x)
+          epsilon=self.batch_norm_epsilon, use_scale=scale,
+          dtype=self.dtype)(x)
 
     # --- image tower (networks.py:450-470)
     net = nn.Conv(
-        64, (6, 6), strides=(2, 2), padding='SAME',
+        64, (6, 6), strides=(2, 2), padding='SAME', dtype=self.dtype,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name='conv1_1')(images)
     net = nn.relu(bn(net))
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     for l in range(2, 2 + self.num_convs[0]):
-      net = _ConvBN(64, 5, name=f'conv{l}')(net, train)
+      net = _ConvBN(64, 5, dtype=self.dtype, name=f'conv{l}')(net, train)
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     end_points['pool2'] = net
 
     # --- grasp-param embedding (networks.py:476-518)
     fcgrasp = nn.Dense(
-        256, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        256, dtype=self.dtype,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name='fcgrasp')(grasp_params)
     fcgrasp = nn.relu(bn(fcgrasp))
     fcgrasp = nn.Dense(
-        64, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        64, dtype=self.dtype,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name='fcgrasp2')(fcgrasp)
     end_points['fcgrasp'] = fcgrasp
 
@@ -122,24 +134,29 @@ class Grasping44(nn.Module):
 
     for l in range(2 + self.num_convs[0],
                    2 + self.num_convs[0] + self.num_convs[1]):
-      net = _ConvBN(64, 3, name=f'conv{l}')(net, train)
+      net = _ConvBN(64, 3, dtype=self.dtype, name=f'conv{l}')(net, train)
     net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
     for l in range(2 + self.num_convs[0] + self.num_convs[1],
                    2 + sum(self.num_convs)):
-      net = _ConvBN(64, 3, padding='VALID', name=f'conv{l}')(net, train)
+      net = _ConvBN(64, 3, padding='VALID', dtype=self.dtype,
+                    name=f'conv{l}')(net, train)
     end_points['final_conv'] = net
 
     net = net.reshape((net.shape[0], -1))
     for l in range(self.hid_layers):
       net = nn.Dense(
-          64, kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+          64, dtype=self.dtype,
+          kernel_init=nn.initializers.truncated_normal(stddev=0.01),
           name=f'fc{l}')(net)
       net = nn.relu(bn(net, scale=True))
     name = 'logit' if self.num_classes == 1 else f'logit_{self.num_classes}'
     logits = nn.Dense(
-        self.num_classes,
+        self.num_classes, dtype=self.dtype,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name=name)(net)
+    # Loss-bearing outputs leave the network in float32: sigmoid + log loss
+    # in bfloat16 would lose precision for no MXU benefit.
+    logits = logits.astype(jnp.float32)
     end_points['logits'] = logits
 
     predictions = (nn.softmax(logits) if softmax else nn.sigmoid(logits))

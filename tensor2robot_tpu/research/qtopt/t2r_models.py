@@ -117,7 +117,8 @@ class GraspingModelWrapper(critic_model.CriticModel):
     return _Preprocessor
 
   def create_module(self) -> networks.Grasping44:
-    return networks.Grasping44(num_convs=self._num_convs)
+    return networks.Grasping44(
+        num_convs=self._num_convs, dtype=self.compute_dtype)
 
   def get_state_specification(self) -> SpecStruct:
     spec = SpecStruct()
@@ -142,10 +143,15 @@ class GraspingModelWrapper(critic_model.CriticModel):
     return spec
 
   def grasp_params(self, features) -> jnp.ndarray:
-    """Concatenates the action blocks (networks.py:66-79)."""
+    """Concatenates the action blocks (networks.py:66-79).
+
+    Keeps the incoming dtype: on TPU the dtype policy delivers bfloat16 and
+    the network computes in bfloat16 — casting to float32 here would undo
+    the policy and push the whole tower off the MXU's native dtype.
+    """
     return jnp.concatenate([
-        features['action/world_vector'].astype(jnp.float32),
-        features['action/vertical_rotation'].astype(jnp.float32),
+        features['action/world_vector'],
+        features['action/vertical_rotation'],
     ], axis=-1)
 
   def inference_network_fn(self, variables, features, labels, mode,
@@ -153,7 +159,7 @@ class GraspingModelWrapper(critic_model.CriticModel):
     features, _ = self.validated_features(features, mode)
     module = self.module
     train = mode == ModeKeys.TRAIN
-    images = features['state/image'].astype(jnp.float32)
+    images = features['state/image']
     grasp_params = self.grasp_params(features)
     mutable = [k for k in variables if k != 'params'] if train else False
     if mutable:
@@ -170,7 +176,7 @@ class GraspingModelWrapper(critic_model.CriticModel):
 
   def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
     features, _ = self.validated_features(features, mode)
-    images = features['state/image'].astype(jnp.float32)
+    images = features['state/image']
     grasp_params = self.grasp_params(features)
     return self.module.init(
         {'params': rng}, images, grasp_params, train=False)
@@ -212,9 +218,7 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         'world_vector', 'vertical_rotation', 'close_gripper', 'open_gripper',
         'terminate_episode', 'gripper_closed', 'height_to_bottom'
     ]
-    return jnp.concatenate(
-        [features[f'action/{b}'].astype(jnp.float32) for b in blocks],
-        axis=-1)
+    return jnp.concatenate([features[f'action/{b}'] for b in blocks], axis=-1)
 
   def pack_features(self, state, context, timestep) -> SpecStruct:
     del timestep

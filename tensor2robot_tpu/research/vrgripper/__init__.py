@@ -25,6 +25,7 @@ from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
 )
 from tensor2robot_tpu.research.vrgripper.vrgripper_env_meta_models import (
     VRGripperEnvRegressionModelMAML,
+    VRGripperEnvSequentialModel,
     VRGripperEnvTecModel,
     pack_vrgripper_meta_features,
 )

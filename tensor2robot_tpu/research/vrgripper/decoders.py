@@ -177,8 +177,14 @@ class MAFDecoder(nn.Module):
     # loss state: (context,) — NLL evaluates the inverse pass on labels.
     return x, context
 
+  @nn.nowrap
   def loss(self, variables, context, action_labels, output_size: int):
-    """Exact NLL of labels under the flow (inverse direction is parallel)."""
+    """Exact NLL of labels under the flow (inverse direction is parallel).
+
+    ``nn.nowrap`` keeps Flax from treating this plain helper as a module
+    method — the ``_MADE`` instances built here are detached modules used
+    only via ``.apply`` with explicitly threaded params.
+    """
 
     def inverse_nll(x):
       log_det = jnp.zeros(x.shape[:-1])

@@ -1,4 +1,4 @@
-"""VRGripper meta models: MAML variant + TEC model.
+"""VRGripper meta models: MAML variant, TEC, and SNAIL sequential models.
 
 Capability-equivalent of
 ``/root/reference/research/vrgripper/vrgripper_env_meta_models.py``:
@@ -7,22 +7,36 @@ Capability-equivalent of
   episodes → MetaExample feature layout.
 * :class:`VRGripperEnvRegressionModelMAML` (``:122-140``) — MAMLModel over
   the VRGripper regression model with policy-side packing.
-* :class:`VRGripperEnvTecModel` (``:143-571``) — the vision TEC model is
-  provided by :class:`..vrgripper_env_wtl_models.VRGripperEnvVisionTrialModel`
-  (same embedding→policy pipeline); this alias keeps the reference name.
+* :class:`VRGripperEnvTecModel` (``:143-520``) — Task-Embedded Control
+  Network (arXiv:1810.03237): condition episodes embedded per-frame
+  (shared vision tower) → temporal reduction → L2-normalized task
+  embedding; the policy consumes per-step vision features + gripper pose +
+  the embedding (optionally via FiLM), and training adds the contrastive
+  embedding loss between inference- and condition-episode embeddings.
+* :class:`VRGripperEnvSequentialModel` (``:421-571``) — RL²/SNAIL
+  meta-learner: the (condition ‖ inference) frame sequence runs through a
+  causal TC/attention stack and the action is read off the inference tail.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
 import numpy as np
+import optax
 
-from tensor2robot_tpu.meta_learning import maml_model
-from tensor2robot_tpu.research.vrgripper.vrgripper_env_wtl_models import (
-    VRGripperEnvVisionTrialModel,
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.layers import snail, tec, vision_layers
+from tensor2robot_tpu.meta_learning import maml_model, preprocessors
+from tensor2robot_tpu.models.base import FlaxModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    DefaultVRGripperPreprocessor,
 )
-from tensor2robot_tpu.specs import SpecStruct
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
 
 
 def pack_vrgripper_meta_features(state,
@@ -89,7 +103,422 @@ class VRGripperEnvRegressionModelMAML(maml_model.MAMLModel):
         1)
 
 
-# The TEC model (meta_models.py:143-571) shares its implementation with the
-# WTL vision trial model: condition episodes → temporal embedding →
-# policy conditioning (+ contrastive embedding loss).
-VRGripperEnvTecModel = VRGripperEnvVisionTrialModel
+# ------------------------------------------------------------------- TEC
+
+
+class _TecNet(nn.Module):
+  """TEC network (meta_models.py:241-318).
+
+  One shared episode encoder (per-frame vision embedding → temporal
+  reduction → L2 normalize) embeds condition AND inference episodes; the
+  policy head consumes inference-frame vision features + gripper pose +
+  the (truncated) task embedding, optionally FiLM-modulating the policy
+  vision tower with embedding-generated γ/β.
+  """
+
+  action_size: int = 7
+  num_waypoints: int = 1
+  fc_embed_size: int = 32
+  ignore_embedding: bool = False
+  use_film: bool = False
+  num_mixture_components: int = 1
+  predict_end: bool = False
+
+  def setup(self):
+    # Shared episode encoder (reference shares 'image_embedding' and
+    # 'fc_reduce' scopes between condition and inference embeddings).
+    self.image_embedding = tec.EmbedConditionImages(
+        fc_layers=(self.fc_embed_size,), name='image_embedding')
+    self.fc_reduce = tec.ReduceTemporalEmbeddings(
+        output_size=self.fc_embed_size, name='fc_reduce')
+    self.state_features = vision_layers.ImagesToFeaturesModel(
+        name='state_features')
+    self.a_func = vision_layers.ImageFeaturesToPoseModel(
+        num_outputs=None, aux_output_dim=1 if self.predict_end else 0,
+        name='a_func')
+    output_size = self.num_waypoints * self.action_size
+    if self.num_mixture_components > 1:
+      self.mdn_params = mdn_lib.MDNParams(
+          num_alphas=self.num_mixture_components, sample_size=output_size,
+          name='mdn_params')
+    else:
+      self.action_out = nn.Dense(output_size, name='action_out')
+    if self.use_film:
+      self.film = vision_layers.FILMParams(name='film_params')
+
+  def embed_episode(self, images: jnp.ndarray,
+                    train: bool = False) -> jnp.ndarray:
+    """[B, E, T, H, W, C] episodes → [B, E, fc_embed] L2-normalized."""
+    b, e, t = images.shape[:3]
+    merged = images.reshape((-1,) + tuple(images.shape[3:]))
+    frame_embedding = self.image_embedding(merged, train=train)
+    frame_embedding = frame_embedding.reshape((b * e, t, -1))
+    embedding = self.fc_reduce(frame_embedding)
+    embedding = embedding.reshape((b, e, -1))
+    norm = jnp.maximum(
+        jnp.linalg.norm(embedding, axis=-1, keepdims=True), 1e-12)
+    return embedding / norm
+
+  def __call__(self, inf_images, inf_gripper_pose, con_images,
+               train: bool = False, embed_inference: bool = False):
+    # inf_images [B, num_inf, T, H, W, C]; con_images [B, num_con, T', ...].
+    b, num_inf, t = inf_images.shape[:3]
+    condition_embedding = self.embed_episode(con_images, train=train)
+    # Task embedding: mean over condition episodes (identical to the
+    # reference for the standard 1-condition-episode case).
+    task_embedding = condition_embedding.mean(axis=1)  # [B, fc_embed]
+
+    film_output_params = None
+    if self.use_film:
+      per_frame = jnp.broadcast_to(
+          self.film(task_embedding)[:, None, None, :],
+          (b, num_inf, t, self.film.film_output_size))
+      film_output_params = per_frame.reshape((b * num_inf * t, -1))
+
+    inf_merged = inf_images.reshape((-1,) + tuple(inf_images.shape[3:]))
+    feature_points, _ = self.state_features(
+        inf_merged, film_output_params=film_output_params, train=train)
+    feature_points = feature_points.reshape((b, num_inf, t, -1))
+
+    fc_embedding = jnp.broadcast_to(
+        task_embedding[:, None, None, :self.fc_embed_size],
+        (b, num_inf, t, self.fc_embed_size))
+    if self.ignore_embedding:
+      fc_inputs = jnp.concatenate([feature_points, inf_gripper_pose], -1)
+    else:
+      fc_inputs = jnp.concatenate(
+          [feature_points, inf_gripper_pose, fc_embedding], -1)
+
+    merged = fc_inputs.reshape((-1, fc_inputs.shape[-1]))
+    action_params, end_token = self.a_func(merged)
+    outputs = {'condition_embedding': condition_embedding}
+    output_size = self.num_waypoints * self.action_size
+    if self.num_mixture_components > 1:
+      dist_params = self.mdn_params(action_params)
+      outputs['dist_params'] = dist_params.reshape(
+          (b, num_inf, t, dist_params.shape[-1]))
+      gm = mdn_lib.get_mixture_distribution(
+          outputs['dist_params'].astype(jnp.float32),
+          self.num_mixture_components, output_size)
+      action = gm.approximate_mode()
+    else:
+      action = self.action_out(action_params).reshape(
+          (b, num_inf, t, output_size))
+    outputs['inference_output'] = action
+    if self.predict_end:
+      end_logits = end_token.reshape((b, num_inf, t, 1))
+      outputs['end_token_logits'] = end_logits
+      outputs['end_token'] = nn.sigmoid(end_logits)
+      outputs['inference_output'] = jnp.concatenate(
+          [outputs['inference_output'], outputs['end_token']], -1)
+    if embed_inference:
+      outputs['inference_embedding'] = self.embed_episode(
+          inf_images, train=train)
+    return outputs
+
+
+class VRGripperEnvTecModel(FlaxModel):
+  """Task-Embedded Control Network (meta_models.py:143-520).
+
+  Trains the behavioral-cloning loss jointly with the contrastive
+  embedding loss (``tec.compute_embedding_contrastive_loss``) between the
+  inference-episode embedding and the condition-episode embeddings, and
+  optionally an end-token prediction loss.
+  """
+
+  def __init__(self,
+               action_size: int = 7,
+               gripper_pose_size: int = 14,
+               num_waypoints: int = 1,
+               episode_length: int = 40,
+               embed_loss_weight: float = 0.1,
+               fc_embed_size: int = 32,
+               ignore_embedding: bool = False,
+               num_mixture_components: int = 1,
+               predict_end_weight: float = 0.0,
+               use_film: bool = False,
+               image_size: Tuple[int, int] = (100, 100),
+               num_condition_samples_per_task: int = 1,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._gripper_pose_size = gripper_pose_size
+    self._num_waypoints = num_waypoints
+    self._episode_length = episode_length
+    self._embed_loss_weight = embed_loss_weight
+    self._fc_embed_size = fc_embed_size
+    self._ignore_embedding = ignore_embedding
+    self._num_mixture_components = num_mixture_components
+    self._predict_end_weight = predict_end_weight
+    self._use_film = use_film
+    self._image_size = tuple(image_size)
+    self._num_condition_samples_per_task = num_condition_samples_per_task
+
+  # ----------------------------------------------------------------- specs
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    """Single-episode feature spec (meta_models.py:188-202)."""
+    del mode
+    spec = SpecStruct()
+    spec['image'] = TensorSpec(
+        shape=(self._episode_length,) + self._image_size + (3,),
+        dtype=np.float32, name='image0', data_format='JPEG')
+    spec['gripper_pose'] = TensorSpec(
+        shape=(self._episode_length, self._gripper_pose_size),
+        dtype=np.float32, name='world_pose_gripper')
+    return spec
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    spec = SpecStruct()
+    spec['action'] = TensorSpec(
+        shape=(self._episode_length,
+               self._num_waypoints * self._action_size),
+        dtype=np.float32, name='action_world')
+    return spec
+
+  @property
+  def preprocessor(self):
+    base_preprocessor = DefaultVRGripperPreprocessor(
+        model_feature_specification_fn=self._episode_feature_specification,
+        model_label_specification_fn=self._episode_label_specification)
+    return preprocessors.FixedLenMetaExamplePreprocessor(
+        base_preprocessor=base_preprocessor,
+        num_condition_samples_per_task=(
+            self._num_condition_samples_per_task))
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_feature_spec(
+        self._episode_feature_specification(mode),
+        self._episode_label_specification(mode))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return preprocessors.create_maml_label_spec(
+        self._episode_label_specification(mode))
+
+  # ---------------------------------------------------------------- network
+
+  def create_module(self) -> _TecNet:
+    return _TecNet(
+        action_size=self._action_size,
+        num_waypoints=self._num_waypoints,
+        fc_embed_size=self._fc_embed_size,
+        ignore_embedding=self._ignore_embedding,
+        use_film=self._use_film,
+        num_mixture_components=self._num_mixture_components,
+        predict_end=self._predict_end_weight > 0.0)
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    return self.create_module().init(
+        {'params': rng},
+        features['inference/features/image'],
+        features['inference/features/gripper_pose'],
+        features['condition/features/image'],
+        train=False, embed_inference=True)
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    outputs = self.create_module().apply(
+        variables,
+        features['inference/features/image'],
+        features['inference/features/gripper_pose'],
+        features['condition/features/image'],
+        train=mode == ModeKeys.TRAIN,
+        # The contrastive loss needs inference-episode embeddings; skip the
+        # extra encoder pass at serving time (meta_models.py:311-316).
+        embed_inference=mode != ModeKeys.PREDICT)
+    return algebra.flatten_spec_structure(outputs), variables
+
+  # ----------------------------------------------------------------- losses
+
+  def _end_loss(self, inference_outputs, labels) -> jnp.ndarray:
+    """Last two timesteps labeled as end states (meta_models.py:320-335)."""
+    logits = inference_outputs['end_token_logits'].astype(jnp.float32)
+    end_labels = jnp.concatenate([
+        jnp.zeros_like(logits[:, :, :-2, :]),
+        jnp.ones_like(logits[:, :, -2:, :])
+    ], axis=2)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, end_labels))
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    action = labels['action'].astype(jnp.float32)
+    output_size = self._num_waypoints * self._action_size
+    if self._num_mixture_components > 1:
+      gm = mdn_lib.get_mixture_distribution(
+          inference_outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, output_size)
+      bc_loss = mdn_lib.mdn_nll_loss(gm, action)
+    else:
+      prediction = inference_outputs['inference_output'].astype(jnp.float32)
+      bc_loss = jnp.mean(jnp.square(prediction[..., :output_size] - action))
+    embed_loss = tec.compute_embedding_contrastive_loss(
+        inference_outputs['inference_embedding'],
+        inference_outputs['condition_embedding'])
+    scalars = {'bc_loss': bc_loss, 'embed_loss': embed_loss}
+    loss = bc_loss + self._embed_loss_weight * embed_loss
+    if self._predict_end_weight > 0.0:
+      end_loss = self._end_loss(inference_outputs, labels)
+      scalars['end_loss'] = end_loss
+      loss = loss + self._predict_end_weight * end_loss
+    return loss, scalars
+
+  # ----------------------------------------------------------------- policy
+
+  def pack_features(self, state, prev_episode_data, timestep) -> SpecStruct:
+    return pack_vrgripper_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition_samples_per_task)
+
+
+# ------------------------------------------------------------- sequential
+
+
+class _SnailSequenceNet(nn.Module):
+  """SNAIL policy over the (condition ‖ inference) sequence.
+
+  Per-frame vision features + aux input → causal TC/attention stack →
+  per-step output head. The TPU-native stand-in for the reference's
+  ``sequence_model_fn`` (an internal SNAIL; arXiv:1707.03141) built from
+  :mod:`tensor2robot_tpu.layers.snail`.
+  """
+
+  num_outputs: int
+  sequence_length: int
+  filters: int = 32
+
+  @nn.compact
+  def __call__(self, images, aux_input, train: bool = False):
+    # images [B, T, H, W, C]; aux_input [B, T, P].
+    b, t = images.shape[:2]
+    merged = images.reshape((-1,) + tuple(images.shape[2:]))
+    frame_features, _ = vision_layers.ImagesToFeaturesModel(
+        name='frame_features')(merged, train=train)
+    net = frame_features.reshape((b, t, -1))
+    net = jnp.concatenate([net, aux_input], axis=-1)
+    net = nn.Dense(64, name='in_proj')(net)
+    end_points = {}
+    net = snail.TCBlock(
+        sequence_length=self.sequence_length, filters=self.filters,
+        name='tc1')(net)
+    net, attn1 = snail.AttentionBlock(
+        key_size=64, value_size=self.filters, name='attn1')(net)
+    end_points['attn_probs/0'] = attn1['attn_prob']
+    net = snail.TCBlock(
+        sequence_length=self.sequence_length, filters=self.filters,
+        name='tc2')(net)
+    net, attn2 = snail.AttentionBlock(
+        key_size=64, value_size=self.filters, name='attn2')(net)
+    end_points['attn_probs/1'] = attn2['attn_prob']
+    poses = nn.Dense(self.num_outputs, name='out')(net)
+    return poses, end_points
+
+
+class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
+  """RL²/SNAIL meta-learner (meta_models.py:421-571).
+
+  Reuses the TEC model's specs and ``pack_features``; the network is a
+  causal sequence model over the concatenated condition + inference
+  frames, with the action read from the inference tail.
+  """
+
+  def __init__(self,
+               condition_gripper_pose: bool = False,
+               greedy_action: bool = False,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._condition_gripper_pose = condition_gripper_pose
+    self._greedy_action = greedy_action
+
+  def create_module(self) -> _SnailSequenceNet:
+    output_size = self._num_waypoints * self._action_size
+    if self._num_mixture_components > 1:
+      num_mus = output_size * self._num_mixture_components
+      num_outputs = self._num_mixture_components + 2 * num_mus
+    else:
+      num_outputs = output_size
+    return _SnailSequenceNet(
+        num_outputs=num_outputs, sequence_length=2 * self._episode_length)
+
+  def _sequence_inputs(self, features):
+    """Concatenates condition and inference episode 0 across time.
+
+    Like the reference ('Assuming only 1 condition, 1 inference batch for
+    now'), the sequence model consumes exactly one episode of each kind —
+    reject anything else loudly rather than silently dropping episodes.
+    """
+    num_con = features['condition/features/image'].shape[1]
+    num_inf = features['inference/features/image'].shape[1]
+    if num_con != 1 or num_inf != 1:
+      raise ValueError(
+          'VRGripperEnvSequentialModel supports exactly 1 condition and 1 '
+          f'inference episode per task, got {num_con} and {num_inf}.')
+    con_images = features['condition/features/image'][:, 0]
+    inf_images = features['inference/features/image'][:, 0]
+    con_pose = features['condition/features/gripper_pose'][:, 0]
+    inf_pose = features['inference/features/gripper_pose'][:, 0]
+    if not self._condition_gripper_pose:
+      # Imitation-from-video: conditioning sees frames, not trajectories.
+      con_pose = jnp.zeros_like(con_pose)
+    images = jnp.concatenate([con_images, inf_images], axis=1)
+    aux = jnp.concatenate([con_pose, inf_pose], axis=1)
+    return images, aux, con_images.shape[1]
+
+  def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
+    features, _ = self.validated_features(features, mode)
+    images, aux, _ = self._sequence_inputs(features)
+    return self.create_module().init({'params': rng}, images, aux,
+                                     train=False)
+
+  def inference_network_fn(self, variables, features, labels, mode,
+                           rng=None):
+    del labels
+    features, _ = self.validated_features(features, mode)
+    images, aux, condition_length = self._sequence_inputs(features)
+    poses, end_points = self.create_module().apply(
+        variables, images, aux, train=mode == ModeKeys.TRAIN)
+    outputs = dict(end_points)
+    output_size = self._num_waypoints * self._action_size
+    tail = poses[:, condition_length:]
+    if self._num_mixture_components > 1:
+      outputs['dist_params'] = tail[:, None]  # [B, 1, T_inf, P]
+      gm = mdn_lib.get_mixture_distribution(
+          tail.astype(jnp.float32), self._num_mixture_components,
+          output_size)
+      if self._greedy_action or rng is None:
+        action = gm.approximate_mode()
+      else:
+        action = gm.sample(rng)
+      outputs['inference_output'] = action[:, None]
+    else:
+      outputs['inference_output'] = tail[:, None]
+    return algebra.flatten_spec_structure(outputs), variables
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    action = labels['action'].astype(jnp.float32)
+    output_size = self._num_waypoints * self._action_size
+    if self._num_mixture_components > 1:
+      gm = mdn_lib.get_mixture_distribution(
+          inference_outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, output_size)
+      bc_loss = mdn_lib.mdn_nll_loss(gm, action)
+    else:
+      prediction = inference_outputs['inference_output'].astype(jnp.float32)
+      bc_loss = jnp.mean(jnp.square(prediction - action))
+    return bc_loss, {'bc_loss': bc_loss}
+
+  def pack_features(self, state, prev_episode_data, timestep,
+                    current_episode_data=None) -> SpecStruct:
+    """Packs meta features, splicing in the running episode's history
+    (meta_models.py:548-571)."""
+    np_features = pack_vrgripper_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition_samples_per_task)
+    if current_episode_data is not None and timestep > 0:
+      for key in ('image', 'gripper_pose'):
+        full_key = f'inference/features/{key}/0'
+        np_features[full_key][0, :timestep] = (
+            current_episode_data[full_key][0, :timestep])
+    return np_features

@@ -70,9 +70,15 @@ def _canonical_shape(shape: ShapeLike) -> Tuple[Optional[int], ...]:
   for dim in shape:
     if dim is None:
       out.append(None)
-    else:
+      continue
+    try:
       d = int(dim)
-      out.append(None if d < 0 else d)
+    except Exception:
+      # Symbolic dims (jax.export shape polymorphism) behave like unknown
+      # runtime dims for validation purposes.
+      out.append(None)
+      continue
+    out.append(None if d < 0 else d)
   return tuple(out)
 
 
@@ -159,8 +165,16 @@ class TensorSpec:
     dtype = getattr(array, 'dtype', None)
     if dtype is None:
       dtype = np.asarray(array).dtype
+    def _dim(d):
+      # Symbolic dims (jax.export shape polymorphism) pass through; spec
+      # validation compares them structurally like ints.
+      try:
+        return int(d)
+      except Exception:  # symbolic dims raise their own exception type
+        return d
+
     return cls(
-        shape=tuple(int(d) for d in np.shape(array)),
+        shape=tuple(_dim(d) for d in np.shape(array)),
         dtype=as_dtype(dtype),
         name=name,
         is_extracted=True)

@@ -263,7 +263,10 @@ class Trainer:
     scalars: MetricDict = {}
     eval_metrics: MetricDict = {}
     last_log = time.time()
-    while self.step < config.max_train_steps:
+    # Host-side step mirror: reading self.step would force a device sync
+    # (int(state.step)) after every dispatch, serializing the pipeline.
+    step = self.step
+    while step < config.max_train_steps:
       if first_batch is not None:
         features, labels = first_batch
         first_batch = None
@@ -273,7 +276,7 @@ class Trainer:
       labels = mesh_lib.shard_batch(labels, self._mesh)
       self._state, scalars = self._train_step_fn(
           self._state, features, labels)
-      step = self.step
+      step += 1
       if config.log_interval_steps and step % config.log_interval_steps == 0:
         scalars = {k: float(v) for k, v in scalars.items()}
         dt = time.time() - last_log
@@ -316,9 +319,10 @@ class Trainer:
           break
       features = mesh_lib.shard_batch(features, self._mesh)
       labels = mesh_lib.shard_batch(labels, self._mesh)
-      metrics = self._eval_step_fn(self._state, features, labels)
-      metric_batches.append({k: float(v) for k, v in metrics.items()})
-    metrics = _mean_metrics(metric_batches)
+      # Keep per-batch metrics on device; a float() here would force a
+      # device sync every eval step. One sync happens in _mean_metrics.
+      metric_batches.append(self._eval_step_fn(self._state, features, labels))
+    metrics = _mean_metrics(jax.device_get(metric_batches))
     for cb in self._callbacks:
       cb.after_eval(self, self.step, metrics)
     return metrics

@@ -17,14 +17,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Default static bound for the without-replacement candidate range; robot
+# episodes are ≤ ~100 steps. Pass ``max_sequence_length`` explicitly for
+# longer padded sequences (it is a trace-time constant).
+DEFAULT_MAX_SEQUENCE_LENGTH = 512
 
-def get_subsample_indices(rng: jax.Array,
-                          sequence_lengths: jnp.ndarray,
-                          min_length: int) -> jnp.ndarray:
-  """[B] lengths → [B, min_length] sorted indices (subsample.py:25-82)."""
+
+def get_subsample_indices(
+    rng: jax.Array,
+    sequence_lengths: jnp.ndarray,
+    min_length: int,
+    max_sequence_length: int = DEFAULT_MAX_SEQUENCE_LENGTH) -> jnp.ndarray:
+  """[B] lengths → [B, min_length] sorted indices (subsample.py:25-82).
+
+  ``max_sequence_length`` is the static upper bound on any sequence length
+  (the padded time dimension of the caller's data); it sizes the candidate
+  range for without-replacement sampling under jit.
+  """
   sequence_lengths = jnp.asarray(sequence_lengths, jnp.int32)
   batch = sequence_lengths.shape[0]
-  max_len = 1 << 30
+  n = int(max_sequence_length)
 
   def per_sequence(rng, seq_len):
     if min_length == 1:
@@ -33,11 +45,6 @@ def get_subsample_indices(rng: jax.Array,
     # Without replacement: random permutation of [1, seq_len-1) via masked
     # random keys — padding positions get +inf keys so they sort last.
     perm_rng, unif_rng = jax.random.split(rng)
-    # Middle candidates are positions 1..T-2 (static upper bound needed; use
-    # uniform keys masked by validity).
-    upper = sequence_lengths.max() if sequence_lengths.size else min_length
-    del upper  # static bound comes from the caller's padded data
-    n = int(_static_upper_bound)
     positions = jnp.arange(1, n - 1)
     keys = jax.random.uniform(perm_rng, (n - 2,))
     valid = positions < (seq_len - 1)
@@ -53,19 +60,8 @@ def get_subsample_indices(rng: jax.Array,
         [jnp.zeros((1,), jnp.int32), middle.astype(jnp.int32),
          jnp.asarray([seq_len - 1], jnp.int32)]))
 
-  del max_len
   rngs = jax.random.split(rng, batch)
   return jax.vmap(per_sequence)(rngs, sequence_lengths)
-
-
-# Static bound for the without-replacement candidate range. Callers with
-# longer sequences should set this before tracing (or use the numpy twin).
-_static_upper_bound = 512
-
-
-def set_max_sequence_length(n: int) -> None:
-  global _static_upper_bound
-  _static_upper_bound = int(n)
 
 
 def get_subsample_indices_randomized_boundary(
@@ -73,7 +69,8 @@ def get_subsample_indices_randomized_boundary(
     sequence_lengths: jnp.ndarray,
     min_length: int,
     min_delta_t: int,
-    max_delta_t: int) -> jnp.ndarray:
+    max_delta_t: int,
+    max_sequence_length: int = DEFAULT_MAX_SEQUENCE_LENGTH) -> jnp.ndarray:
   """Randomized start/end window variant (subsample.py:84-160).
 
   Samples a window [t0, t0+delta_t) inside each sequence, then subsamples
@@ -93,7 +90,8 @@ def get_subsample_indices_randomized_boundary(
     u0 = jax.random.uniform(t0_rng)
     t0 = jnp.floor(u0 * (seq_len - delta_t + 1)).astype(jnp.int32)
     inner = get_subsample_indices(
-        sub_rng, jnp.asarray([delta_t]), min_length)[0]
+        sub_rng, jnp.asarray([delta_t]), min_length,
+        max_sequence_length=max_sequence_length)[0]
     return t0 + inner
 
   rngs = jax.random.split(rng, batch)

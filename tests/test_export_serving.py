@@ -239,3 +239,89 @@ class TestPolicies:
     assert policy.global_step == 7
     action, debug = policy.sample_action(np.zeros(3), 0.5)
     assert debug is None
+
+
+class TestSelfContainedServing:
+  """Export artifact usable with no model class / training script.
+
+  VERDICT #6 done-criterion: raw tf.Example bytes + an export dir →
+  actions, without access to the training code.
+  """
+
+  def _export(self, tmp_path):
+    trainer, model = _trained_trainer(tmp_path)
+    root = str(tmp_path / 'export')
+    path = export_lib.ModelExporter().export(model, trainer.state, root)
+    return root, path
+
+  def test_serving_fn_artifact_written(self, tmp_path):
+    _, path = self._export(tmp_path)
+    assert os.path.exists(
+        os.path.join(path, export_lib.exporters.SERVING_FN_FILENAME))
+    import json
+
+    with open(os.path.join(path, 'export_meta.json')) as f:
+      assert json.load(f)['self_contained_serving_fn'] is True
+
+  def test_predict_without_model_class(self, tmp_path, monkeypatch):
+    root, _ = self._export(tmp_path)
+    # Prove the model class is never imported: break the fallback loader.
+    monkeypatch.setattr(
+        export_lib.exporters, 'load_model_from_export_dir',
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError('model class must not be loaded')))
+    predictor = ExportedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    assert predictor._model is None
+    spec = predictor.get_feature_specification()
+    from tensor2robot_tpu.specs import make_random_numpy
+
+    features = make_random_numpy(spec, batch_size=3)
+    outputs = predictor.predict(dict(features))
+    assert 'logit' in outputs or len(outputs)
+    (value,) = [v for k, v in outputs.items()][:1]
+    assert np.asarray(value).shape[0] == 3
+
+  def test_symbolic_batch_dimension(self, tmp_path):
+    root, _ = self._export(tmp_path)
+    predictor = ExportedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    from tensor2robot_tpu.specs import make_random_numpy
+
+    spec = predictor.get_feature_specification()
+    for batch in (1, 4, 7):
+      outputs = predictor.predict(dict(make_random_numpy(spec,
+                                                         batch_size=batch)))
+      first = next(iter(outputs.values()))
+      assert np.asarray(first).shape[0] == batch
+
+  def test_predict_from_example_bytes(self, tmp_path, monkeypatch):
+    root, _ = self._export(tmp_path)
+    monkeypatch.setattr(
+        export_lib.exporters, 'load_model_from_export_dir',
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError('model class must not be loaded')))
+    predictor = ExportedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    from tensor2robot_tpu.data import example_codec
+    from tensor2robot_tpu.specs import make_random_numpy
+
+    spec = predictor.get_feature_specification()
+    batch = make_random_numpy(spec, batch_size=2)
+    records = [
+        example_codec.encode_example(
+            spec, {k: np.asarray(v)[b] for k, v in batch.items()})
+        for b in range(2)
+    ]
+    outputs = predictor.predict_example_bytes(records)
+    first = next(iter(outputs.values()))
+    assert np.asarray(first).shape[0] == 2
+
+  def test_warmup_requests_replay(self, tmp_path):
+    root, path = self._export(tmp_path)
+    assets = os.path.join(path, 'assets.extra')
+    assert os.path.exists(
+        os.path.join(assets, export_lib.exporters.WARMUP_NPZ_FILENAME))
+    predictor = ExportedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    assert predictor.warmup() >= 1

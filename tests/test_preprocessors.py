@@ -203,3 +203,37 @@ class TestPhotometric:
     out = image_transformations.apply_depth_image_distortions(
         rng, depth, random_noise_level=0.1)
     assert out.shape == depth.shape
+
+
+class TestPallasPhotometric:
+  """ops/photometric.py matches the plain-jax distortion chain."""
+
+  def test_fused_matches_jax_chain(self):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tensor2robot_tpu.ops import fused_brightness_contrast
+    from tensor2robot_tpu.preprocessors import image_transformations as it
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.rand(3, 16, 24, 3).astype(np.float32))
+    delta = jnp.asarray([0.1, -0.05, 0.0], jnp.float32)
+    factor = jnp.asarray([1.3, 0.7, 1.0], jnp.float32)
+
+    fused = fused_brightness_contrast(images, delta, factor, interpret=True)
+    ref = it.adjust_brightness(images, delta[:, None, None, None])
+    ref = it.adjust_contrast(ref, factor[:, None, None, None])
+    ref = jnp.clip(ref, 0.0, 1.0)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               atol=1e-5)
+
+  def test_random_wrapper_shapes_and_range(self):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tensor2robot_tpu.ops import random_brightness_contrast
+
+    images = jnp.ones((2, 8, 8, 3), jnp.float32) * 0.5
+    out = random_brightness_contrast(jax.random.PRNGKey(0), images)
+    assert out.shape == images.shape
+    assert float(jnp.min(out)) >= 0.0 and float(jnp.max(out)) <= 1.0
