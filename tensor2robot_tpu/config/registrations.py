@@ -19,7 +19,8 @@ def register() -> None:
   _REGISTERED = True
 
   from tensor2robot_tpu.data import input_generators as ig
-  from tensor2robot_tpu.models import optimizers
+  from tensor2robot_tpu.models import optimizers, warm_start
+  from tensor2robot_tpu.train import callbacks as callbacks_lib
   from tensor2robot_tpu.parallel import mesh as mesh_lib
   from tensor2robot_tpu.train import trainer as trainer_lib
   from tensor2robot_tpu.utils import mocks
@@ -30,6 +31,7 @@ def register() -> None:
   reg(trainer_lib.predict_from_model, 'predict_from_model')
   # Input generators (input_generators/*.py).
   reg(ig.DefaultRecordInputGenerator, 'DefaultRecordInputGenerator')
+  reg(ig.TaskGroupedRecordInputGenerator, 'TaskGroupedRecordInputGenerator')
   reg(ig.FractionalRecordInputGenerator, 'FractionalRecordInputGenerator')
   reg(ig.MultiEvalRecordInputGenerator, 'MultiEvalRecordInputGenerator')
   reg(ig.GeneratorInputGenerator, 'GeneratorInputGenerator')
@@ -45,6 +47,15 @@ def register() -> None:
       'create_constant_learning_rate')
   reg(optimizers.create_exp_decaying_learning_rate_fn,
       'create_exp_decaying_learning_rate')
+  # Warm start + callbacks.
+  reg(warm_start.default_init_from_checkpoint_fn,
+      'default_init_from_checkpoint_fn')
+  reg(warm_start.create_resnet_init_from_checkpoint_fn,
+      'create_resnet_init_from_checkpoint_fn')
+  reg(callbacks_lib.TensorBoardCallback, 'TensorBoardCallback')
+  reg(callbacks_lib.MetricsLoggerCallback, 'MetricsLoggerCallback')
+  reg(callbacks_lib.VariableLoggerCallback, 'VariableLoggerCallback')
+  reg(callbacks_lib.ProfilerCallback, 'ProfilerCallback')
   # Mesh.
   reg(mesh_lib.create_mesh, 'create_mesh')
   reg(mesh_lib.MeshSpec, 'MeshSpec')

@@ -162,6 +162,91 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
     self.multi_eval_name = multi_eval_name
 
 
+class TaskGroupedRecordInputGenerator(AbstractInputGenerator):
+  """Per-task file interleave feeding MAML's meta-batch layout.
+
+  Each record FILE holds one task's examples (base model specs on disk).
+  Every meta batch groups ``num_train_samples_per_task`` condition +
+  ``num_val_samples_per_task`` inference examples per task, for
+  ``batch_size`` tasks:
+
+  * ``condition/features/*``, ``condition/labels/*`` —
+    [tasks, num_train, ...]
+  * ``inference/features/*`` — [tasks, num_val, ...]
+  * labels — the inference examples' labels, [tasks, num_val, ...]
+
+  Capability-equivalent of the reference's task-grouped ``parallel_read``
+  (``meta_learning/meta_tfdata.py:37-132``) feeding ``MAMLPreprocessorV2``.
+  """
+
+  def __init__(self,
+               file_patterns: str,
+               num_train_samples_per_task: int = 4,
+               num_val_samples_per_task: int = 4,
+               shuffle_buffer_size: int = 50,
+               interleave_cycle_length: Optional[int] = None,
+               batch_size: int = 4,
+               seed: Optional[int] = None):
+    super().__init__(batch_size)
+    self._file_patterns = file_patterns
+    self._num_train = num_train_samples_per_task
+    self._num_val = num_val_samples_per_task
+    self._shuffle_buffer_size = shuffle_buffer_size
+    self._interleave_cycle_length = interleave_cycle_length
+    self._seed = seed
+    self._base_feature_spec: Optional[SpecStruct] = None
+    self._base_label_spec: Optional[SpecStruct] = None
+
+  def set_specification_from_model(self, model, mode: str) -> None:
+    """Pulls BASE specs (the on-disk record contract) from the wrapped
+    preprocessor; the meta layout is reassembled by this generator."""
+    super().set_specification_from_model(model, mode)
+    preprocessor = model.preprocessor
+    # Unwrap dtype-policy and MAML wrappers down to the base preprocessor.
+    while True:
+      if hasattr(preprocessor, 'base_preprocessor'):
+        preprocessor = preprocessor.base_preprocessor
+        continue
+      break
+    self._base_feature_spec = algebra.flatten_spec_structure(
+        preprocessor.get_in_feature_specification(mode))
+    self._base_label_spec = algebra.flatten_spec_structure(
+        preprocessor.get_in_label_specification(mode))
+
+  def _create_iterator(self, mode, batch_size):
+    if self._base_feature_spec is None:
+      raise ValueError(
+          'TaskGroupedRecordInputGenerator needs base specs; call '
+          'set_specification_from_model first.')
+    num_train = self._num_train
+
+    dataset = pipeline.make_task_grouped_dataset(
+        self._file_patterns,
+        self._base_feature_spec,
+        self._base_label_spec,
+        mode=mode,
+        task_batch_size=batch_size,
+        num_train_samples_per_task=num_train,
+        num_val_samples_per_task=self._num_val,
+        shuffle_buffer_size=self._shuffle_buffer_size,
+        interleave_cycle_length=self._interleave_cycle_length,
+        seed=self._seed)
+
+    def iterate():
+      for features, labels in dataset.as_numpy_iterator():
+        meta = SpecStruct()
+        for key, value in features.items():
+          meta[f'condition/features/{key}'] = value[:, :num_train]
+          meta[f'inference/features/{key}'] = value[:, num_train:]
+        for key, value in labels.items():
+          meta[f'condition/labels/{key}'] = value[:, :num_train]
+        meta_labels = SpecStruct(
+            {key: value[:, num_train:] for key, value in labels.items()})
+        yield meta, meta_labels
+
+    return iterate()
+
+
 class GeneratorInputGenerator(AbstractInputGenerator):
   """Batches produced by a user-supplied python generator of examples.
 

@@ -25,13 +25,29 @@ def _tf():
   return tf
 
 
+def shard_filenames_for_process(filenames):
+  """Per-host file sharding: each jax process reads a distinct slice.
+
+  The multi-host feeding contract (reference: TPUEstimator's per-host
+  ``input_fn``): with fewer files than processes the caller falls back to
+  element-level sharding. No-op in single-process runs.
+  """
+  import jax
+
+  process_count = jax.process_count()
+  if process_count <= 1 or len(filenames) < process_count:
+    return filenames, False
+  return list(filenames)[jax.process_index()::process_count], True
+
+
 def make_serialized_dataset(file_patterns: Union[str, Dict[str, str]],
                             batch_size: int,
                             is_training: bool,
                             shuffle_buffer_size: int = 1000,
                             parallel_shards: int = 10,
                             repeat: bool = True,
-                            seed: Optional[int] = None):
+                            seed: Optional[int] = None,
+                            shard_by_process: bool = True):
   """Batched serialized-example dataset; dict patterns -> zipped dict."""
   tf = _tf()
   if isinstance(file_patterns, dict):
@@ -41,14 +57,37 @@ def make_serialized_dataset(file_patterns: Union[str, Dict[str, str]],
   datasets = {}
   for dataset_key, patterns in patterns_map.items():
     data_format, filenames = records.get_data_format_and_filenames(patterns)
-    files = tf.data.Dataset.list_files(
-        filenames, shuffle=is_training, seed=seed)
-    cycle_length = min(parallel_shards, len(filenames)) if is_training else 1
-    dataset = files.interleave(
-        records.DATA_FORMATS[data_format],
-        cycle_length=cycle_length,
-        num_parallel_calls=tf.data.AUTOTUNE,
-        deterministic=not is_training)
+    sharded_by_file = False
+    if shard_by_process:
+      filenames, sharded_by_file = shard_filenames_for_process(filenames)
+    element_shard = False
+    if shard_by_process and not sharded_by_file:
+      import jax
+
+      element_shard = jax.process_count() > 1
+    if element_shard:
+      # Fewer files than processes: shard at the example level. The shard
+      # must partition an IDENTICALLY-ORDERED stream on every host, so
+      # read files sequentially and deterministically (shuffling AFTER
+      # the shard restores randomness).
+      import jax
+
+      files = tf.data.Dataset.from_tensor_slices(sorted(filenames))
+      dataset = files.interleave(
+          records.DATA_FORMATS[data_format],
+          cycle_length=1,
+          deterministic=True)
+      dataset = dataset.shard(jax.process_count(), jax.process_index())
+    else:
+      files = tf.data.Dataset.list_files(
+          filenames, shuffle=is_training, seed=seed)
+      cycle_length = (
+          min(parallel_shards, len(filenames)) if is_training else 1)
+      dataset = files.interleave(
+          records.DATA_FORMATS[data_format],
+          cycle_length=cycle_length,
+          num_parallel_calls=tf.data.AUTOTUNE,
+          deterministic=not is_training)
     if is_training:
       dataset = dataset.shuffle(shuffle_buffer_size, seed=seed)
     if repeat:
@@ -98,6 +137,74 @@ def make_dataset(file_patterns,
       parse, num_parallel_calls=num_parallel_calls or tf.data.AUTOTUNE)
   if preprocess_fn is not None:
     dataset = dataset.map(preprocess_fn, num_parallel_calls=tf.data.AUTOTUNE)
+  return dataset.prefetch(tf.data.AUTOTUNE)
+
+
+def make_task_grouped_dataset(file_patterns: str,
+                              feature_spec,
+                              label_spec=None,
+                              mode: str = modes.ModeKeys.TRAIN,
+                              task_batch_size: int = 4,
+                              num_train_samples_per_task: int = 4,
+                              num_val_samples_per_task: int = 4,
+                              shuffle_buffer_size: int = 50,
+                              interleave_cycle_length: Optional[int] = None,
+                              shuffle_filenames: bool = True,
+                              seed: Optional[int] = None):
+  """Per-task file interleave emitting [task_batch, samples, ...] batches.
+
+  Capability-equivalent of the reference's task-grouped ``parallel_read``
+  (``meta_learning/meta_tfdata.py:37-132``): each FILE holds one task's
+  examples; every element dequeues ``num_train + num_val`` examples from
+  ONE task (so meta-learning sees per-task sample groups), tasks are
+  interleaved block_length=1, and ``task_batch_size`` tasks form the meta
+  batch.
+  """
+  tf = _tf()
+  data_format, filenames = records.get_data_format_and_filenames(
+      file_patterns)
+  # Multi-host: each process owns a distinct slice of task files.
+  filenames, _ = shard_filenames_for_process(filenames)
+  num_tasks = len(filenames)
+  samples = num_train_samples_per_task + num_val_samples_per_task
+  is_training = modes.is_training(mode)
+
+  files = tf.data.Dataset.from_tensor_slices(filenames)
+  if shuffle_filenames and is_training:
+    files = files.shuffle(buffer_size=num_tasks, seed=seed).repeat()
+  else:
+    files = files.repeat()
+
+  def per_task(filename):
+    task = records.DATA_FORMATS[data_format](filename)
+    if is_training:
+      # ONE sample-group per file visit: an infinite (repeat'd) inner
+      # dataset would permanently starve tasks beyond the first
+      # interleave cycle (tf.data only advances the cycle when an inner
+      # iterator exhausts). The filenames stream repeats, so every task
+      # recurs across visits.
+      task = task.shuffle(
+          buffer_size=max(shuffle_buffer_size, samples), seed=seed)
+      return task.repeat().batch(samples, drop_remainder=True).take(1)
+    # Eval: drain the file's groups once per filename epoch.
+    return task.batch(samples, drop_remainder=True)
+
+  dataset = files.interleave(
+      per_task,
+      cycle_length=interleave_cycle_length or num_tasks,
+      block_length=1)
+
+  parse_fn = example_codec.make_parse_fn(feature_spec, label_spec)
+
+  def parse(serialized):
+    parsed = parse_fn(serialized)
+    if label_spec is not None:
+      features, labels = parsed
+      return dict(features.items()), dict(labels.items())
+    return dict(parsed.items())
+
+  dataset = dataset.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+  dataset = dataset.batch(task_batch_size, drop_remainder=True)
   return dataset.prefetch(tf.data.AUTOTUNE)
 
 

@@ -17,3 +17,8 @@ from tensor2robot_tpu.models.classification_model import (
 from tensor2robot_tpu.models.critic_model import CriticModel, log_loss
 from tensor2robot_tpu.models.regression_model import RegressionModel
 from tensor2robot_tpu.models import optimizers
+from tensor2robot_tpu.models.warm_start import (
+    create_resnet_init_from_checkpoint_fn,
+    default_init_from_checkpoint_fn,
+    load_checkpoint_variables,
+)

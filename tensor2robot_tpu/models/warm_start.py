@@ -1,0 +1,152 @@
+"""Warm start: partial parameter restore from a checkpoint or export.
+
+Capability-equivalent of the reference's
+``default_init_from_checkpoint_fn`` (``models/abstract_model.py:88-118``,
+``tf.train.init_from_checkpoint`` with optional partial restore) and the
+ResNet pretrained-checkpoint restore (``layers/resnet.py:152-218``: load
+ImageNet backbone weights, excluding FiLM and the classifier head).
+
+The returned function plugs into ``AbstractT2RModel(init_from_checkpoint_fn=...)``
+and runs inside ``create_train_state`` after random init: matching
+parameter paths (by '/'-joined key and shape) are overwritten from the
+source checkpoint, everything else keeps its fresh initialization.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from flax import traverse_util
+
+
+def _flatten(tree) -> Dict[str, Any]:
+  if not isinstance(tree, Mapping):
+    return {'': tree}
+  return traverse_util.flatten_dict(dict(tree), sep='/')
+
+
+def load_checkpoint_variables(checkpoint_path: str):
+  """Loads a raw variable tree from any framework artifact.
+
+  Accepts: an export version dir (``state/``), a trainer step dir
+  (``ckpt_<n>/`` with ``default/``), or a bare Orbax pytree dir.
+  """
+  import orbax.checkpoint as ocp
+
+  path = os.path.abspath(checkpoint_path)
+  for sub in ('state', 'default'):
+    if os.path.isdir(os.path.join(path, sub)):
+      path = os.path.join(path, sub)
+      break
+  return ocp.PyTreeCheckpointer().restore(path)
+
+
+def _split_source(tree) -> Tuple[Mapping, Mapping]:
+  """(params, model_state) from a TrainState payload or variables dict."""
+  if isinstance(tree, Mapping) and 'params' in tree:
+    if 'opt_state' in tree or 'step' in tree:  # TrainState payload
+      return tree['params'], dict(tree.get('model_state') or {})
+    state = {k: v for k, v in tree.items() if k != 'params'}
+    return tree['params'], state
+  return tree, {}
+
+
+def default_init_from_checkpoint_fn(
+    checkpoint_path: str,
+    include: Optional[Sequence[str]] = None,
+    exclude: Sequence[str] = (),
+    source_prefix: str = '',
+    target_prefix: str = '',
+    restore_model_state: bool = True) -> Callable:
+  """Builds an ``init_from_checkpoint_fn(params, model_state)`` hook.
+
+  Args:
+    checkpoint_path: source artifact (see :func:`load_checkpoint_variables`).
+    include: if given, only parameter paths containing one of these
+      substrings are restored.
+    exclude: parameter paths containing any of these substrings are kept
+      at their fresh initialization (e.g. a classifier head).
+    source_prefix: path prefix to strip from source keys (restore a
+      submodule trained standalone into a larger model).
+    target_prefix: path prefix to prepend when matching target keys.
+    restore_model_state: also restore matching non-trainable collections
+      (batch_stats etc.).
+
+  Returns:
+    ``fn(params, model_state) -> (params, model_state)`` restoring every
+    matching (path, shape) pair; raises if nothing matched.
+  """
+
+  def _selected(path: str) -> bool:
+    if include is not None and not any(s in path for s in include):
+      return False
+    return not any(s in path for s in exclude)
+
+  def _restore_tree(target, source) -> Tuple[Any, int]:
+    flat_target = dict(_flatten(target))
+    flat_source = _flatten(source)
+    matched = 0
+    for path, value in flat_target.items():
+      src_key = source_prefix + path[len(target_prefix):] if path.startswith(
+          target_prefix) else None
+      if src_key is None or not _selected(path):
+        continue
+      if src_key not in flat_source:
+        continue
+      src_value = flat_source[src_key]
+      if tuple(np.shape(src_value)) != tuple(np.shape(value)):
+        logging.warning(
+            'warm start: shape mismatch at %s: %s vs %s — skipped', path,
+            np.shape(src_value), np.shape(value))
+        continue
+      flat_target[path] = np.asarray(src_value).astype(
+          np.asarray(value).dtype)
+      matched += 1
+    return traverse_util.unflatten_dict(flat_target, sep='/'), matched
+
+  def init_fn(params, model_state):
+    tree = load_checkpoint_variables(checkpoint_path)
+    src_params, src_state = _split_source(tree)
+    params, matched = _restore_tree(params, src_params)
+    total_state_matched = 0
+    if restore_model_state and model_state and src_state:
+      model_state = dict(model_state)
+      for collection, target in model_state.items():
+        if collection in src_state:
+          model_state[collection], n = _restore_tree(
+              target, src_state[collection])
+          total_state_matched += n
+    if matched == 0:
+      raise ValueError(
+          f'Warm start from {checkpoint_path!r} matched no parameters '
+          f'(include={include}, exclude={list(exclude)}).')
+    logging.info('warm start: restored %d params + %d state vars from %s',
+                 matched, total_state_matched, checkpoint_path)
+    return params, model_state
+
+  return init_fn
+
+
+def create_resnet_init_from_checkpoint_fn(
+    checkpoint_path: str,
+    restore_film: bool = False,
+    restore_head: bool = False,
+    **kwargs) -> Callable:
+  """Pretrained-ResNet partial restore (``layers/resnet.py:152-218``).
+
+  Restores the backbone (convs + norms) from a checkpoint of a
+  :class:`...layers.resnet.FilmResNet`/``ResNet`` model, keeping the FiLM
+  generator and the classifier head (``final_dense``) freshly initialized
+  unless explicitly requested.
+  """
+  exclude = list(kwargs.pop('exclude', ()))
+  if not restore_film:
+    exclude.append('film')
+  if not restore_head:
+    exclude.append('final_dense')
+  return default_init_from_checkpoint_fn(
+      checkpoint_path, exclude=tuple(exclude), **kwargs)

@@ -17,3 +17,10 @@ from tensor2robot_tpu.parallel.mesh import (
     single_device_mesh,
     state_shardings_for,
 )
+from tensor2robot_tpu.parallel.sequence_parallel import (
+    make_ring_attention,
+    make_ulysses_attention,
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -128,8 +128,22 @@ def global_batch_size(per_device_batch: int, mesh: Mesh) -> int:
 
 
 def shard_batch(batch: Any, mesh: Mesh) -> Any:
-  """Places a host-global numpy batch onto the mesh, sharded on batch axes."""
+  """Places a batch onto the mesh, sharded on the batch axes.
+
+  Single-process: ``batch`` is the global batch; a plain sharded
+  ``device_put``. Multi-host (``jax.process_count() > 1``): each process
+  passes its PROCESS-LOCAL shard (fed by per-host file sharding in the
+  input pipeline) and the global array is assembled with
+  ``jax.make_array_from_process_local_data`` — the reference gets this
+  per-host feeding from TPUEstimator's per-host ``input_fn``
+  (``utils/tfdata.py:43-66``); feeding a host-global batch on every host
+  would silently duplicate data across hosts.
+  """
   sharding = batch_sharding(mesh)
+  if jax.process_count() > 1:
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)), batch)
   return jax.tree_util.tree_map(
       lambda x: jax.device_put(x, sharding), batch)
 

@@ -307,7 +307,13 @@ class ExportedModelPredictor(AbstractPredictor):
     return self.predict(features)
 
   def warmup(self) -> int:
-    """Replays the export's recorded warmup requests; returns the count."""
+    """Replays the export's recorded warmup requests; returns the count.
+
+    Prefers the serialized-example records (exercising the bytes
+    receiver); on a TF-free host (the parser needs the host-side TF
+    wheel) falls back to the ``.npz`` numpy requests through
+    ``predict`` — so jax-only robot hosts still warm up.
+    """
     self.assert_is_loaded()
     path = f'{self._loaded_dir}'
     count = 0
@@ -315,8 +321,24 @@ class ExportedModelPredictor(AbstractPredictor):
       for record in exporters_lib.read_warmup_examples(path):
         self.predict_example_bytes([record])
         count += 1
-    except FileNotFoundError:
+      if count:
+        return count
+    except (FileNotFoundError, ImportError):
       pass
+    # npz fallback: arrays are keyed '<feature_path>/<request_index>'.
+    npz_path = os.path.join(
+        path, 'assets.extra', exporters_lib.WARMUP_NPZ_FILENAME)
+    try:
+      arrays = np.load(npz_path)
+    except FileNotFoundError:
+      return count
+    requests: Dict[str, Dict[str, np.ndarray]] = {}
+    for key in arrays.files:
+      feature_key, _, index = key.rpartition('/')
+      requests.setdefault(index, {})[feature_key] = arrays[key]
+    for request in requests.values():
+      self.predict(request)
+      count += 1
     return count
 
   @property

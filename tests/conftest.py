@@ -25,3 +25,7 @@ os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
+
+
+def pytest_configure(config):
+  config.addinivalue_line('markers', 'slow: slower multi-process tests')

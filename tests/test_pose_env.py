@@ -147,7 +147,19 @@ class TestPoseEnvModels:
         save_interval_steps=50,
         log_interval_steps=0)
     assert np.isfinite(metrics['pose_mse'])
-    assert metrics['pose_mse'] < 1.0  # random poses have var ~0.16/0.07
+    # Threshold anchored to the recorded converged measurement
+    # (BASELINE.json measured.pose_env_eval_mse, 300 TPU steps): a 50-step
+    # CPU run must get within ~2 orders of magnitude of convergence —
+    # loose enough for CI noise, tight enough to catch the
+    # negative-reward-weight divergence this workload once had.
+    import json
+
+    baseline_path = os.path.join(os.path.dirname(TEST_DATA), '..', '..',
+                                 'BASELINE.json')
+    measured = json.load(open(baseline_path)).get('measured', {}).get(
+        'pose_env_eval_mse')
+    threshold = max(100 * measured, 0.2) if measured else 1.0
+    assert metrics['pose_mse'] < threshold, metrics['pose_mse']
 
 
 class TestPoseEnvPolicies:

@@ -1,0 +1,87 @@
+"""Ring/Ulysses sequence parallelism vs full-attention oracle.
+
+Runs on the virtual 8-device CPU mesh (conftest): sequence sharded over a
+4-way ``seq`` axis, numerics compared against plain full attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel.sequence_parallel import (
+    make_ring_attention,
+    make_ulysses_attention,
+    reference_attention,
+)
+
+
+@pytest.fixture(scope='module')
+def seq_mesh():
+  return mesh_lib.create_mesh(data=2, seq=4)
+
+
+def _qkv(batch=2, t=32, heads=4, dim=8, seed=0):
+  rng = np.random.RandomState(seed)
+  shape = (batch, t, heads, dim)
+  return tuple(
+      jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.5)
+      for _ in range(3))
+
+
+class TestRingAttention:
+
+  @pytest.mark.parametrize('causal', [False, True])
+  def test_matches_full_attention(self, seq_mesh, causal):
+    q, k, v = _qkv()
+    ring = jax.jit(make_ring_attention(seq_mesh, causal=causal))
+    out = ring(q, k, v)
+    expected = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5)
+
+  def test_local_memory_is_blockwise(self, seq_mesh):
+    # The jitted computation never materializes the full [T, T] score
+    # matrix per device: with T=64 over 4 shards, per-device logits are
+    # [B, H, 16, 16] per hop. Smoke: it runs with a T that would OOM a
+    # quadratic per-device buffer only at much larger scale — here we just
+    # assert correctness at a larger T.
+    q, k, v = _qkv(t=64, seed=3)
+    out = jax.jit(make_ring_attention(seq_mesh))(q, k, v)
+    expected = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5)
+
+  def test_grads_flow(self, seq_mesh):
+    q, k, v = _qkv(t=16, seed=5)
+    ring = make_ring_attention(seq_mesh, causal=True)
+
+    def loss(q, k, v):
+      return jnp.sum(ring(q, k, v) ** 2)
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    ref_grads = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+      np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+class TestUlyssesAttention:
+
+  @pytest.mark.parametrize('causal', [False, True])
+  def test_matches_full_attention(self, seq_mesh, causal):
+    q, k, v = _qkv()
+    ulysses = jax.jit(make_ulysses_attention(seq_mesh, causal=causal))
+    out = ulysses(q, k, v)
+    expected = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5)
+
+  def test_rejects_indivisible_heads(self, seq_mesh):
+    q, k, v = _qkv(heads=3)
+    ulysses = make_ulysses_attention(seq_mesh)
+    with pytest.raises(Exception):
+      jax.jit(ulysses)(q, k, v)

@@ -1,0 +1,160 @@
+"""Measures BASELINE.md's target numbers and records them in BASELINE.json.
+
+The reference publishes no benchmarks (BASELINE.md), so the measurable
+targets come from running its testable workloads in THIS framework on one
+chip:
+
+1. pose_env regression on tests/test_data/pose_env_test_data.tfrecord —
+   converged eval pose_mse.
+2. QT-Opt grasping critic — steps/sec/chip (bench.py's headline; recorded
+   there).
+3. Grasp2Vec — steps/sec/chip.
+4. WTL vision trial model — steps/sec/chip.
+5. MAML over pose_env tasks — steps/sec/chip + adaptation eval loss.
+
+Run: python tools/measure_baselines.py  (on the TPU box; ~minutes)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+TEST_DATA = os.path.join(REPO, 'tests', 'test_data',
+                         'pose_env_test_data.tfrecord')
+
+
+def _steps_per_sec(model, batch_size: int, steps: int = 50,
+                   generator=None) -> float:
+  """Times the jitted train step over device-resident random batches."""
+  import jax
+
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRandomInputGenerator)
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.train import Trainer, TrainerConfig
+
+  generator = generator or DefaultRandomInputGenerator(
+      batch_size=batch_size)
+  generator.batch_size = batch_size
+  generator.set_specification_from_model(model, ModeKeys.TRAIN)
+  config = TrainerConfig(model_dir='', max_train_steps=1,
+                         eval_interval_steps=0, log_interval_steps=0)
+  trainer = Trainer(model, config)
+  it = generator.create_iterator(ModeKeys.TRAIN)
+  trainer.train(it, None)
+  state = trainer.state
+  step_fn = trainer._train_step_fn  # pylint: disable=protected-access
+  batches = []
+  for _ in range(4):
+    features, labels = next(it)
+    batches.append((mesh_lib.shard_batch(features, trainer.mesh),
+                    mesh_lib.shard_batch(labels, trainer.mesh)))
+  for i in range(3):
+    state, _ = step_fn(state, *batches[i % 4])
+  jax.block_until_ready(state.params)
+  t0 = time.perf_counter()
+  for i in range(steps):
+    state, _ = step_fn(state, *batches[i % 4])
+  jax.block_until_ready(state.params)
+  return steps / (time.perf_counter() - t0)
+
+
+def measure_pose_env_convergence(max_train_steps: int = 400) -> dict:
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+  from tensor2robot_tpu.train import train_eval_model
+
+  import tempfile
+
+  model = PoseEnvRegressionModel(device_type='tpu')
+  with tempfile.TemporaryDirectory() as tmp:
+    metrics = train_eval_model(
+        model=model,
+        model_dir=tmp,
+        train_input_generator=DefaultRecordInputGenerator(
+            file_patterns=TEST_DATA, batch_size=32),
+        eval_input_generator=DefaultRecordInputGenerator(
+            file_patterns=TEST_DATA, batch_size=32),
+        max_train_steps=max_train_steps,
+        eval_steps=4,
+        eval_interval_steps=0,
+        save_interval_steps=max_train_steps,
+        log_interval_steps=0)
+  return {
+      'pose_env_eval_mse': round(float(metrics['pose_mse']), 6),
+      'pose_env_eval_loss': round(float(metrics['loss']), 6),
+      'pose_env_train_steps': max_train_steps,
+  }
+
+
+def measure_grasp2vec() -> float:
+  from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
+
+  return _steps_per_sec(Grasp2VecModel(device_type='tpu'), batch_size=16)
+
+
+def measure_wtl_vision() -> float:
+  from tensor2robot_tpu.research.vrgripper import (
+      VRGripperEnvVisionTrialModel)
+
+  model = VRGripperEnvVisionTrialModel(
+      device_type='tpu', episode_length=40)
+  return _steps_per_sec(model, batch_size=4)
+
+
+def measure_pose_env_maml() -> float:
+  from tensor2robot_tpu.meta_learning import MAMLModel
+  from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModelMAML
+  from tensor2robot_tpu.research.pose_env.pose_env_models import (
+      PoseEnvRegressionModel)
+
+  model = PoseEnvRegressionModelMAML(
+      base_model=PoseEnvRegressionModel(device_type='tpu'),
+      num_inner_loop_steps=1)
+  return _steps_per_sec(model, batch_size=4)
+
+
+def main():
+  import jax
+
+  on_tpu = jax.default_backend() != 'cpu'
+  if not on_tpu:
+    print('WARNING: not on TPU; numbers will not be recorded.')
+
+  measured = {}
+  print('pose_env convergence ...', flush=True)
+  measured.update(measure_pose_env_convergence())
+  print(f"  pose_env_eval_mse={measured['pose_env_eval_mse']}", flush=True)
+  print('grasp2vec steps/sec ...', flush=True)
+  measured['grasp2vec_steps_per_sec_per_chip'] = round(
+      measure_grasp2vec(), 3)
+  print(f"  {measured['grasp2vec_steps_per_sec_per_chip']}", flush=True)
+  print('wtl vision steps/sec ...', flush=True)
+  measured['wtl_vision_steps_per_sec_per_chip'] = round(
+      measure_wtl_vision(), 3)
+  print(f"  {measured['wtl_vision_steps_per_sec_per_chip']}", flush=True)
+  print('pose_env maml steps/sec ...', flush=True)
+  measured['pose_env_maml_steps_per_sec_per_chip'] = round(
+      measure_pose_env_maml(), 3)
+  print(f"  {measured['pose_env_maml_steps_per_sec_per_chip']}", flush=True)
+
+  print(json.dumps(measured, indent=2))
+  if on_tpu:
+    path = os.path.join(REPO, 'BASELINE.json')
+    with open(path) as f:
+      record = json.load(f)
+    record.setdefault('measured', {}).update(measured)
+    with open(path, 'w') as f:
+      json.dump(record, f, indent=2)
+    print(f'recorded into {path}')
+
+
+if __name__ == '__main__':
+  main()
