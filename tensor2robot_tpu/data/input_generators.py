@@ -203,11 +203,8 @@ class TaskGroupedRecordInputGenerator(AbstractInputGenerator):
     super().set_specification_from_model(model, mode)
     preprocessor = model.preprocessor
     # Unwrap dtype-policy and MAML wrappers down to the base preprocessor.
-    while True:
-      if hasattr(preprocessor, 'base_preprocessor'):
-        preprocessor = preprocessor.base_preprocessor
-        continue
-      break
+    while hasattr(preprocessor, 'base_preprocessor'):
+      preprocessor = preprocessor.base_preprocessor
     self._base_feature_spec = algebra.flatten_spec_structure(
         preprocessor.get_in_feature_specification(mode))
     self._base_label_spec = algebra.flatten_spec_structure(

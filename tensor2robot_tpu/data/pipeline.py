@@ -70,8 +70,6 @@ def make_serialized_dataset(file_patterns: Union[str, Dict[str, str]],
       # must partition an IDENTICALLY-ORDERED stream on every host, so
       # read files sequentially and deterministically (shuffling AFTER
       # the shard restores randomness).
-      import jax
-
       files = tf.data.Dataset.from_tensor_slices(sorted(filenames))
       dataset = files.interleave(
           records.DATA_FORMATS[data_format],
@@ -161,21 +159,41 @@ def make_task_grouped_dataset(file_patterns: str,
   batch.
   """
   tf = _tf()
+  import jax
+
   data_format, filenames = records.get_data_format_and_filenames(
       file_patterns)
-  # Multi-host: each process owns a distinct slice of task files.
-  filenames, _ = shard_filenames_for_process(filenames)
+  # Multi-host: each process owns a distinct slice of task files. With
+  # fewer task files than processes, fall back to sharding the stream of
+  # task GROUPS below (mirrors make_serialized_dataset's element shard)
+  # so hosts never silently feed duplicate data.
+  filenames, sharded_by_file = shard_filenames_for_process(filenames)
+  group_shard = not sharded_by_file and jax.process_count() > 1
   num_tasks = len(filenames)
   samples = num_train_samples_per_task + num_val_samples_per_task
   is_training = modes.is_training(mode)
 
+  if group_shard:
+    filenames = sorted(filenames)
   files = tf.data.Dataset.from_tensor_slices(filenames)
   if shuffle_filenames and is_training:
-    files = files.shuffle(buffer_size=num_tasks, seed=seed).repeat()
+    shuffle_seed = seed
+    if group_shard and shuffle_seed is None:
+      # The positional shard below only partitions the task stream if
+      # every host walks it in the same order.
+      shuffle_seed = 0
+    files = files.shuffle(buffer_size=num_tasks, seed=shuffle_seed).repeat()
   else:
     files = files.repeat()
 
-  def per_task(filename):
+  # Enumerate file visits: every per_task invocation builds FRESH shuffle
+  # ops, so a constant user seed would make each visit to a task (and
+  # every host, under the group shard) draw the identical sample group
+  # forever. Mixing the visit index in keeps runs reproducible while
+  # varying the draw per visit.
+  files = files.enumerate()
+
+  def per_task(visit, filename):
     task = records.DATA_FORMATS[data_format](filename)
     if is_training:
       # ONE sample-group per file visit: an infinite (repeat'd) inner
@@ -183,16 +201,34 @@ def make_task_grouped_dataset(file_patterns: str,
       # interleave cycle (tf.data only advances the cycle when an inner
       # iterator exhausts). The filenames stream repeats, so every task
       # recurs across visits.
+      visit_seed = None if seed is None else seed + visit
       task = task.shuffle(
-          buffer_size=max(shuffle_buffer_size, samples), seed=seed)
+          buffer_size=max(shuffle_buffer_size, samples), seed=visit_seed)
       return task.repeat().batch(samples, drop_remainder=True).take(1)
     # Eval: drain the file's groups once per filename epoch.
     return task.batch(samples, drop_remainder=True)
 
+  # Sequential interleave (no num_parallel_calls) is deterministic, which
+  # the positional group shard below relies on.
   dataset = files.interleave(
       per_task,
       cycle_length=interleave_cycle_length or num_tasks,
       block_length=1)
+  if group_shard:
+    if is_training and not shuffle_filenames:
+      # Unshuffled round-robin + stride-P keeps host h on tasks
+      # ≡ h (mod gcd(P, num_tasks)) forever. The GLOBAL batch stays
+      # complete and balanced (the classes partition the tasks), but
+      # host and task become correlated; filename shuffling (the
+      # default) breaks the alias.
+      import logging
+
+      logging.warning(
+          'Task-group shard with shuffle_filenames=False: host/task '
+          'aliasing (gcd(%d, %d) classes); enable filename shuffling '
+          'for host-decorrelated task draws.', jax.process_count(),
+          num_tasks)
+    dataset = dataset.shard(jax.process_count(), jax.process_index())
 
   parse_fn = example_codec.make_parse_fn(feature_spec, label_spec)
 

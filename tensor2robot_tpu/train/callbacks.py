@@ -15,7 +15,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from tensor2robot_tpu.train.trainer import TrainerCallback
+from tensor2robot_tpu.train.trainer import TrainerCallback, should_log
 
 
 class VariableLoggerCallback(TrainerCallback):
@@ -30,7 +30,7 @@ class VariableLoggerCallback(TrainerCallback):
     self._log_values = log_values
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if self._log_interval_steps and step % self._log_interval_steps:
+    if not should_log(self._log_interval_steps, step):
       return
     flat = jax.tree_util.tree_leaves_with_path(trainer.state.params)
     for path, value in flat:
@@ -57,8 +57,8 @@ class MetricsLoggerCallback(TrainerCallback):
       f.write(json.dumps(record) + '\n')
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if not scalars or (trainer.config.log_interval_steps and
-                       step % trainer.config.log_interval_steps):
+    if not scalars or not should_log(trainer.config.log_interval_steps,
+                                      step):
       return
     record = {'kind': 'train', 'step': int(step)}
     record.update({k: float(v) for k, v in scalars.items()})
@@ -136,8 +136,8 @@ class TensorBoardCallback(TrainerCallback):
     writer.flush()
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if not scalars or (trainer.config.log_interval_steps and
-                       step % trainer.config.log_interval_steps):
+    if not scalars or not should_log(trainer.config.log_interval_steps,
+                                      step):
       return
     self._write(trainer, 'train', step, scalars)
 

@@ -21,6 +21,7 @@ through the callback protocol (the reference's HookBuilder surface).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -39,6 +40,13 @@ from tensor2robot_tpu.train.train_state import (TrainState, apply_ema,
 
 Batch = Tuple[Any, Any]
 MetricDict = Dict[str, float]
+
+
+def should_log(interval: int, step: int) -> bool:
+  """``interval == 0`` disables periodic logging; logging every step
+  would force a device sync per dispatch. Shared by the trainer's scalar
+  conversion and every logging callback so the cadence can't drift."""
+  return bool(interval) and step % interval == 0
 
 
 class TrainerCallback:
@@ -277,7 +285,7 @@ class Trainer:
       self._state, scalars = self._train_step_fn(
           self._state, features, labels)
       step += 1
-      if config.log_interval_steps and step % config.log_interval_steps == 0:
+      if should_log(config.log_interval_steps, step):
         scalars = {k: float(v) for k, v in scalars.items()}
         dt = time.time() - last_log
         last_log = time.time()
@@ -433,7 +441,18 @@ def train_eval_model(model=None,
       backup = ckpt_lib.create_backup_checkpoint_for_eval(
           ckpt_dir, step, backup_dir)
       if backup is None:
-        continue  # GC won the race; wait for the next checkpoint
+        # GC won the race; wait for the next checkpoint. If this was the
+        # final checkpoint the iterator will terminate, so say loudly
+        # that the returned metrics are from an earlier step.
+        logging.warning(
+            'Continuous eval: checkpoint %d disappeared before it could '
+            'be backed up; skipping its eval.', step)
+        if use_continuous_eval and step >= max_train_steps:
+          logging.warning(
+              'Continuous eval: the FINAL checkpoint (step %d) was never '
+              'evaluated; returning metrics from the last evaluated '
+              'checkpoint%s.', step, '' if metrics else ' (none: empty)')
+        continue
       eval_iter = eval_input_generator.create_iterator(ModeKeys.EVAL)
       if trainer.state is None:
         features, _ = next(eval_input_generator.create_iterator(ModeKeys.EVAL))

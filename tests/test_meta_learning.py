@@ -382,3 +382,42 @@ class TestTaskGroupedReader:
         save_interval_steps=10,
         log_interval_steps=0)
     assert np.isfinite(metrics['loss'])
+
+  def test_group_shard_fallback_partitions_stream(self, tmp_path,
+                                                  monkeypatch):
+    """Fewer task files than processes → positional task-group shard.
+
+    3 task files, 4 simulated hosts: every host must walk the same
+    round-robin task stream (f0,f1,f2,f0,…) and keep positions
+    ``h, h+4, h+8, …`` — no silently duplicated groups across hosts.
+    """
+    import jax
+
+    from tensor2robot_tpu.data import pipeline
+
+    self._write_task_files(tmp_path, num_tasks=3)
+    base = MockT2RModel(device_type='cpu')
+    fspec = SpecStruct(
+        {'measured_position':
+             base.get_feature_specification(ModeKeys.TRAIN)
+             ['measured_position']})
+    lspec = SpecStruct(
+        {'valid_position':
+             base.get_label_specification(ModeKeys.TRAIN)
+             ['valid_position']})
+
+    monkeypatch.setattr(jax, 'process_count', lambda: 4)
+    streams = {}
+    for pidx in range(4):
+      monkeypatch.setattr(jax, 'process_index', lambda p=pidx: p)
+      dataset = pipeline.make_task_grouped_dataset(
+          str(tmp_path / '*.tfrecord'), fspec, label_spec=lspec,
+          task_batch_size=1, num_train_samples_per_task=2,
+          num_val_samples_per_task=1, shuffle_filenames=False, seed=0)
+      tasks = []
+      for features, _ in dataset.take(6).as_numpy_iterator():
+        tasks.append(int(np.floor(
+            features['measured_position'].mean())))
+      streams[pidx] = tasks
+    for h in range(4):
+      assert streams[h] == [(h + 4 * k) % 3 for k in range(6)], streams

@@ -34,8 +34,9 @@ def _parse_xplane(tracedir):
   for k, v in _XPLANE_ENV.items():
     os.environ.setdefault(k, v)
   import warnings
-  warnings.filterwarnings('ignore')
-  from tensorflow.tsl.profiler.protobuf import xplane_pb2  # pylint: disable=g-import-not-at-top
+  with warnings.catch_warnings():
+    warnings.simplefilter('ignore')
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # pylint: disable=g-import-not-at-top
 
   paths = glob.glob(
       os.path.join(tracedir, '**', '*.xplane.pb'), recursive=True)
@@ -48,14 +49,20 @@ def _parse_xplane(tracedir):
 
 
 def device_op_times(tracedir, device_prefix='/device:TPU'):
-  """Aggregates per-op device time (ms) from a trace directory."""
+  """Aggregates per-op device time (ms) from a trace directory.
+
+  With several device planes in the trace (multi-chip runs), reports the
+  busiest chip's plane — chips run concurrently, so summing across them
+  would overstate per-step device time by the chip count.
+  """
   xs = _parse_xplane(tracedir)
-  ops = collections.Counter()
-  total = 0
+  per_plane = []
   for p in xs.planes:
     if not p.name.startswith(device_prefix):
       continue
     ev_meta = {m.id: m.name for m in p.event_metadata.values()}
+    ops = collections.Counter()
+    total = 0
     for line in p.lines:
       if line.name != 'XLA Ops':
         continue
@@ -63,6 +70,10 @@ def device_op_times(tracedir, device_prefix='/device:TPU'):
         total += ev.duration_ps
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
         ops[re.sub(r'[.\d]+$', '', name)] += ev.duration_ps
+    per_plane.append((total, ops))
+  if not per_plane:
+    return 0.0, {}
+  total, ops = max(per_plane, key=lambda t: t[0])
   return total / 1e9, {k: v / 1e9 for k, v in ops.most_common()}
 
 
@@ -75,9 +86,11 @@ def device_ms_per_iter(fn, args, n=20, tracedir=None):
   import jax
   import jax.numpy as jnp
 
+  # Only a tempdir this call owns is ever wiped; a caller-provided dir is
+  # left intact (the newest-mtime pick below still finds this run's
+  # trace among any pre-existing ones).
   owns = tracedir is None
   tracedir = tracedir or tempfile.mkdtemp(prefix='t2r_trace_')
-  shutil.rmtree(tracedir, ignore_errors=True)
 
   def chained(acc, *args):
     out = fn(*args)
