@@ -31,6 +31,7 @@ def register() -> None:
   reg(trainer_lib.predict_from_model, 'predict_from_model')
   # Input generators (input_generators/*.py).
   reg(ig.DefaultRecordInputGenerator, 'DefaultRecordInputGenerator')
+  reg(ig.NativeRecordInputGenerator, 'NativeRecordInputGenerator')
   reg(ig.TaskGroupedRecordInputGenerator, 'TaskGroupedRecordInputGenerator')
   reg(ig.FractionalRecordInputGenerator, 'FractionalRecordInputGenerator')
   reg(ig.MultiEvalRecordInputGenerator, 'MultiEvalRecordInputGenerator')

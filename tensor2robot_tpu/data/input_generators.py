@@ -162,6 +162,102 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
     self.multi_eval_name = multi_eval_name
 
 
+class NativeRecordInputGenerator(AbstractInputGenerator):
+  """TF-free record input on the native C++ runtime.
+
+  Reads TFRecord files with the native interleaved prefetch reader
+  (``native/record_io.cpp``), parses tf.Examples with the native
+  wire-format parser, and decodes images with PIL — no TensorFlow in the
+  loop (the robot/serving-host story: a predictor plus this generator
+  needs only numpy + PIL + a C++ toolchain). Restricted to the
+  context-feature subset the native parser supports
+  (``native_io.NativeExampleParser.supports``); use
+  :class:`DefaultRecordInputGenerator` for SequenceExample or
+  multi-dataset specs.
+  """
+
+  def __init__(self,
+               file_patterns: str,
+               batch_size: int = 32,
+               shuffle_buffer_size: int = 1000,
+               cycle_length: int = 16,
+               queue_capacity: int = 64,
+               seed: Optional[int] = None):
+    super().__init__(batch_size)
+    if not file_patterns:
+      raise ValueError('Provide file_patterns.')
+    self._file_patterns = file_patterns
+    self._shuffle_buffer_size = shuffle_buffer_size
+    self._cycle_length = cycle_length
+    self._queue_capacity = queue_capacity
+    self._seed = seed
+
+  def _records(self, mode: str):
+    """Yields raw serialized examples forever (train) or one epoch."""
+    from tensor2robot_tpu.data import native_io, records
+
+    data_format, filenames = records.get_data_format_and_filenames(
+        self._file_patterns)
+    if data_format != 'tfrecord':
+      raise ValueError(f'Native reader supports tfrecord, got {data_format}')
+    filenames, sharded = pipeline.shard_filenames_for_process(filenames)
+    import jax
+
+    element_shard = not sharded and jax.process_count() > 1
+    training = modes.is_training(mode)
+    while True:
+      with native_io.NativeInterleaveReader(
+          sorted(filenames) if element_shard else filenames,
+          cycle_length=self._cycle_length,
+          queue_capacity=self._queue_capacity) as reader:
+        for i, record in enumerate(reader):
+          if element_shard and i % jax.process_count() != jax.process_index():
+            continue
+          yield record
+      if not training:
+        return
+
+  def _create_iterator(self, mode, batch_size):
+    from tensor2robot_tpu.data import native_io
+
+    parse_fn = native_io.make_native_parse_fn(self._feature_spec,
+                                              self._label_spec)
+    if parse_fn is None:
+      raise ValueError(
+          'Specs not natively parseable (sequence/multi-dataset/'
+          'multi-image features, or no C++ toolchain); use '
+          'DefaultRecordInputGenerator.')
+    training = modes.is_training(mode)
+    rng = np.random.RandomState(self._seed)
+
+    def stream():
+      if not training or self._shuffle_buffer_size <= 1:
+        yield from self._records(mode)
+        return
+      buf = []
+      for record in self._records(mode):
+        if len(buf) < self._shuffle_buffer_size:
+          buf.append(record)
+          continue
+        i = rng.randint(len(buf))
+        yield buf[i]
+        buf[i] = record
+      while buf:  # unreachable for train (infinite), kept for safety
+        yield buf.pop(rng.randint(len(buf)))
+
+    def batches():
+      pending = []
+      for record in stream():
+        pending.append(record)
+        if len(pending) < batch_size:
+          continue
+        yield parse_fn(pending)
+        pending = []
+      # eval: drop the final short batch (drop_remainder parity)
+
+    return batches()
+
+
 class TaskGroupedRecordInputGenerator(AbstractInputGenerator):
   """Per-task file interleave feeding MAML's meta-batch layout.
 

@@ -1,0 +1,332 @@
+"""TF-free TFRecord IO on the native C++ runtime (ctypes bindings).
+
+Wire-format-compatible with ``tf.io.TFRecordWriter`` / ``TFRecordDataset``
+(CRC32C-framed records), so files interchange freely with the TF-based
+pipeline. The interleave reader overlaps disk IO with training via one
+prefetch thread per file (the role tf.data's C++ runtime plays for the
+reference, ``utils/tfdata.py:43-66``).
+
+All classes raise ``RuntimeError`` if the native library is unavailable;
+call ``available()`` first or use the ``records.RecordWriter`` facade,
+which falls back to TF automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, List, Optional, Sequence
+
+from tensor2robot_tpu import native
+
+
+def available() -> bool:
+  return native.load_record_io() is not None
+
+
+def _lib() -> ctypes.CDLL:
+  lib = native.load_record_io()
+  if lib is None:
+    raise RuntimeError('native record_io library unavailable '
+                       '(no toolchain, or T2R_NATIVE_DISABLE set)')
+  return lib
+
+
+def masked_crc32c(data: bytes) -> int:
+  return _lib().t2r_masked_crc32c(data, len(data))
+
+
+class NativeRecordWriter:
+  """Appends TFRecord-framed records to a file."""
+
+  def __init__(self, path: str, append: bool = False):
+    self._lib = _lib()
+    self._h = self._lib.t2r_writer_open(
+        path.encode(), b'a' if append else b'w')
+    if not self._h:
+      raise IOError(f'cannot open {path!r} for writing')
+
+  def write(self, serialized: bytes) -> None:
+    if self._lib.t2r_writer_write(self._h, serialized, len(serialized)):
+      raise IOError('short write')
+
+  def flush(self) -> None:
+    self._lib.t2r_writer_flush(self._h)
+
+  def close(self) -> None:
+    if self._h:
+      self._lib.t2r_writer_close(self._h)
+      self._h = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+class NativeRecordReader:
+  """Sequential reader with CRC verification."""
+
+  def __init__(self, path: str, verify_crc: bool = True):
+    self._lib = _lib()
+    self._h = self._lib.t2r_reader_open(path.encode(), int(verify_crc))
+    if not self._h:
+      raise IOError(f'cannot open {path!r}')
+
+  def __iter__(self) -> Iterator[bytes]:
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    while True:
+      n = self._lib.t2r_reader_next(self._h, ctypes.byref(buf))
+      if n == -1:
+        return
+      if n == -2:
+        err = self._lib.t2r_reader_error(self._h).decode()
+        raise IOError(f'record read failed: {err}')
+      yield ctypes.string_at(buf, n)
+
+  def close(self) -> None:
+    if self._h:
+      self._lib.t2r_reader_close(self._h)
+      self._h = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+class NativeInterleaveReader:
+  """Round-robin (block_length=1) reader over many files.
+
+  ``cycle_length`` native prefetch threads (slot ``s`` owns files
+  ``s, s+C, s+2C, …``) keep bounded queues full, so thread count and
+  queue memory stay fixed regardless of shard count and ``__iter__``
+  never touches the filesystem on the consumer thread.
+  """
+
+  def __init__(self, paths: Sequence[str], cycle_length: int = 16,
+               queue_capacity: int = 64, verify_crc: bool = True):
+    if not paths:
+      raise ValueError('need at least one path')
+    self._lib = _lib()
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    self._h = self._lib.t2r_interleave_open(
+        arr, len(paths), cycle_length, queue_capacity, int(verify_crc))
+    if not self._h:
+      raise IOError('cannot open interleave reader')
+
+  def __iter__(self) -> Iterator[bytes]:
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    while True:
+      n = self._lib.t2r_interleave_next(self._h, ctypes.byref(buf))
+      if n == -1:
+        return
+      if n == -2:
+        err = self._lib.t2r_interleave_error(self._h).decode()
+        raise IOError(f'interleave read failed: {err}')
+      yield ctypes.string_at(buf, n)
+
+  def close(self) -> None:
+    if self._h:
+      self._lib.t2r_interleave_close(self._h)
+      self._h = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def read_records(path: str) -> List[bytes]:
+  """Reads every record of one file (convenience for tools/tests)."""
+  with NativeRecordReader(path) as r:
+    return list(r)
+
+
+# ------------------------------------------------------- example parsing
+
+_KIND_FLOAT, _KIND_INT64, _KIND_BYTES = 0, 1, 2
+
+
+def _is_bytes_spec(spec) -> bool:
+  return (getattr(spec, 'is_encoded_image', False) or
+          spec.dtype.name in ('object', 'str', 'bytes'))
+
+
+class NativeExampleParser:
+  """Spec-driven tf.Example batch parser on the C++ wire decoder.
+
+  Covers the context-feature subset of the codec (fixed-shape and
+  padded/clipped varlen float/int features, single encoded-image bytes
+  features). Sequence (``SequenceExample``) specs and multi-image bytes
+  features are unsupported — callers fall back to the TF parse path;
+  ``supports(spec)`` reports coverage.
+
+  ``parse_batch`` returns numpy arrays shaped ``[B, *spec.shape]`` (bytes
+  features: a list of ``bytes`` per example, for the image decoder).
+  """
+
+  def __init__(self, named_specs):
+    """named_specs: iterable of (output_key, record_name, TensorSpec)."""
+    import numpy as np
+
+    self._lib = _lib()
+    self._np = np
+    self._fields = []
+    keys, kinds, lens, req, varlen = [], [], [], [], []
+    for out_key, name, spec in named_specs:
+      if not self.supports(spec):
+        raise ValueError(f'spec {out_key!r} not supported natively')
+      if _is_bytes_spec(spec):
+        kind, flat = _KIND_BYTES, 1
+      elif spec.dtype.name in ('float32', 'float64', 'bfloat16', 'float16'):
+        kind, flat = _KIND_FLOAT, int(np.prod(spec.shape, dtype=np.int64))
+      else:
+        kind, flat = _KIND_INT64, int(np.prod(spec.shape, dtype=np.int64))
+      pad = spec.varlen_default_value
+      required = pad is None and not spec.is_optional
+      self._fields.append((out_key, spec, kind, flat))
+      keys.append(name.encode())
+      kinds.append(kind)
+      lens.append(flat)
+      req.append(int(required))
+      varlen.append(int(pad is not None))
+    n = len(keys)
+    self._h = self._lib.t2r_parser_create(
+        (ctypes.c_char_p * n)(*keys), (ctypes.c_int * n)(*kinds),
+        (ctypes.c_int64 * n)(*lens), (ctypes.c_int * n)(*req),
+        (ctypes.c_int * n)(*varlen), n)
+
+  @staticmethod
+  def supports(spec) -> bool:
+    import numpy as np
+
+    if getattr(spec, 'is_sequence', False):
+      return False
+    if getattr(spec, 'is_encoded_image', False):
+      return len(spec.shape) <= 3  # single encoded blob per example
+    if spec.dtype.name in ('object', 'str', 'bytes'):
+      # Plain string features pass through undecoded: one per example.
+      return int(np.prod(spec.shape, dtype=np.int64)) == 1
+    if spec.dtype.name in ('float32', 'float64', 'bfloat16', 'float16'):
+      return True
+    return np.issubdtype(spec.dtype, np.integer) or spec.dtype == np.bool_
+
+  def parse_batch(self, records: Sequence[bytes]):
+    np = self._np
+    batch = len(records)
+    recs = (ctypes.c_char_p * batch)(*records)
+    lens = (ctypes.c_uint64 * batch)(*[len(r) for r in records])
+    buffers = []
+    outs = (ctypes.c_void_p * len(self._fields))()
+    for i, (_, spec, kind, flat) in enumerate(self._fields):
+      if kind == _KIND_BYTES:
+        buf = np.full((batch, flat, 2), -1, np.int64)
+      elif kind == _KIND_FLOAT:
+        pad = spec.varlen_default_value or 0.0
+        buf = np.full((batch, flat), pad, np.float32)
+      else:
+        pad = spec.varlen_default_value or 0
+        buf = np.full((batch, flat), int(pad), np.int64)
+      buffers.append(buf)
+      outs[i] = buf.ctypes.data_as(ctypes.c_void_p)
+    rc = self._lib.t2r_parser_parse_batch(self._h, recs, lens, batch, outs)
+    if rc:
+      raise ValueError(
+          f'example parse failed: '
+          f'{self._lib.t2r_parser_error(self._h).decode()}')
+    out = {}
+    for (key, spec, kind, flat), buf in zip(self._fields, buffers):
+      if kind == _KIND_BYTES:
+        vals = []
+        for b in range(batch):
+          off, ln = int(buf[b, 0, 0]), int(buf[b, 0, 1])
+          vals.append(records[b][off:off + ln] if off >= 0 else b'')
+        out[key] = vals
+      else:
+        out[key] = buf.reshape((batch,) + tuple(spec.shape)).astype(
+            spec.dtype, copy=False)
+    return out
+
+  def close(self) -> None:
+    if self._h:
+      self._lib.t2r_parser_destroy(self._h)
+      self._h = None
+
+  def __del__(self):
+    try:
+      self.close()
+    except Exception:  # interpreter shutdown
+      pass
+
+
+def _decode_image(raw: bytes, spec):
+  """PIL image decode with the codec's empty-bytes→zeros convention."""
+  import numpy as np
+
+  shape = tuple(spec.shape[-3:])
+  if not raw:
+    return np.zeros(shape, spec.dtype)
+  import io
+
+  import PIL.Image
+
+  arr = np.asarray(PIL.Image.open(io.BytesIO(raw)))
+  if arr.ndim == 2:
+    arr = arr[..., None]
+  return arr.astype(spec.dtype)
+
+
+def make_native_parse_fn(feature_spec, label_spec=None):
+  """Spec-driven TF-free batch parse fn, or ``None`` when not coverable.
+
+  Returns ``parse_fn(records: Sequence[bytes]) -> (features, labels)``
+  yielding packed SpecStructs (labels ``None`` when no label spec), using
+  the native wire parser + PIL image decode. Returns ``None`` when the
+  native library is unavailable or any spec needs the TF codec
+  (sequences, multi-dataset, multi-image bytes) so callers can fall back.
+  """
+  import numpy as np
+
+  from tensor2robot_tpu.specs import algebra
+
+  if not available():
+    return None
+  flat_f = algebra.flatten_spec_structure(feature_spec)
+  flat_l = (None if label_spec is None else
+            algebra.flatten_spec_structure(label_spec))
+  named = []
+  for prefix, flat in (('f/', flat_f), ('l/', flat_l)):
+    if flat is None:
+      continue
+    for key, spec in flat.items():
+      if spec.dataset_key or not NativeExampleParser.supports(spec):
+        return None
+      named.append((prefix + key, spec.name or key.split('/')[-1], spec))
+  parser = NativeExampleParser(named)
+
+  def parse_fn(records):
+    from tensor2robot_tpu.specs import SpecStruct
+
+    parsed = parser.parse_batch(list(records))
+    feats, labels = SpecStruct(), SpecStruct()
+    for out_key, _, spec in named:
+      value = parsed[out_key]
+      if isinstance(value, list):  # bytes feature
+        if getattr(spec, 'is_encoded_image', False):
+          value = np.stack([_decode_image(raw, spec) for raw in value])
+          if len(spec.shape) > 3:  # singleton leading image dims
+            value = value.reshape(value.shape[:1] + tuple(spec.shape))
+        else:  # plain string: pass through undecoded (TF-codec parity)
+          value = np.asarray(value, dtype=object).reshape(
+              (len(records),) + tuple(spec.shape))
+      (feats if out_key.startswith('f/') else labels)[out_key[2:]] = value
+    features = algebra.pack_flat_sequence_to_spec_structure(flat_f, feats)
+    if flat_l is None:
+      return features, None
+    return features, algebra.pack_flat_sequence_to_spec_structure(
+        flat_l, labels)
+
+  return parse_fn

@@ -72,15 +72,29 @@ def get_data_format_and_filenames(
 
 
 class RecordWriter:
-  """Sharded TFRecord writer for serialized examples (replay/test data)."""
+  """Sharded TFRecord writer for serialized examples (replay/test data).
+
+  Prefers the native C++ writer (``data/native_io.py`` — same wire
+  format, no TF dependency); falls back to ``tf.io.TFRecordWriter`` when
+  the native library can't be built.
+  """
 
   def __init__(self, path: str, shard: Optional[int] = None,
                num_shards: Optional[int] = None):
     if shard is not None and num_shards:
       path = f'{path}-{shard:05d}-of-{num_shards:05d}'
-    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
     self._path = path
-    self._writer = _tf().io.TFRecordWriter(path)
+    from tensor2robot_tpu.data import native_io
+    # The native writer is plain-fs only; remote filesystem schemes
+    # (gs://, s3://, hdfs://, cns paths, …) go through TF's filesystem
+    # layer.
+    local = '://' not in path
+    if local:
+      os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    if local and native_io.available():
+      self._writer = native_io.NativeRecordWriter(path)
+    else:
+      self._writer = _tf().io.TFRecordWriter(path)
 
   @property
   def path(self) -> str:

@@ -297,10 +297,20 @@ class ExportedModelPredictor(AbstractPredictor):
     """
     self.assert_is_loaded()
     if self._parse_fn is None:
-      from tensor2robot_tpu.data import example_codec
+      # Prefer the TF-free native parser (C++ wire decode + PIL images)
+      # so robot hosts don't need a TF wheel; the TF codec remains the
+      # fallback for sequence/multi-dataset specs.
+      from tensor2robot_tpu.data import native_io
 
-      self._parse_fn = example_codec.make_parse_fn(self._feature_spec)
-    parsed = self._parse_fn(np.asarray(serialized_examples, dtype=object))
+      native_fn = native_io.make_native_parse_fn(self._feature_spec)
+      if native_fn is not None:
+        self._parse_fn = lambda ex: native_fn(list(ex))[0]
+      else:
+        from tensor2robot_tpu.data import example_codec
+
+        tf_fn = example_codec.make_parse_fn(self._feature_spec)
+        self._parse_fn = lambda ex: tf_fn(np.asarray(ex, dtype=object))
+    parsed = self._parse_fn(serialized_examples)
     if isinstance(parsed, tuple):
       parsed = parsed[0]
     features = {k: np.asarray(v) for k, v in parsed.items()}

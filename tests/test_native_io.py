@@ -1,0 +1,429 @@
+"""Native C++ record IO: wire-format interop with TF, CRC, interleave."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data import native_io, records
+
+pytestmark = pytest.mark.skipif(
+    not native_io.available(),
+    reason='native record_io library unavailable (no toolchain)')
+
+
+def _payloads(n, seed=0):
+  rng = np.random.RandomState(seed)
+  return [rng.bytes(int(rng.randint(0, 2000))) for _ in range(n)]
+
+
+class TestRoundTrip:
+
+  def test_native_write_tf_read(self, tmp_path):
+    import tensorflow as tf
+
+    path = str(tmp_path / 'a.tfrecord')
+    data = _payloads(20)
+    with native_io.NativeRecordWriter(path) as w:
+      for p in data:
+        w.write(p)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(path)]
+    assert got == data
+
+  def test_tf_write_native_read(self, tmp_path):
+    import tensorflow as tf
+
+    path = str(tmp_path / 'b.tfrecord')
+    data = _payloads(20, seed=1)
+    with tf.io.TFRecordWriter(path) as w:
+      for p in data:
+        w.write(p)
+    assert native_io.read_records(path) == data
+
+  def test_empty_record_and_empty_file(self, tmp_path):
+    path = str(tmp_path / 'c.tfrecord')
+    with native_io.NativeRecordWriter(path) as w:
+      w.write(b'')
+      w.write(b'x')
+    assert native_io.read_records(path) == [b'', b'x']
+    empty = str(tmp_path / 'd.tfrecord')
+    with native_io.NativeRecordWriter(empty):
+      pass
+    assert native_io.read_records(empty) == []
+
+  def test_append_mode(self, tmp_path):
+    path = str(tmp_path / 'e.tfrecord')
+    with native_io.NativeRecordWriter(path) as w:
+      w.write(b'one')
+    with native_io.NativeRecordWriter(path, append=True) as w:
+      w.write(b'two')
+    assert native_io.read_records(path) == [b'one', b'two']
+
+
+class TestCorruption:
+
+  def test_payload_corruption_detected(self, tmp_path):
+    path = str(tmp_path / 'x.tfrecord')
+    with native_io.NativeRecordWriter(path) as w:
+      w.write(b'hello world payload')
+    raw = bytearray(open(path, 'rb').read())
+    raw[14] ^= 0xFF  # flip a payload byte
+    open(path, 'wb').write(bytes(raw))
+    with pytest.raises(IOError, match='crc'):
+      native_io.read_records(path)
+
+  def test_truncation_detected(self, tmp_path):
+    path = str(tmp_path / 'y.tfrecord')
+    with native_io.NativeRecordWriter(path) as w:
+      w.write(b'hello world payload')
+    raw = open(path, 'rb').read()
+    open(path, 'wb').write(raw[:-6])
+    with pytest.raises(IOError, match='truncated'):
+      native_io.read_records(path)
+
+  def test_verify_can_be_disabled(self, tmp_path):
+    path = str(tmp_path / 'z.tfrecord')
+    with native_io.NativeRecordWriter(path) as w:
+      w.write(b'hello world payload')
+    raw = bytearray(open(path, 'rb').read())
+    raw[14] ^= 0xFF
+    open(path, 'wb').write(bytes(raw))
+    with native_io.NativeRecordReader(path, verify_crc=False) as r:
+      assert len(list(r)) == 1
+
+
+class TestInterleave:
+
+  def _write_files(self, tmp_path, counts):
+    paths = []
+    for i, n in enumerate(counts):
+      p = str(tmp_path / f'f{i}.tfrecord')
+      with native_io.NativeRecordWriter(p) as w:
+        for k in range(n):
+          w.write(f'{i}:{k}'.encode())
+      paths.append(p)
+    return paths
+
+  def test_round_robin_order_and_completeness(self, tmp_path):
+    paths = self._write_files(tmp_path, [3, 3, 3])
+    with native_io.NativeInterleaveReader(paths, queue_capacity=2) as it:
+      got = [r.decode() for r in it]
+    assert got == ['0:0', '1:0', '2:0', '0:1', '1:1', '2:1',
+                   '0:2', '1:2', '2:2']
+
+  def test_uneven_files_drain_completely(self, tmp_path):
+    paths = self._write_files(tmp_path, [1, 4, 0, 2])
+    with native_io.NativeInterleaveReader(paths) as it:
+      got = sorted(r.decode() for r in it)
+    assert got == sorted(
+        ['0:0', '1:0', '1:1', '1:2', '1:3', '3:0', '3:1'])
+
+  def test_many_records_prefetch(self, tmp_path):
+    paths = self._write_files(tmp_path, [500, 500])
+    with native_io.NativeInterleaveReader(paths, queue_capacity=8) as it:
+      assert sum(1 for _ in it) == 1000
+
+  def test_early_close_joins_workers(self, tmp_path):
+    paths = self._write_files(tmp_path, [500, 500])
+    it = native_io.NativeInterleaveReader(paths, queue_capacity=4)
+    stream = iter(it)
+    for _ in range(5):
+      next(stream)
+    it.close()  # must not hang or crash with workers mid-stream
+
+
+class TestFacade:
+
+  def test_record_writer_uses_native_and_tf_pipeline_reads(self, tmp_path):
+    import tensorflow as tf
+
+    path = str(tmp_path / 'facade.tfrecord')
+    data = _payloads(5, seed=2)
+    records.write_examples(path, data)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(path)]
+    assert got == data
+
+  def test_masked_crc_matches_tf(self):
+    # TF's published masked-crc of b'' framing is exercised implicitly by
+    # interop; spot-check determinism + mask nonlinearity here.
+    a = native_io.masked_crc32c(b'hello')
+    b = native_io.masked_crc32c(b'hello')
+    c = native_io.masked_crc32c(b'hellp')
+    assert a == b != c
+
+
+class TestExampleParser:
+
+  def _specs(self):
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    return SpecStruct({
+        'pose': TensorSpec(shape=(2, 3), dtype=np.float32, name='pose'),
+        'count': TensorSpec(shape=(2,), dtype=np.int64, name='count'),
+        'flag': TensorSpec(shape=(), dtype=np.bool_, name='flag'),
+    })
+
+  def _encode(self, spec_struct, n, seed=0):
+    from tensor2robot_tpu.data import example_codec
+
+    rng = np.random.RandomState(seed)
+    values, recs = [], []
+    for _ in range(n):
+      v = {
+          'pose': rng.randn(2, 3).astype(np.float32),
+          'count': rng.randint(0, 99, (2,)).astype(np.int64),
+          'flag': np.bool_(rng.rand() > 0.5),
+      }
+      values.append(v)
+      recs.append(example_codec.encode_example(spec_struct, v))
+    return values, recs
+
+  def test_parse_matches_encoded_values(self):
+    spec = self._specs()
+    values, recs = self._encode(spec, 7)
+    parser = native_io.NativeExampleParser(
+        [(k, s.name, s) for k, s in spec.items()])
+    out = parser.parse_batch(recs)
+    for b, v in enumerate(values):
+      np.testing.assert_array_equal(out['pose'][b], v['pose'])
+      np.testing.assert_array_equal(out['count'][b], v['count'])
+      assert out['flag'][b] == v['flag']
+    assert out['pose'].dtype == np.float32
+    assert out['count'].dtype == np.int64
+    assert out['flag'].dtype == np.bool_
+
+  def test_parse_matches_tf_parse_fn(self):
+    from tensor2robot_tpu.data import example_codec
+
+    # bool isn't TF-parseable (codec restriction), so compare on the
+    # TF-supported subset.
+    spec = self._specs()
+    tf_spec = type(spec)(
+        {k: s for k, s in spec.items() if k in ('pose', 'count')})
+    _, recs = self._encode(spec, 5, seed=3)
+    parse_fn = example_codec.make_parse_fn(tf_spec)
+    tf_out = parse_fn(np.asarray(recs, dtype=object))
+    parser = native_io.NativeExampleParser(
+        [(k, s.name, s) for k, s in tf_spec.items()])
+    out = parser.parse_batch(recs)
+    for key in ('pose', 'count'):
+      np.testing.assert_array_equal(out[key], np.asarray(tf_out[key]))
+
+  def test_encoded_image_spans(self):
+    from tensor2robot_tpu.data import example_codec
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        'img': TensorSpec(shape=(4, 6, 3), dtype=np.uint8, name='img',
+                          data_format='png'),
+    })
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 255, (4, 6, 3), dtype=np.uint8)
+            for _ in range(3)]
+    recs = [example_codec.encode_example(spec, {'img': im}) for im in imgs]
+    parser = native_io.NativeExampleParser(
+        [('img', 'img', spec['img'])])
+    out = parser.parse_batch(recs)
+    import PIL.Image
+    import io
+    for b, im in enumerate(imgs):
+      decoded = np.asarray(PIL.Image.open(io.BytesIO(out['img'][b])))
+      np.testing.assert_array_equal(decoded, im)
+
+  def test_varlen_pad_and_clip(self):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = TensorSpec(shape=(4,), dtype=np.float32, name='v',
+                      varlen_default_value=-1.0)
+    def ex(vals):
+      return tf.train.Example(features=tf.train.Features(feature={
+          'v': tf.train.Feature(float_list=tf.train.FloatList(value=vals))
+      })).SerializeToString()
+    recs = [ex([1., 2.]), ex([1., 2., 3., 4., 5., 6.]), ex([])]
+    parser = native_io.NativeExampleParser([('v', 'v', spec)])
+    out = parser.parse_batch(recs)
+    np.testing.assert_array_equal(
+        out['v'],
+        [[1., 2., -1., -1.], [1., 2., 3., 4.], [-1., -1., -1., -1.]])
+
+  def test_fixed_shape_mismatch_errors(self):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = TensorSpec(shape=(3,), dtype=np.float32, name='v')
+    bad = tf.train.Example(features=tf.train.Features(feature={
+        'v': tf.train.Feature(float_list=tf.train.FloatList(value=[1., 2.]))
+    })).SerializeToString()
+    parser = native_io.NativeExampleParser([('v', 'v', spec)])
+    with pytest.raises(ValueError, match='expected 3'):
+      parser.parse_batch([bad])
+
+  def test_missing_required_errors(self):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = TensorSpec(shape=(3,), dtype=np.float32, name='v')
+    empty = tf.train.Example().SerializeToString()
+    parser = native_io.NativeExampleParser([('v', 'v', spec)])
+    with pytest.raises(ValueError, match='required'):
+      parser.parse_batch([empty])
+
+  def test_missing_optional_gets_default(self):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = TensorSpec(shape=(3,), dtype=np.float32, name='v',
+                      is_optional=True)
+    empty = tf.train.Example().SerializeToString()
+    parser = native_io.NativeExampleParser([('v', 'v', spec)])
+    out = parser.parse_batch([empty])
+    np.testing.assert_array_equal(out['v'], [[0., 0., 0.]])
+
+  def test_unsupported_sequence_spec_rejected(self):
+    from tensor2robot_tpu.specs import TensorSpec
+
+    seq = TensorSpec(shape=(3,), dtype=np.float32, name='s',
+                     is_sequence=True)
+    assert not native_io.NativeExampleParser.supports(seq)
+    with pytest.raises(ValueError, match='not supported'):
+      native_io.NativeExampleParser([('s', 's', seq)])
+
+
+class TestNativeInputGenerator:
+
+  def _write(self, tmp_path, n=32):
+    from tensor2robot_tpu.data import example_codec
+    from tensor2robot_tpu.modes import ModeKeys
+    from tensor2robot_tpu.specs import SpecStruct
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+    model = MockT2RModel(device_type='cpu')
+    fspec = model.get_feature_specification(ModeKeys.TRAIN)
+    lspec = model.get_label_specification(ModeKeys.TRAIN)
+    rng = np.random.RandomState(0)
+    recs = []
+    for i in range(n):
+      x = rng.randn(2).astype(np.float32)
+      y = np.float32(i % 2)
+      recs.append(example_codec.encode_example(
+          SpecStruct({'measured_position': fspec['measured_position'],
+                      'valid_position': lspec['valid_position']}),
+          SpecStruct({'measured_position': x, 'valid_position': y})))
+    path = str(tmp_path / 'd.tfrecord')
+    records.write_examples(path, recs)
+    return model, path
+
+  def test_batches_match_specs_and_cycle(self, tmp_path):
+    from tensor2robot_tpu.data.input_generators import (
+        NativeRecordInputGenerator)
+    from tensor2robot_tpu.modes import ModeKeys
+
+    model, path = self._write(tmp_path)
+    gen = NativeRecordInputGenerator(path, batch_size=8,
+                                     shuffle_buffer_size=16, seed=0)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    it = gen.create_iterator(ModeKeys.TRAIN)
+    for _ in range(10):  # > one epoch: the stream must cycle
+      features, labels = next(it)
+      assert features['measured_position'].shape == (8, 2)
+      assert features['measured_position'].dtype == np.float32
+      assert labels['valid_position'].shape == (8,)
+
+  def test_eval_is_single_epoch_and_unshuffled(self, tmp_path):
+    from tensor2robot_tpu.data.input_generators import (
+        NativeRecordInputGenerator)
+    from tensor2robot_tpu.modes import ModeKeys
+
+    model, path = self._write(tmp_path, n=20)
+    gen = NativeRecordInputGenerator(path, batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.EVAL)
+    batches = list(gen.create_iterator(ModeKeys.EVAL))
+    assert len(batches) == 2  # 20 // 8, short remainder dropped
+    # Unshuffled: labels alternate 0,1,0,1,...
+    labels = np.concatenate([b[1]['valid_position'] for b in batches])
+    np.testing.assert_array_equal(labels, np.arange(16) % 2)
+
+  def test_trains_e2e_without_tf_pipeline(self, tmp_path):
+    from tensor2robot_tpu.data.input_generators import (
+        NativeRecordInputGenerator)
+    from tensor2robot_tpu.modes import ModeKeys
+    from tensor2robot_tpu.train import train_eval_model
+
+    model, path = self._write(tmp_path)
+
+    def make_gen():
+      g = NativeRecordInputGenerator(path, batch_size=8,
+                                     shuffle_buffer_size=8, seed=1)
+      return g
+
+    metrics = train_eval_model(
+        model=model,
+        model_dir=str(tmp_path / 'm'),
+        train_input_generator=make_gen(),
+        eval_input_generator=make_gen(),
+        max_train_steps=6,
+        eval_steps=2,
+        eval_interval_steps=0,
+        save_interval_steps=6,
+        log_interval_steps=0)
+    assert np.isfinite(metrics['loss'])
+
+
+class TestBoundedCycle:
+
+  def test_cycle_length_bounds_slots_and_drains_all(self, tmp_path):
+    paths = []
+    for i in range(4):
+      p = str(tmp_path / f'f{i}.tfrecord')
+      with native_io.NativeRecordWriter(p) as w:
+        for k in range(2):
+          w.write(f'{i}:{k}'.encode())
+      paths.append(p)
+    with native_io.NativeInterleaveReader(paths, cycle_length=2) as it:
+      got = [r.decode() for r in it]
+    # slot 0 owns files 0,2; slot 1 owns files 1,3; round-robin slots.
+    assert got == ['0:0', '1:0', '0:1', '1:1', '2:0', '3:0', '2:1', '3:1']
+
+  def test_many_files_few_threads(self, tmp_path):
+    paths = []
+    for i in range(40):
+      p = str(tmp_path / f'g{i}.tfrecord')
+      with native_io.NativeRecordWriter(p) as w:
+        w.write(f'{i}'.encode())
+      paths.append(p)
+    with native_io.NativeInterleaveReader(paths, cycle_length=4,
+                                          queue_capacity=2) as it:
+      got = sorted(int(r) for r in it)
+    assert got == list(range(40))
+
+
+class TestStringPassthrough:
+
+  def test_plain_string_feature_not_decoded(self):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        'instruction': TensorSpec(shape=(), dtype=str, name='instruction'),
+        'x': TensorSpec(shape=(2,), dtype=np.float32, name='x'),
+    })
+    def ex(text, x):
+      return tf.train.Example(features=tf.train.Features(feature={
+          'instruction': tf.train.Feature(
+              bytes_list=tf.train.BytesList(value=[text.encode()])),
+          'x': tf.train.Feature(float_list=tf.train.FloatList(value=x)),
+      })).SerializeToString()
+    recs = [ex('pick up the cup', [1., 2.]), ex('open drawer', [3., 4.])]
+    parse_fn = native_io.make_native_parse_fn(spec)
+    assert parse_fn is not None
+    feats, labels = parse_fn(recs)
+    assert labels is None
+    assert feats['instruction'].tolist() == [b'pick up the cup',
+                                             b'open drawer']
+    np.testing.assert_array_equal(feats['x'], [[1., 2.], [3., 4.]])
