@@ -1,0 +1,57 @@
+"""Convert legacy pickle-based export assets to ``t2r_assets.pbtxt``.
+
+Migration tool for exports produced by the original framework before its
+proto-assets era (equivalent of
+``/root/reference/utils/convert_pkl_assets_to_proto_assets.py``): reads
+``<assets_dir>/input_specs.pkl`` (+ optional ``global_step.pkl``) through
+a restricted legacy unpickler — no TensorFlow or original package needed
+— and writes ``<assets_dir>/t2r_assets.pbtxt`` in this framework's
+format.
+
+Usage::
+
+    python -m tensor2robot_tpu.bin.convert_pkl_assets \
+        --assets_filepath /path/to/export/assets.extra
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs import legacy_pickle
+
+
+def convert(assets_filepath: str) -> str:
+  """Converts one assets directory; returns the written pbtxt path."""
+  input_spec_path = os.path.join(assets_filepath, 'input_specs.pkl')
+  if not os.path.exists(input_spec_path):
+    raise ValueError(f'No file exists for {input_spec_path}.')
+  feature_spec, label_spec = legacy_pickle.load_input_spec_from_file(
+      input_spec_path)
+
+  global_step = 0
+  global_step_path = os.path.join(assets_filepath, 'global_step.pkl')
+  if os.path.exists(global_step_path):
+    global_step = legacy_pickle.load_global_step_from_file(global_step_path)
+
+  out_path = os.path.join(assets_filepath, assets_lib.T2R_ASSETS_FILENAME)
+  assets_lib.write_t2r_assets_to_file(
+      assets_lib.make_t2r_assets(feature_spec, label_spec, global_step),
+      out_path)
+  return out_path
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--assets_filepath', required=True,
+                      help='Exported-model assets directory holding '
+                           'input_specs.pkl.')
+  args = parser.parse_args(argv)
+  path = convert(args.assets_filepath)
+  print(f'Wrote {path}')
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,159 @@
+"""Legacy pickle-asset migration: tensor2robot ``.pkl`` specs → our specs.
+
+The original framework stored export specs as pickles
+(``input_specs.pkl`` with ``{'in_feature_spec', 'in_label_spec'}``,
+``global_step.pkl``) before moving to the ``t2r_assets.pbtxt`` proto; its
+``convert_pkl_assets_to_proto_assets.py`` migrated old exports
+(``/root/reference/utils/convert_pkl_assets_to_proto_assets.py:40-62``,
+pickle layout ``tensorspec_utils.py:278-282,1705-1713``).
+
+This module performs the same migration WITHOUT the original package or
+TensorFlow installed: a restricted unpickler maps the legacy class paths
+(``tensor2robot.utils.tensorspec_utils.ExtendedTensorSpec`` /
+``TensorSpecStruct``, tf ``TensorShape``/``DType``/``TensorSpec``) onto
+local reconstruction shims, and everything else is refused (defense
+against arbitrary-code pickles).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.specs.spec_struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+class _TensorShape:
+  """Stand-in for tf.TensorShape: captures the dims list."""
+
+  def __init__(self, dims=None):
+    self.dims = dims
+
+  def as_tuple(self):
+    if self.dims is None:
+      return ()
+
+    def dim(d):
+      v = getattr(d, 'value', d)
+      return None if v is None else int(v)
+
+    return tuple(dim(d) for d in self.dims)
+
+
+class _Dim:
+  """Stand-in for tf.compat.v1.Dimension."""
+
+  def __init__(self, value=None):
+    self.value = value
+
+
+def _as_dtype(name) -> np.dtype:
+  """Stand-in for tf's ``as_dtype`` — how real TF DTypes pickle:
+  ``DType.__reduce__ → (as_dtype, (self.name,))``."""
+  return _np_dtype(name)
+
+
+def _np_dtype(dtype) -> np.dtype:
+  if isinstance(dtype, np.dtype):
+    return dtype
+  name = str(dtype)
+  if name == 'bfloat16':
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+  if name in ('string', 'object', 'str', 'bytes'):  # tf.string
+    return np.dtype(object)
+  return np.dtype(name)
+
+
+class _LegacyStruct(dict):
+  """Stand-in for TensorSpecStruct (an OrderedDict subclass whose pickle
+  carries instance state like ``_path_prefix``): absorb and drop it."""
+
+  def __setstate__(self, state):
+    pass
+
+
+def _shape_tuple(shape) -> Tuple[Optional[int], ...]:
+  if isinstance(shape, _TensorShape):
+    return shape.as_tuple()
+  if shape is None:
+    return ()
+  return tuple(None if d is None else int(d) for d in shape)
+
+
+def _extended_tensor_spec(shape, dtype, name=None, is_optional=False,
+                          is_sequence=False, is_extracted=False,
+                          data_format=None, dataset_key=None,
+                          varlen_default_value=None):
+  """Reconstruction shim matching ExtendedTensorSpec.__reduce__ args."""
+  del is_extracted  # derived at runtime in this framework
+  return TensorSpec(
+      shape=_shape_tuple(shape),
+      dtype=_np_dtype(dtype),
+      name=name,
+      is_optional=bool(is_optional),
+      is_sequence=bool(is_sequence),
+      data_format=data_format,
+      dataset_key=dataset_key or '',
+      varlen_default_value=varlen_default_value)
+
+
+def _plain_tensor_spec(shape=None, dtype=None, name=None):
+  return TensorSpec(shape=_shape_tuple(shape), dtype=_np_dtype(dtype),
+                    name=name)
+
+
+_CLASS_MAP = {
+    ('tensor2robot.utils.tensorspec_utils', 'ExtendedTensorSpec'):
+        _extended_tensor_spec,
+    # Reconstructed as a state-dropping dict shim (pickle bypasses
+    # __init__, which SpecStruct needs, and real TensorSpecStruct pickles
+    # carry instance state); load_input_spec_from_file wraps the result.
+    ('tensor2robot.utils.tensorspec_utils', 'TensorSpecStruct'):
+        _LegacyStruct,
+    ('tensorflow.python.framework.tensor_shape', 'TensorShape'):
+        _TensorShape,
+    ('tensorflow.python.framework.tensor_shape', 'Dimension'): _Dim,
+    ('tensorflow.python.framework.dtypes', 'as_dtype'): _as_dtype,
+    ('tensorflow.python.framework.tensor_spec', 'TensorSpec'):
+        _plain_tensor_spec,
+    ('tensorflow.python.framework.tensor', 'TensorSpec'):
+        _plain_tensor_spec,
+    ('collections', 'OrderedDict'): dict,
+}
+
+
+class _LegacyUnpickler(pickle.Unpickler):
+
+  def find_class(self, module, name):
+    try:
+      return _CLASS_MAP[(module, name)]
+    except KeyError:
+      raise pickle.UnpicklingError(
+          f'Refusing to unpickle {module}.{name}: only legacy '
+          'tensor2robot spec classes are allowed.')
+
+
+def loads(data: bytes):
+  return _LegacyUnpickler(io.BytesIO(data)).load()
+
+
+def load_input_spec_from_file(path: str) -> Tuple[SpecStruct, SpecStruct]:
+  """Reads a legacy ``input_specs.pkl`` → (feature_spec, label_spec)."""
+  with open(path, 'rb') as f:
+    spec_data = loads(f.read())
+  return (SpecStruct(spec_data['in_feature_spec']),
+          SpecStruct(spec_data['in_label_spec']))
+
+
+def load_global_step_from_file(path: str) -> int:
+  with open(path, 'rb') as f:
+    data = loads(f.read())
+  if isinstance(data, dict):
+    return int(data.get('global_step', 0))
+  return int(data)

@@ -1,0 +1,139 @@
+"""Legacy pickle-assets migration (ref convert_pkl_assets_to_proto_assets).
+
+Fabricates byte-faithful legacy pickles: REAL TensorFlow
+``TensorShape``/``DType`` objects (pickling exactly as genuine legacy
+assets do — ``as_dtype`` by name, ``TensorShape(Dimension...)``) plus
+stubs registered under the original ``tensor2robot.utils
+.tensorspec_utils`` path whose ``__reduce__``/instance-state match the
+reference classes (``tensorspec_utils.py:278-282`` and the OrderedDict
+subclass with ``_path_prefix`` state at ``:306``).
+"""
+
+import collections
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.bin import convert_pkl_assets
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs import legacy_pickle
+
+_MISSING = object()
+_T2R_MODULE = 'tensor2robot.utils.tensorspec_utils'
+
+
+@pytest.fixture()
+def legacy_modules():
+  class ExtendedTensorSpec:
+    """Reduce-faithful stand-in (reference tensorspec_utils.py:278-282)."""
+
+    def __init__(self, *args):
+      self.args = args
+
+    def __reduce__(self):
+      return (ExtendedTensorSpec, self.args)
+
+  class TensorSpecStruct(collections.OrderedDict):
+    """State-faithful stand-in: real pickles carry instance attrs."""
+
+    def __init__(self, *args, **kwargs):
+      super().__init__(*args, **kwargs)
+      self._path_prefix = ''
+      self._dict_view = None
+
+  saved = {}
+  parts = _T2R_MODULE.split('.')
+  for i in range(1, len(parts) + 1):
+    name = '.'.join(parts[:i])
+    saved[name] = sys.modules.get(name, _MISSING)
+    sys.modules[name] = types.ModuleType(name)
+  mod = sys.modules[_T2R_MODULE]
+  for cls in (ExtendedTensorSpec, TensorSpecStruct):
+    cls.__module__ = _T2R_MODULE
+    cls.__qualname__ = cls.__name__
+    setattr(mod, cls.__name__, cls)
+  yield types.SimpleNamespace(ExtendedTensorSpec=ExtendedTensorSpec,
+                              TensorSpecStruct=TensorSpecStruct)
+  for name, original in saved.items():
+    if original is _MISSING:
+      sys.modules.pop(name, None)
+    else:
+      sys.modules[name] = original
+
+
+def _write_legacy_assets(tmp_path, m):
+  import tensorflow as tf
+
+  feature_spec = m.TensorSpecStruct()
+  # (shape, dtype, name, is_optional, is_sequence, is_extracted,
+  #  data_format, dataset_key, varlen_default_value)
+  feature_spec['state/image'] = m.ExtendedTensorSpec(
+      tf.TensorShape([64, 64, 3]), tf.uint8, 'image', False, False, False,
+      'jpeg', '', None)
+  feature_spec['state/pose'] = m.ExtendedTensorSpec(
+      tf.TensorShape([7]), tf.float32, 'pose', True, False, False, None,
+      '', None)
+  feature_spec['state/text'] = m.ExtendedTensorSpec(
+      tf.TensorShape([]), tf.string, 'text', True, False, False, None,
+      '', None)
+  label_spec = m.TensorSpecStruct()
+  label_spec['target'] = m.ExtendedTensorSpec(
+      tf.TensorShape([2]), tf.float32, 'target', False, False, False,
+      None, '', None)
+  with open(tmp_path / 'input_specs.pkl', 'wb') as f:
+    pickle.dump({'in_feature_spec': feature_spec,
+                 'in_label_spec': label_spec}, f)
+  with open(tmp_path / 'global_step.pkl', 'wb') as f:
+    pickle.dump({'global_step': 1234}, f)
+
+
+def test_real_tf_objects_pickle_through_restricted_loader(
+    tmp_path, legacy_modules):
+  """The wire format is REAL TF's: as_dtype by name, Dimension shapes,
+  OrderedDict-subclass instance state — all must load."""
+  _write_legacy_assets(tmp_path, legacy_modules)
+  feature_spec, label_spec = legacy_pickle.load_input_spec_from_file(
+      str(tmp_path / 'input_specs.pkl'))
+  assert tuple(feature_spec['state/image'].shape) == (64, 64, 3)
+  assert feature_spec['state/text'].dtype == np.dtype(object)
+  assert tuple(label_spec['target'].shape) == (2,)
+
+
+def test_convert_legacy_assets(tmp_path, legacy_modules):
+  _write_legacy_assets(tmp_path, legacy_modules)
+  out = convert_pkl_assets.convert(str(tmp_path))
+  assets = assets_lib.load_t2r_assets_from_file(out)
+  assert assets.global_step == 1234
+  from tensor2robot_tpu.specs import SpecStruct
+
+  feature_spec = SpecStruct.from_proto(assets.feature_spec)
+  label_spec = SpecStruct.from_proto(assets.label_spec)
+  img = feature_spec['state/image']
+  assert tuple(img.shape) == (64, 64, 3)
+  assert img.dtype == np.uint8
+  assert img.data_format == 'JPEG'
+  assert img.name == 'image'
+  pose = feature_spec['state/pose']
+  assert pose.is_optional and tuple(pose.shape) == (7,)
+  assert tuple(label_spec['target'].shape) == (2,)
+  assert label_spec['target'].dtype == np.float32
+
+
+def test_unpickler_refuses_arbitrary_classes(tmp_path, legacy_modules):
+  class Evil:
+    def __reduce__(self):
+      return (print, ('pwned',))
+
+  with open(tmp_path / 'input_specs.pkl', 'wb') as f:
+    pickle.dump({'in_feature_spec': Evil(), 'in_label_spec': {}}, f)
+  with pytest.raises(pickle.UnpicklingError, match='Refusing'):
+    legacy_pickle.load_input_spec_from_file(
+        str(tmp_path / 'input_specs.pkl'))
+
+
+def test_missing_input_specs_raises(tmp_path):
+  with pytest.raises(ValueError, match='No file exists'):
+    convert_pkl_assets.convert(str(tmp_path))
