@@ -30,9 +30,31 @@ def main(argv=None):
   t2r_config.parse_config_files_and_bindings(
       config_files=args.gin_configs, bindings=args.gin_bindings)
 
+  # Persist the config next to the checkpoints, like the reference's
+  # GinConfigSaverHook (train_eval.py:540-541): the FULL parsed config at
+  # startup (so crashed/preempted runs are still reproducible from the
+  # model dir), refined to the operative (actually-consumed) config on
+  # successful completion.
+  import os
+
+  try:
+    model_dir = t2r_config.query_parameter('train_eval_model.model_dir')
+  except t2r_config.ConfigError:
+    model_dir = None
+
+  def save_config(text):
+    if not model_dir or '://' in str(model_dir):
+      return
+    os.makedirs(model_dir, exist_ok=True)
+    with open(os.path.join(model_dir, 'operative_config-0.gin'), 'w') as f:
+      f.write(text)
+
+  save_config(t2r_config.config_str())
   train_eval_model = t2r_config.get_configurable('train_eval_model')
   result = train_eval_model()
-  logging.info('Operative config:\n%s', t2r_config.operative_config_str())
+  operative = t2r_config.operative_config_str()
+  logging.info('Operative config:\n%s', operative)
+  save_config(operative)
   return result
 
 

@@ -29,7 +29,7 @@ composes ``model_fn``, but as jitted functions instead of graph modes.
 from __future__ import annotations
 
 import abc
-from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Type
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple, Type
 
 import jax
 import numpy as np
@@ -169,6 +169,20 @@ class AbstractT2RModel(ModelInterface):
     if self.is_device_tpu:
       preprocessor = DtypePolicyPreprocessor(preprocessor)
     return preprocessor
+
+  def param_sharding_rules(self, mesh) -> Sequence:
+    """Tensor-parallel parameter layouts for this model (optional).
+
+    Returns ``(path_regex, per-dim axis spec)`` pairs consumed by
+    ``parallel.mesh.state_shardings_for``: the first matching rule shards
+    that parameter over the named mesh axes (e.g.
+    ``(r'fcgrasp/kernel$', (None, 'model'))`` column-shards a Dense
+    kernel, Megatron-style). Unmatched parameters fall back to the fsdp
+    rule. Axes missing from ``mesh`` are ignored, so rules are
+    layout-portable.
+    """
+    del mesh
+    return ()
 
   # ------------------------------------------------------------- core fns
 

@@ -172,18 +172,66 @@ def fsdp_param_sharding(mesh: Mesh, param) -> NamedSharding:
   return NamedSharding(mesh, P(*spec))
 
 
-def state_shardings_for(mesh: Mesh, state: Any) -> Any:
-  """Sharding tree for a TrainState: fsdp-sharded params, replicated rest.
+def rule_param_sharding(mesh: Mesh, path: str, param,
+                        rules) -> Optional[NamedSharding]:
+  """First matching (regex, spec) rule → NamedSharding, else None.
 
-  Starting point for the trainer; models can override with finer rules
-  (e.g. tensor-parallel attention layouts) via `logical sharding` later.
+  ``rules``: sequence of ``(pattern, spec)`` where ``pattern`` is matched
+  (``re.search``) against the parameter's slash-joined tree path and
+  ``spec`` is a tuple of axis names / None per dimension — e.g.
+  ``(r'fcgrasp/kernel', (None, 'model'))`` column-shards a Dense kernel
+  over the tensor-parallel axis (Megatron-style). Axes absent from the
+  mesh or not dividing the dim are dropped (replicated on that dim), so
+  one rule set serves every mesh layout.
+  """
+  import re
+
+  shape = getattr(param, 'shape', ())
+  for pattern, spec in rules:
+    if re.search(pattern, path) is None:
+      continue
+    if len(spec) != len(shape):
+      continue
+    fixed = []
+    for dim, axis in zip(shape, spec):
+      if (axis is None or axis not in mesh.axis_names or
+          mesh.shape.get(axis, 1) <= 1 or dim % mesh.shape[axis]):
+        fixed.append(None)
+      else:
+        fixed.append(axis)
+    if not any(fixed):
+      # Every requested axis degenerated (absent / size 1 / indivisible):
+      # fall through to the default rule instead of pinning the param
+      # replicated — otherwise declaring TP rules would silently disable
+      # fsdp sharding on non-TP meshes.
+      return None
+    return NamedSharding(mesh, P(*fixed))
+  return None
+
+
+def state_shardings_for(mesh: Mesh, state: Any, rules=()) -> Any:
+  """Sharding tree for a TrainState.
+
+  Per-leaf: a matching model rule (tensor-parallel layouts, see
+  :func:`rule_param_sharding`) wins; otherwise the ZeRO-3 fsdp rule;
+  otherwise replicated. Models declare rules via
+  ``AbstractT2RModel.param_sharding_rules``.
   """
   fsdp_size = mesh.shape.get(FSDP_AXIS, 1)
-  if fsdp_size <= 1:
-    rep = replicated(mesh)
-    return jax.tree_util.tree_map(lambda _: rep, state)
-  return jax.tree_util.tree_map(
-      lambda leaf: fsdp_param_sharding(mesh, leaf), state)
+  rep = replicated(mesh)
+
+  def leaf_sharding(path, leaf):
+    if rules:
+      name = '/'.join(str(getattr(k, 'key', getattr(k, 'name', k)))
+                      for k in path)
+      ruled = rule_param_sharding(mesh, name, leaf, rules)
+      if ruled is not None:
+        return ruled
+    if fsdp_size > 1:
+      return fsdp_param_sharding(mesh, leaf)
+    return rep
+
+  return jax.tree_util.tree_map_with_path(leaf_sharding, state)
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

@@ -120,6 +120,20 @@ class GraspingModelWrapper(critic_model.CriticModel):
     return networks.Grasping44(
         num_convs=self._num_convs, dtype=self.compute_dtype)
 
+  def param_sharding_rules(self, mesh):
+    """Megatron-style TP pair on the grasp-param MLP: ``fcgrasp`` kernel
+    column-sharded over the ``model`` axis, ``fcgrasp2`` row-sharded (one
+    all-reduce at the pair's output, inserted by GSPMD). The 64-channel
+    conv tower stays fsdp/replicated — too narrow to benefit."""
+    from tensor2robot_tpu.parallel.mesh import MODEL_AXIS
+
+    del mesh
+    return (
+        (r'fcgrasp/kernel$', (None, MODEL_AXIS)),
+        (r'fcgrasp/bias$', (MODEL_AXIS,)),
+        (r'fcgrasp2/kernel$', (MODEL_AXIS, None)),
+    )
+
   def get_state_specification(self) -> SpecStruct:
     spec = SpecStruct()
     spec['image'] = TensorSpec(

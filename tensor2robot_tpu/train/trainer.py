@@ -216,7 +216,11 @@ class Trainer:
   def _state_sharding(self):
     if self._state is None:
       raise ValueError('State must be initialized before building steps.')
-    return mesh_lib.state_shardings_for(self._mesh, self._state)
+    rules = ()
+    if hasattr(self._model, 'param_sharding_rules'):
+      rules = tuple(self._model.param_sharding_rules(self._mesh) or ())
+    return mesh_lib.state_shardings_for(self._mesh, self._state,
+                                        rules=rules)
 
   # ------------------------------------------------------- state lifecycle
 
@@ -402,6 +406,21 @@ def train_eval_model(model=None,
     exporters = list(create_exporters_fn(model))
 
   trainer = Trainer(model, config, mesh=mesh, callbacks=callbacks)
+
+  # Spec dump at startup (the reference logs the full in/out spec contract
+  # before training, utils/train_eval.py:65-98).
+  preprocessor = model.preprocessor
+  for kind, getter in (
+      ('feature', preprocessor.get_in_feature_specification),
+      ('label', preprocessor.get_in_label_specification)):
+    try:
+      spec = getter(ModeKeys.TRAIN)
+    except Exception:  # models without one of the specs
+      continue
+    if spec is not None:
+      logging.info('train %s specs:\n%s', kind,
+                   '\n'.join(f'  {k}: {v}' for k, v in sorted(
+                       dict(spec.items()).items())))
 
   if train_input_generator is not None:
     provide_input_generator_with_model_information(

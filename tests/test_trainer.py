@@ -98,6 +98,47 @@ def test_trainer_on_8_device_mesh(tmp_path):
   assert metrics['accuracy'] > 0.9, metrics
 
 
+def test_trainer_tensor_parallel_rules(tmp_path):
+  """Model-declared TP rules shard the named params over `model` and the
+  Megatron pair still converges (GSPMD inserts the collectives)."""
+
+  class TPModel(MockT2RModel):
+
+    def param_sharding_rules(self, mesh):
+      return (
+          (r'Dense_0/kernel$', (None, parallel.MODEL_AXIS)),
+          (r'Dense_0/bias$', (parallel.MODEL_AXIS,)),
+          (r'Dense_1/kernel$', (parallel.MODEL_AXIS, None)),
+      )
+
+  mesh = parallel.create_mesh(data=2, fsdp=2, model=2)
+  model = TPModel(device_type='tpu', create_optimizer_fn=fast_adam)
+  config = TrainerConfig(model_dir='', max_train_steps=1,
+                         eval_interval_steps=0, log_interval_steps=0)
+  trainer = Trainer(model, config, mesh=mesh)
+  gen = MockInputGenerator(batch_size=32)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  features, _ = next(gen.create_iterator(ModeKeys.TRAIN))
+  trainer.initialize(features)
+  sharding = trainer._state_sharding()  # pylint: disable=protected-access
+  k0 = sharding.params['Dense_0']['kernel'].spec
+  k1 = sharding.params['Dense_1']['kernel'].spec
+  assert tuple(k0) == (None, parallel.MODEL_AXIS), k0
+  assert tuple(k1)[0] == parallel.MODEL_AXIS, k1
+
+  metrics = train_eval_model(
+      model=TPModel(device_type='tpu', create_optimizer_fn=fast_adam),
+      model_dir='',
+      train_input_generator=MockInputGenerator(batch_size=32),
+      eval_input_generator=MockInputGenerator(batch_size=32),
+      max_train_steps=200,
+      eval_steps=5,
+      eval_interval_steps=0,
+      log_interval_steps=0,
+      mesh=mesh)
+  assert metrics['accuracy'] > 0.9, metrics
+
+
 def test_trainer_fsdp_mesh(tmp_path):
   """Params sharded over the fsdp axis still converge."""
   mesh = parallel.create_mesh(data=2, fsdp=4)
