@@ -38,12 +38,15 @@ def main(argv=None):
   import os
 
   try:
-    model_dir = t2r_config.query_parameter('train_eval_model.model_dir')
+    model_dir = t2r_config.query_parameter('train_eval_model.model_dir',
+                                           resolve=True)
   except t2r_config.ConfigError:
+    model_dir = None
+  if not isinstance(model_dir, str):
     model_dir = None
 
   def save_config(text):
-    if not model_dir or '://' in str(model_dir):
+    if not model_dir or '://' in model_dir:
       return
     os.makedirs(model_dir, exist_ok=True)
     with open(os.path.join(model_dir, 'operative_config-0.gin'), 'w') as f:

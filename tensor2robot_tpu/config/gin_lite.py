@@ -182,6 +182,11 @@ class _Reference:
     self.scope, _, self.name = name.rpartition('/')
     self.evaluate = evaluate
 
+  def __repr__(self):
+    # gin syntax, so config_str() round-trips through parse_config.
+    prefix = f'{self.scope}/' if self.scope else ''
+    return f'@{prefix}{self.name}' + ('()' if self.evaluate else '')
+
   def resolve(self):
     with _LOCK:
       target = _REGISTRY.get(self.name)
@@ -207,6 +212,10 @@ class _Reference:
 class _Macro:
   def __init__(self, name: str):
     self.name = name
+
+  def __repr__(self):
+    # gin syntax, so config_str() round-trips through parse_config.
+    return f'%{self.name}'
 
   def resolve(self):
     with _LOCK:
@@ -374,13 +383,21 @@ def bind_parameter(target: str, value: Any) -> None:
     _BINDINGS.setdefault((scope, name), {})[param] = value
 
 
-def query_parameter(target: str) -> Any:
+def query_parameter(target: str, resolve: bool = False) -> Any:
+  """Returns the binding for ``scope/name.param``.
+
+  ``resolve=True`` evaluates macros/references to their values (e.g. a
+  ``%model_dir``-bound path resolves to the string) instead of returning
+  the raw binding object.
+  """
   scoped_name, _, param = target.rpartition('.')
   scope, _, name = scoped_name.rpartition('/')
   with _LOCK:
     if (scope, name) in _BINDINGS and param in _BINDINGS[(scope, name)]:
-      return _BINDINGS[(scope, name)][param]
-  raise ConfigError(f'No binding for {target!r}.')
+      value = _BINDINGS[(scope, name)][param]
+    else:
+      raise ConfigError(f'No binding for {target!r}.')
+  return _resolve(value) if resolve else value
 
 
 def get_configurable(name: str) -> Callable:

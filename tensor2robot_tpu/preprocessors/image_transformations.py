@@ -136,6 +136,7 @@ def apply_photometric_image_distortions(
     upper_contrast: float = 1.5,
     random_noise_level: float = 0.0,
     random_noise_apply_probability: float = 0.5,
+    use_fused_kernel: bool = False,
 ) -> jax.Array:
   """Per-image random photometric distortion chain.
 
@@ -143,14 +144,17 @@ def apply_photometric_image_distortions(
   the reference's per-image loop (image_transformations.py:181-272) but
   vectorized over the batch.
 
-  When exactly brightness + contrast are enabled (no saturation / hue /
-  noise) on TPU, the chain dispatches to the fused Pallas kernel in
-  :mod:`tensor2robot_tpu.ops.photometric` — one HBM pass instead of
-  separate add / reduce / scale / clip stages.
+  ``use_fused_kernel`` routes the brightness+contrast-only case to the
+  Pallas kernel in :mod:`tensor2robot_tpu.ops.photometric`. It is OFF by
+  default: trace-based measurement on this chip shows XLA's own fusion of
+  the chain is faster (0.28 vs 0.43 ms on [32,472,472,3] — Pallas DMA
+  throughput trails XLA loop fusions here; see PERF_NOTES.md). The kernel
+  remains the numerics-tested Pallas reference for fusion-hostile
+  elementwise+reduction chains.
   """
   batch = images.shape[0]
-  if (random_brightness and random_contrast and not random_saturation and
-      not random_hue and not random_noise_level and
+  if (use_fused_kernel and random_brightness and random_contrast and
+      not random_saturation and not random_hue and not random_noise_level and
       jax.default_backend() == 'tpu'):
     from tensor2robot_tpu.ops import photometric
 

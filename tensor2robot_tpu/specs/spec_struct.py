@@ -65,6 +65,12 @@ class SpecStruct(collections_abc.MutableMapping):
     for key, value in kwargs.items():
       self[key] = value
 
+  def __reduce__(self):
+    # Pickle as (class, flat items): views materialize their subtree, and
+    # reconstruction goes through __init__ (plain dict-subclass pickling
+    # would bypass it and leave the slots unset).
+    return (type(self), (list(self.items()),))
+
   # ----------------------------------------------------------------- views
 
   @classmethod

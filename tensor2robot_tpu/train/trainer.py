@@ -413,14 +413,11 @@ def train_eval_model(model=None,
   for kind, getter in (
       ('feature', preprocessor.get_in_feature_specification),
       ('label', preprocessor.get_in_label_specification)):
-    try:
-      spec = getter(ModeKeys.TRAIN)
-    except Exception:  # models without one of the specs
-      continue
+    spec = getter(ModeKeys.TRAIN)
     if spec is not None:
       logging.info('train %s specs:\n%s', kind,
-                   '\n'.join(f'  {k}: {v}' for k, v in sorted(
-                       dict(spec.items()).items())))
+                   '\n'.join(f'  {k}: {v}'
+                             for k, v in sorted(spec.items())))
 
   if train_input_generator is not None:
     provide_input_generator_with_model_information(

@@ -162,6 +162,21 @@ class TestSpecStruct:
     assert isinstance(doubled, SpecStruct)
     np.testing.assert_allclose(np.asarray(doubled['a/x']), 2.0)
 
+  def test_pickle_roundtrip_and_views(self):
+    import pickle
+
+    s = SpecStruct({'a/x': TensorSpec(shape=(2,), dtype=np.float32),
+                    'a/y': TensorSpec(shape=(), dtype=np.int64),
+                    'b': TensorSpec(shape=(3,), dtype=np.float32)})
+    restored = pickle.loads(pickle.dumps(s))
+    assert isinstance(restored, SpecStruct)
+    assert list(restored.keys()) == list(s.keys())
+    assert restored['a/x'] == s['a/x']
+    # Views pickle as their materialized subtree.
+    view = pickle.loads(pickle.dumps(s['a']))
+    assert sorted(view.keys()) == ['x', 'y']
+    assert view['x'] == s['a/x']
+
 
 class TestAlgebra:
 
