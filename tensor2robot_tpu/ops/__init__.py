@@ -1,5 +1,16 @@
-"""Custom Pallas TPU ops (the hot non-MXU paths)."""
+"""Custom Pallas TPU ops.
 
+``flash_attention`` is the long-context workhorse (3.5–5.4× over the XLA
+attention chain on-chip, O(T·D) memory); ``photometric`` is the fused
+image-distortion kernel kept as the Pallas reference for elementwise+
+reduction chains (XLA's own fusion currently wins on-chip — see
+PERF_NOTES.md — so its dispatch is opt-in).
+"""
+
+from tensor2robot_tpu.ops.flash_attention import (
+    flash_attention,
+    is_supported as flash_attention_supported,
+)
 from tensor2robot_tpu.ops.photometric import (
     fused_brightness_contrast,
     random_brightness_contrast,

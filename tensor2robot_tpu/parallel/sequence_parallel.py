@@ -135,16 +135,24 @@ def ulysses_attention(q: jax.Array,
         x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
   ql, kl, vl = to_headsharded(q), to_headsharded(k), to_headsharded(v)
-  t = ql.shape[1]
-  mask = (jnp.tril(jnp.ones((t, t), bool)) if causal else None)
-  m0 = jnp.full(ql.shape[:1] + (ql.shape[2], t), -jnp.inf, jnp.float32)
-  l0 = jnp.zeros_like(m0)
-  o0 = jnp.zeros(ql.shape, jnp.float32)
-  m, l, o = _block_attention(
-      ql.astype(jnp.float32), kl.astype(jnp.float32),
-      vl.astype(jnp.float32), mask, m0, l0, o0)
-  out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-  return to_seqsharded(out.astype(q.dtype))
+  t, d = ql.shape[1], ql.shape[3]
+  from tensor2robot_tpu.ops import flash_attention as fa
+
+  if fa.is_supported(t, d):
+    # The full-sequence local attention is exactly the flash kernel's
+    # job: O(T·D) HBM memory instead of the [B, H, T, T] logits tensor.
+    out = fa.flash_attention(ql, kl, vl, causal)
+  else:
+    mask = (jnp.tril(jnp.ones((t, t), bool)) if causal else None)
+    m0 = jnp.full(ql.shape[:1] + (ql.shape[2], t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros_like(m0)
+    o0 = jnp.zeros(ql.shape, jnp.float32)
+    m, l, o = _block_attention(
+        ql.astype(jnp.float32), kl.astype(jnp.float32),
+        vl.astype(jnp.float32), mask, m0, l0, o0)
+    out = (o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]).astype(
+        q.dtype)
+  return to_seqsharded(out)
 
 
 def _sharded_apply(fn, mesh: Mesh, axis_name: str, causal: bool):

@@ -1,0 +1,68 @@
+"""Pallas flash attention vs the full-attention oracle (interpret mode)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.ops.flash_attention import flash_attention
+from tensor2robot_tpu.parallel.sequence_parallel import reference_attention
+
+
+def _qkv(shape, seed=0, dtype=jnp.float32):
+  rng = np.random.RandomState(seed)
+  return tuple(jnp.asarray(rng.randn(*shape), dtype) for _ in range(3))
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('shape,bq,bk', [
+    ((2, 256, 2, 32), 64, 128),
+    ((1, 512, 4, 64), 256, 512),
+    ((1, 128, 2, 16), 128, 128),
+    # block_q > block_k: causal q blocks contain fully-masked rows for
+    # trailing key blocks (regression for the m == -inf exp guard).
+    ((1, 256, 2, 16), 128, 64),
+])
+def test_matches_reference(shape, bq, bk, causal):
+  q, k, v = _qkv(shape)
+  out = flash_attention(q, k, v, causal, bq, bk)
+  ref = reference_attention(q, k, v, causal=causal)
+  np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_grads_match_reference(causal):
+  q, k, v = _qkv((2, 256, 2, 32), seed=1)
+  ct = jnp.asarray(np.random.RandomState(2).randn(2, 256, 2, 32),
+                   jnp.float32)
+
+  def loss(fn):
+    return jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) * ct),
+        argnums=(0, 1, 2))
+
+  got = loss(lambda q, k, v: flash_attention(q, k, v, causal, 64, 128))(
+      q, k, v)
+  ref = loss(lambda q, k, v: reference_attention(q, k, v, causal=causal))(
+      q, k, v)
+  for g, r in zip(got, ref):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4)
+
+
+def test_rejects_bad_shapes():
+  q, k, v = _qkv((1, 100, 2, 16))
+  with pytest.raises(ValueError, match='divisible'):
+    flash_attention(q, k, v, False, 64, 64)
+  q, k, v = _qkv((1, 128, 2, 256))
+  with pytest.raises(ValueError, match='head dim'):
+    flash_attention(q, k, v, False, 128, 128)
+
+
+def test_bf16_inputs():
+  q, k, v = _qkv((1, 256, 2, 32), dtype=jnp.bfloat16)
+  out = flash_attention(q, k, v, True, 128, 128)
+  ref = reference_attention(q, k, v, causal=True)
+  assert out.dtype == jnp.bfloat16
+  np.testing.assert_allclose(
+      np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2)

@@ -85,3 +85,17 @@ class TestUlyssesAttention:
     ulysses = make_ulysses_attention(seq_mesh)
     with pytest.raises(Exception):
       jax.jit(ulysses)(q, k, v)
+
+  @pytest.mark.parametrize('causal', [False, True])
+  def test_fallback_path_when_flash_unsupported(self, seq_mesh, causal):
+    """dim=12 fails flash's d % 8 alignment, exercising the
+    _block_attention fallback branch of ulysses_attention."""
+    from tensor2robot_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(dim=12)
+    assert not fa.is_supported(q.shape[1], q.shape[3])
+    ulysses = jax.jit(make_ulysses_attention(seq_mesh, causal=causal))
+    out = ulysses(q, k, v)
+    expected = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5)
