@@ -5,10 +5,16 @@ attention chain on-chip, O(T·D) memory); ``photometric`` is the fused
 image-distortion kernel kept as the Pallas reference for elementwise+
 reduction chains (XLA's own fusion currently wins on-chip — see
 PERF_NOTES.md — so its dispatch is opt-in).
+
+NOTE: the ``flash_attention`` attribute of this package is the
+SUBMODULE; import the callable from it
+(``from tensor2robot_tpu.ops.flash_attention import flash_attention``).
+Re-exporting the function here would shadow the module (the round-1
+``run_meta_env`` registration bug all over again).
 """
 
+from tensor2robot_tpu.ops import flash_attention, photometric
 from tensor2robot_tpu.ops.flash_attention import (
-    flash_attention,
     is_supported as flash_attention_supported,
 )
 from tensor2robot_tpu.ops.photometric import (

@@ -16,13 +16,14 @@ produces dq in a q-block grid and dk/dv in a k-block grid, with
 ``D = rowsum(dO ⊙ O)`` precomputed.
 
 Constraints (see :func:`is_supported`): ``T`` divisible by the
-(8-aligned) block sizes; head dim ≤ 128. The per-sequence K/V are staged
-into VMEM wholesale (one DMA per grid row rather than per block), which
-caps the per-device sequence at ``T·D ≲ 2M`` elements (~32k tokens at
-D=64) — under sequence parallelism that bound applies to the PER-DEVICE
-shard, so an 8-way mesh covers ~256k global tokens; a fully-streamed
-K/V variant would lift it. Runs in interpret mode off-TPU so the
-CPU-mesh test suite exercises the same code path.
+(8-aligned) block sizes; head dim ≤ 128. Two implementations behind one
+API: up to ``T·D ≤ 2M`` elements (~32k tokens at D=64) the per-sequence
+K/V are staged into VMEM wholesale (fewer DMAs, dynamic causal
+early-exit); past that the streamed kernels take over — K/V blocks
+become an inner sequential grid dimension with the flash accumulators in
+VMEM scratch, so memory is O(block) and T is bounded only by HBM. Runs
+in interpret mode off-TPU so the CPU-mesh test suite exercises the same
+code paths.
 """
 
 from __future__ import annotations
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/corr math
                   # finite without isfinite guards in the inner loop
@@ -41,6 +43,44 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/corr math
 
 def _use_interpret() -> bool:
   return jax.default_backend() == 'cpu'
+
+def _scores(q, k, q0, k0, causal, scale=None):
+  """Scaled (optional) masked q·kᵀ block scores; (q0, k0) are the global
+  offsets of the blocks — THE shared definition of the causal mask and
+  score math for every kernel variant (staged and streamed)."""
+  s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+  if scale is not None:
+    s = s * scale
+  if causal:
+    bq, bk = s.shape
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    s = jnp.where(qpos >= kpos, s, _NEG_INF)
+  return s
+
+
+def _online_softmax_step(s, m, l, acc, v):
+  """One flash accumulator update from a block of scores."""
+  m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+  # Rows with every key masked so far have m_new == _NEG_INF; clamp the
+  # subtrahend so exp(_NEG_INF - m_new) stays 0 instead of exp(0) = 1.
+  m_sub = jnp.maximum(m_new, 0.5 * _NEG_INF)
+  p = jnp.exp(s - m_sub)
+  corr = jnp.exp(m - m_sub)
+  l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+  acc = acc * corr + jax.lax.dot_general(
+      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+  return m_new, l, acc
+
+
+def _ds_block(s, lse, do, v, delta):
+  """FlashAttention-2 backward core: (p, ds) from saved logsumexp."""
+  p = jnp.exp(s - lse)
+  dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+  return p, p * (dp - delta)
+
 
 
 # ----------------------------------------------------------------- forward
@@ -60,22 +100,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, causal, scale):
     m, l, acc = carry
     k = k_ref[0, pl.dslice(i * bk, bk), :].astype(jnp.float32)
     v = v_ref[0, pl.dslice(i * bk, bk), :].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    if causal:
-      qpos = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-      kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-      s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-    # Rows with every key masked so far have m_new == _NEG_INF; clamp the
-    # subtrahend so exp(_NEG_INF - m_new) stays 0 instead of exp(0) = 1.
-    m_sub = jnp.maximum(m_new, 0.5 * _NEG_INF)
-    p = jnp.exp(s - m_sub)
-    corr = jnp.exp(m - m_sub)
-    l = l * corr + jnp.sum(p, axis=1, keepdims=True)
-    acc = acc * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    return m_new, l, acc
+    s = _scores(q, k, qb * bq, i * bk, causal)
+    return _online_softmax_step(s, m, l, acc, v)
 
   if causal:
     # Only key blocks at/before this q block's diagonal contribute.
@@ -86,6 +112,115 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, causal, scale):
   l = jnp.maximum(l, 1e-30)
   o_ref[0] = (acc / l).astype(o_ref.dtype)
   lse_ref[0, 0] = (m[:, 0] + jnp.log(l[:, 0]))
+
+
+# ----------------------------------------------------- streamed variants
+#
+# For sequences past the whole-KV-in-VMEM bound, K/V blocks become a
+# THIRD (innermost, sequential) grid dimension and the flash accumulators
+# live in VMEM scratch across those steps — VMEM usage is O(block), so T
+# is bounded only by HBM. Slightly slower than the staged kernels at
+# small T (per-block DMAs; causal skipping via pl.when instead of a
+# shortened loop), so the dispatcher uses these only when needed.
+
+
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                         acc_scr, *, causal, scale, nk):
+  qb, kb = pl.program_id(1), pl.program_id(2)
+  bq, d = q_ref.shape[1], q_ref.shape[2]
+  bk = k_ref.shape[1]
+
+  @pl.when(kb == 0)
+  def _():
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+  # Causal: key blocks strictly above the diagonal contribute nothing.
+  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+
+  @pl.when(live)
+  def _():
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = _scores(q, k, qb * bq, kb * bk, causal)
+    m_new, l_new, acc_new = _online_softmax_step(
+        s, m_scr[...], l_scr[...], acc_scr[...], v)
+    m_scr[...] = m_new
+    l_scr[...] = l_new
+    acc_scr[...] = acc_new
+
+  @pl.when(kb == nk - 1)
+  def _():
+    l = jnp.maximum(l_scr[...], 1e-30)
+    o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m_scr[...][:, 0] + jnp.log(l[:, 0])
+
+
+def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_scr, *, causal, scale, nk):
+  qb, kb = pl.program_id(1), pl.program_id(2)
+  bq, d = q_ref.shape[1], q_ref.shape[2]
+  bk = k_ref.shape[1]
+
+  @pl.when(kb == 0)
+  def _():
+    dq_scr[...] = jnp.zeros_like(dq_scr)
+
+  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+
+  @pl.when(live)
+  def _():
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    s = _scores(q, k, qb * bq, kb * bk, causal, scale)
+    _, ds = _ds_block(s, lse, do, v, delta)
+    dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+  @pl.when(kb == nk - 1)
+  def _():
+    dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale,
+                         nq):
+  kb, qb = pl.program_id(1), pl.program_id(2)
+  bk, d = k_ref.shape[1], k_ref.shape[2]
+  bq = q_ref.shape[1]
+
+  @pl.when(qb == 0)
+  def _():
+    dk_scr[...] = jnp.zeros_like(dk_scr)
+    dv_scr[...] = jnp.zeros_like(dv_scr)
+
+  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+
+  @pl.when(live)
+  def _():
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    s = _scores(q, k, qb * bq, kb * bk, causal, scale)
+    p, ds = _ds_block(s, lse, do, v, delta)
+    dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+  @pl.when(qb == nq - 1)
+  def _():
+    dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------- backward
@@ -106,16 +241,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
   def body(i, dq):
     k = k_ref[0, pl.dslice(i * bk, bk), :].astype(jnp.float32)
     v = v_ref[0, pl.dslice(i * bk, bk), :].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-      qpos = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-      kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-      s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jnp.exp(s - lse)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
+    s = _scores(q, k, qb * bq, i * bk, causal, scale)
+    _, ds = _ds_block(s, lse, do, v, delta)
     return dq + jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -144,18 +271,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     do = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
     lse = lse_ref[0, 0, pl.dslice(i * bq, bq)][:, None]
     delta = delta_ref[0, 0, pl.dslice(i * bq, bq)][:, None]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-      qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-      kpos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-      s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jnp.exp(s - lse)                       # [bq, bk]
+    s = _scores(q, k, i * bq, kb * bk, causal, scale)
+    p, ds = _ds_block(s, lse, do, v, delta)
     dv = dv + jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
     dk = dk + jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     return dk, dv
@@ -186,8 +305,10 @@ def _unfold_heads(x, b, h):
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
-# K+V staged in VMEM per grid row: 2 · t · d · 2B ≤ ~8 MB of the ~16 MB.
-_MAX_T_TIMES_D = 2 * 1024 * 1024
+# Whole-sequence K/V staging fits VMEM up to 2·t·d·2B ≤ ~8 MB of the
+# ~16 MB; beyond it the streamed kernels (K/V blocks as an inner grid
+# dim, scratch accumulators) take over, bounded only by HBM.
+_MAX_STAGED_T_TIMES_D = 2 * 1024 * 1024
 
 
 def is_supported(t: int, d: int, block_q: int = DEFAULT_BLOCK_Q,
@@ -200,8 +321,11 @@ def is_supported(t: int, d: int, block_q: int = DEFAULT_BLOCK_Q,
   bq, bk = min(block_q, t), min(block_k, t)
   return (0 < d <= 128 and d % 8 == 0 and
           t % bq == 0 and t % bk == 0 and
-          bq % 8 == 0 and bk % 8 == 0 and
-          t * d <= _MAX_T_TIMES_D)
+          bq % 8 == 0 and bk % 8 == 0)
+
+
+def _use_streamed(t: int, d: int) -> bool:
+  return t * d > _MAX_STAGED_T_TIMES_D
 
 
 def _check(q, block_q, block_k):
@@ -216,7 +340,7 @@ def _check(q, block_q, block_k):
   if not is_supported(t, d, block_q, block_k):
     raise ValueError(
         f'flash_attention unsupported for T={t}, D={d} '
-        f'(alignment or VMEM bound; see is_supported).')
+        f'(alignment; see is_supported).')
   return bq, bk
 
 
@@ -233,6 +357,33 @@ def flash_attention(q, k, v, causal: bool = False,
 def _flash_call(q, k, v, causal, bq, bk):
   bh, t, d = q.shape
   scale = 1.0 / np.sqrt(d)
+  if _use_streamed(t, d):
+    nk = t // bk
+    kern = functools.partial(_fwd_kernel_streamed, causal=causal,
+                             scale=scale, nk=nk)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, t // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, g, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, g, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
   kern = functools.partial(_fwd_kernel, bk=bk, causal=causal, scale=scale)
   return pl.pallas_call(
       kern,
@@ -270,6 +421,55 @@ def _flash_bwd(causal, block_q, block_k, res, g):
   bh = qr.shape[0]
   delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                   axis=-1)[:, None, :]  # [bh, 1, t]
+
+  if _use_streamed(t, d):
+    nk, nq = t // bk, t // bq
+    dq_kern = functools.partial(_dq_kernel_streamed, causal=causal,
+                                scale=scale, nk=nk)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, g, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, g, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, 0, j)),
+            pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, g: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qr.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(qr, kr, vr, do, lse, delta)
+
+    dkv_kern = functools.partial(_dkv_kernel_streamed, causal=causal,
+                                 scale=scale, nq=nq)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, g, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, g: (i, g, 0)),
+            pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, 0, g)),
+            pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, 0, g)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, g: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), kr.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), vr.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(qr, kr, vr, do, lse, delta)
+    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
+            _unfold_heads(dv, b, h))
 
   dq_kern = functools.partial(_dq_kernel, bk=bk, causal=causal, scale=scale)
   dq = pl.pallas_call(

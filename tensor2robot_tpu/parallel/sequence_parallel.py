@@ -136,12 +136,13 @@ def ulysses_attention(q: jax.Array,
 
   ql, kl, vl = to_headsharded(q), to_headsharded(k), to_headsharded(v)
   t, d = ql.shape[1], ql.shape[3]
-  from tensor2robot_tpu.ops import flash_attention as fa
+  from tensor2robot_tpu.ops.flash_attention import (flash_attention,
+                                                    is_supported)
 
-  if fa.is_supported(t, d):
+  if is_supported(t, d):
     # The full-sequence local attention is exactly the flash kernel's
     # job: O(T·D) HBM memory instead of the [B, H, T, T] logits tensor.
-    out = fa.flash_attention(ql, kl, vl, causal)
+    out = flash_attention(ql, kl, vl, causal)
   else:
     mask = (jnp.tril(jnp.ones((t, t), bool)) if causal else None)
     m0 = jnp.full(ql.shape[:1] + (ql.shape[2], t), -jnp.inf, jnp.float32)

@@ -59,6 +59,34 @@ def test_rejects_bad_shapes():
     flash_attention(q, k, v, False, 128, 128)
 
 
+@pytest.mark.parametrize('causal', [False, True])
+def test_streamed_variant_matches(monkeypatch, causal):
+  """Force the streamed (scratch-accumulator) kernels and re-verify
+  forward + gradients against the oracle."""
+  from tensor2robot_tpu.ops import flash_attention as fa
+
+  monkeypatch.setattr(fa, '_MAX_STAGED_T_TIMES_D', 1)
+  q, k, v = _qkv((2, 256, 2, 32), seed=3)
+  out = fa.flash_attention(q, k, v, causal, 64, 128)
+  ref = reference_attention(q, k, v, causal=causal)
+  np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+  ct = jnp.asarray(np.random.RandomState(4).randn(2, 256, 2, 32),
+                   jnp.float32)
+
+  def loss(fn):
+    return jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) * ct),
+        argnums=(0, 1, 2))
+
+  got = loss(lambda q, k, v: fa.flash_attention(q, k, v, causal, 64, 128))(
+      q, k, v)
+  ref_g = loss(lambda q, k, v: reference_attention(q, k, v, causal=causal))(
+      q, k, v)
+  for g, r in zip(got, ref_g):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4)
+
+
 def test_bf16_inputs():
   q, k, v = _qkv((1, 256, 2, 32), dtype=jnp.bfloat16)
   out = flash_attention(q, k, v, True, 128, 128)
