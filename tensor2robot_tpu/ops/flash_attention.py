@@ -44,6 +44,13 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/corr math
 def _use_interpret() -> bool:
   return jax.default_backend() == 'cpu'
 
+def _block_live(q0, bq, k0):
+  """Causal block-liveness: a key block starting at ``k0`` contributes to
+  a query block [q0, q0+bq) iff its first key is not past the last query
+  (the companion of _scores' per-element mask)."""
+  return q0 + bq - 1 >= k0
+
+
 def _scores(q, k, q0, k0, causal, scale=None):
   """Scaled (optional) masked q·kᵀ block scores; (q0, k0) are the global
   offsets of the blocks — THE shared definition of the causal mask and
@@ -137,7 +144,7 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
   # Causal: key blocks strictly above the diagonal contribute nothing.
-  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+  live = _block_live(qb * bq, bq, kb * bk) if causal else True
 
   @pl.when(live)
   def _():
@@ -168,7 +175,7 @@ def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   def _():
     dq_scr[...] = jnp.zeros_like(dq_scr)
 
-  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+  live = _block_live(qb * bq, bq, kb * bk) if causal else True
 
   @pl.when(live)
   def _():
@@ -200,7 +207,7 @@ def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_scr[...] = jnp.zeros_like(dk_scr)
     dv_scr[...] = jnp.zeros_like(dv_scr)
 
-  live = (qb * bq + bq - 1 >= kb * bk) if causal else True
+  live = _block_live(qb * bq, bq, kb * bk) if causal else True
 
   @pl.when(live)
   def _():

@@ -61,7 +61,8 @@ def ring_attention(q: jax.Array,
                    k: jax.Array,
                    v: jax.Array,
                    axis_name: str = SEQ_AXIS,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   kv_chunk: Optional[int] = None) -> jax.Array:
   """Blockwise ring attention; call INSIDE shard_map over ``axis_name``.
 
   Args:
@@ -69,6 +70,10 @@ def ring_attention(q: jax.Array,
       is the concatenation over the mesh axis.
     axis_name: the mesh axis the sequence is sharded over.
     causal: apply a causal mask over GLOBAL positions.
+    kv_chunk: process each hop's K/V in chunks of this many positions so
+      the per-hop logits tensor is [B, H, T_local, kv_chunk] instead of
+      [B, H, T_local, T_local] — the memory knob for long per-device
+      shards. Must divide ``T_local``; default = one chunk per hop.
 
   Returns:
     [B, T_local, H, D] attention output for the local query block.
@@ -76,6 +81,11 @@ def ring_attention(q: jax.Array,
   axis_size = jax.lax.psum(1, axis_name)
   my_index = jax.lax.axis_index(axis_name)
   batch, t_local, heads, dim = q.shape
+  chunk = t_local if kv_chunk is None else kv_chunk
+  if chunk <= 0 or t_local % chunk:
+    raise ValueError(
+        f'kv_chunk ({chunk}) must divide the local sequence ({t_local}).')
+  n_chunks = t_local // chunk
 
   m0 = jnp.full((batch, heads, t_local), -jnp.inf, jnp.float32)
   l0 = jnp.zeros((batch, heads, t_local), jnp.float32)
@@ -86,15 +96,25 @@ def ring_attention(q: jax.Array,
     m, l, o, k_blk, v_blk = carry
     # This hop's kv block originated on device (my_index - i) % axis_size.
     src = (my_index - i) % axis_size
-    if causal:
-      q_pos = my_index * t_local + jnp.arange(t_local)  # [Tq]
-      k_pos = src * t_local + jnp.arange(t_local)  # [Tk]
-      mask = q_pos[:, None] >= k_pos[None, :]
+
+    def chunk_step(c, inner):
+      m, l, o = inner
+      k_c = jax.lax.dynamic_slice_in_dim(k_blk, c * chunk, chunk, axis=1)
+      v_c = jax.lax.dynamic_slice_in_dim(v_blk, c * chunk, chunk, axis=1)
+      if causal:
+        q_pos = my_index * t_local + jnp.arange(t_local)  # [Tq]
+        k_pos = src * t_local + c * chunk + jnp.arange(chunk)  # [chunk]
+        mask = q_pos[:, None] >= k_pos[None, :]
+      else:
+        mask = None
+      return _block_attention(
+          q32, k_c.astype(jnp.float32), v_c.astype(jnp.float32), mask,
+          m, l, o)
+
+    if n_chunks == 1:  # unchunked hot path: no nested scan under grad
+      m, l, o = chunk_step(0, (m, l, o))
     else:
-      mask = None
-    m, l, o = _block_attention(
-        q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), mask,
-        m, l, o)
+      m, l, o = jax.lax.fori_loop(0, n_chunks, chunk_step, (m, l, o))
     # Rotate kv around the ring: device d sends to d+1 (next hop's block
     # on this device then originates one device further back).
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
@@ -170,9 +190,11 @@ def _sharded_apply(fn, mesh: Mesh, axis_name: str, causal: bool):
 
 def make_ring_attention(mesh: Mesh,
                         axis_name: str = SEQ_AXIS,
-                        causal: bool = False):
+                        causal: bool = False,
+                        kv_chunk: Optional[int] = None):
   """Jittable [B, T, H, D] → [B, T, H, D] ring attention over ``mesh``."""
-  return _sharded_apply(ring_attention, mesh, axis_name, causal)
+  fn = functools.partial(ring_attention, kv_chunk=kv_chunk)
+  return _sharded_apply(fn, mesh, axis_name, causal)
 
 
 def make_ulysses_attention(mesh: Mesh,

@@ -53,6 +53,32 @@ class TestRingAttention:
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(expected), atol=2e-5)
 
+  @pytest.mark.parametrize('causal', [False, True])
+  def test_kv_chunked_hops_match(self, seq_mesh, causal):
+    """kv_chunk divides each hop's K/V: per-hop logits [.., T/n, chunk]
+    instead of [.., T/n, T/n]; numerics and grads must be unchanged."""
+    q, k, v = _qkv(t=32, seed=7)  # T_local = 8, chunk = 4 → 2 chunks/hop
+    ring = jax.jit(make_ring_attention(seq_mesh, causal=causal, kv_chunk=4))
+    out = ring(q, k, v)
+    expected = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5)
+
+    grads = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    ref_grads = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+      np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+  def test_kv_chunk_must_divide(self, seq_mesh):
+    q, k, v = _qkv(t=32)
+    with pytest.raises(Exception, match='divide'):
+      jax.jit(make_ring_attention(seq_mesh, kv_chunk=3))(q, k, v)
+
   def test_grads_flow(self, seq_mesh):
     q, k, v = _qkv(t=16, seed=5)
     ring = make_ring_attention(seq_mesh, causal=True)
