@@ -102,6 +102,7 @@ def register() -> None:
   reg(run_meta_env_fn, 'run_meta_env')
   reg(dql_grasping_lib.run_env, 'run_env')
   reg(pose_env_lib.PoseToyEnv, 'PoseToyEnv')
+  reg(pose_env_lib.PoseEnvRandomPolicy, 'PoseEnvRandomPolicy')
   reg(pose_env_lib.PoseEnvRegressionModel, 'PoseEnvRegressionModel')
   reg(pose_env_lib.PoseEnvContinuousMCModel, 'PoseEnvContinuousMCModel')
   reg(pose_env_lib.PoseEnvRegressionModelMAML, 'PoseEnvRegressionModelMAML')

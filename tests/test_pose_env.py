@@ -113,6 +113,38 @@ class TestPoseEnvData:
     np.testing.assert_allclose(labels['reward'][0, 0], rew, rtol=1e-5)
 
 
+class TestRandomCollectBinary:
+
+  def test_run_collect_eval_with_random_collect_config(self, tmp_path):
+    """The robot-side binary end-to-end: gin config → random policy →
+    env episodes → transition tfrecords on disk → parseable by the
+    training input generator (ref run_random_collect.gin)."""
+    from tensor2robot_tpu import config as t2r_config
+    from tensor2robot_tpu.bin import run_collect_eval
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = os.path.join(repo, 'tensor2robot_tpu', 'research', 'pose_env',
+                          'configs', 'run_random_collect.gin')
+    t2r_config.clear_config()
+    try:
+      run_collect_eval.main([
+          '--gin_configs', config,
+          '--gin_bindings', 'run_meta_env.num_tasks = 2',
+          '--gin_bindings', 'run_meta_env.num_episodes_per_adaptation = 1',
+          '--root_dir', str(tmp_path),
+      ])
+    finally:
+      t2r_config.clear_config()
+    records = glob.glob(str(tmp_path / 'policy_collect' / '*.tfrecord*'))
+    assert records, list(tmp_path.rglob('*'))
+    model = PoseEnvRegressionModel(device_type='cpu')
+    gen = DefaultRecordInputGenerator(
+        file_patterns=records[0], batch_size=1)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(gen.create_iterator(ModeKeys.TRAIN))
+    assert labels['reward'].shape == (1, 1)
+
+
 class TestPoseEnvModels:
 
   def test_regression_fixture_smoke(self, tmp_path):

@@ -160,7 +160,15 @@ class TestWorkloadConfigs:
     t2r_config.register_framework_configurables()
     t2r_config.clear_config()
     t2r_config.parse_config_files_and_bindings(config_files=[config_path])
-    model_ref = t2r_config.query_parameter('train_eval_model.model')
-    model = model_ref.resolve()
-    assert hasattr(model, 'get_feature_specification')
+    try:
+      model_ref = t2r_config.query_parameter('train_eval_model.model')
+    except t2r_config.ConfigError:
+      # Collect/eval configs wire a policy + env instead of a model.
+      policy_ref = t2r_config.query_parameter(
+          'collect_eval_loop.policy_class')
+      policy = policy_ref.resolve()
+      assert hasattr(policy, 'sample_action'), policy
+    else:
+      model = model_ref.resolve()
+      assert hasattr(model, 'get_feature_specification')
     t2r_config.clear_config()
