@@ -50,6 +50,96 @@ def _step_flops(step_fn, *args) -> float:
     return 0.0
 
 
+def bench_flash_attention():
+  """flash vs XLA attention at [2, 4096, 8, 64] bf16 — emits JSON lines.
+
+  Driver-verifiable replacement for the PERF_NOTES prose (round-2
+  verdict #3): trace-measured device ms for forward and fwd+bwd, both
+  kernels, plus the speedup. TPU only (interpret mode at T=4096 is not
+  meaningful).
+  """
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from tensor2robot_tpu.ops.flash_attention import flash_attention
+  from tensor2robot_tpu.parallel.sequence_parallel import (
+      reference_attention)
+  from tools.trace_profile import device_ms_per_iter
+
+  rng = np.random.RandomState(0)
+  q, k, v = (jnp.asarray(rng.randn(2, 4096, 8, 64), jnp.bfloat16)
+             for _ in range(3))
+
+  def timed(fn, grad):
+    if grad:
+      base = lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+      target = jax.jit(jax.grad(base, argnums=(0, 1, 2)))
+    else:
+      target = jax.jit(fn)
+    ms, _ = device_ms_per_iter(target, (q, k, v), n=10)
+    return ms
+
+  for causal in (False, True):
+    fa = lambda q, k, v: flash_attention(q, k, v, causal)
+    ref = lambda q, k, v: reference_attention(q, k, v, causal=causal)
+    for grad, tag in ((False, 'fwd'), (True, 'fwdbwd')):
+      flash_ms = timed(fa, grad)
+      xla_ms = timed(ref, grad)
+      print(json.dumps({
+          'metric': f'flash_attention_{tag}{"_causal" if causal else ""}_ms',
+          'value': round(flash_ms, 3),
+          'unit': 'ms',
+          'shape': [2, 4096, 8, 64],
+          'xla_ms': round(xla_ms, 3),
+          'speedup': round(xla_ms / flash_ms, 2) if flash_ms else 0.0,
+      }))
+
+
+def bench_native_reader():
+  """Native interleave-reader throughput on generated shards — JSON line."""
+  import os
+  import shutil
+  import tempfile
+
+  from tensor2robot_tpu.data import native_io
+
+  if not native_io.available():
+    print(json.dumps({'metric': 'native_reader_gbps', 'value': None,
+                      'unit': 'GB/s', 'note': 'native lib unavailable'}))
+    return
+  tmp = tempfile.mkdtemp(prefix='t2r_bench_io_')
+  try:
+    record = os.urandom(50 * 1024)
+    paths = []
+    shards, per_shard = 8, 1280  # 8 × 64 MB: enough to reach steady state
+    for s in range(shards):
+      path = os.path.join(tmp, f'shard{s}.tfrecord')
+      with native_io.NativeRecordWriter(path) as w:
+        for _ in range(per_shard):
+          w.write(record)
+      paths.append(path)
+    total_bytes = shards * per_shard * len(record)
+    # Warm the page cache so the number measures the reader, not disk.
+    for p in paths:
+      with open(p, 'rb') as f:
+        f.read()
+    t0 = time.perf_counter()
+    n = 0
+    with native_io.NativeInterleaveReader(paths, cycle_length=8) as reader:
+      for _ in reader:
+        n += 1
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        'metric': 'native_reader_gbps',
+        'value': round(total_bytes / dt / 1e9, 3),
+        'unit': 'GB/s',
+        'records': n,
+    }))
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
   import jax
 
@@ -145,6 +235,23 @@ def main():
     except Exception:
       pass
   vs_baseline = (steps_per_sec / baseline) if baseline else 1.0
+
+  # Suite lines (round-2 verdict #3: driver-verifiable flash + native-IO
+  # numbers). Best-effort: never let them break the headline line, which
+  # must stay LAST.
+  try:
+    bench_native_reader()
+  except Exception as e:
+    print(json.dumps({'metric': 'native_reader_gbps', 'error': repr(e)[:200]}))
+  # Strictly TPU (not merely non-cpu): any other backend would run the
+  # T=4096 kernels in Pallas interpret mode — meaningless and glacial.
+  if jax.default_backend() == 'tpu':
+    try:
+      bench_flash_attention()
+    except Exception as e:
+      print(json.dumps({'metric': 'flash_attention_suite',
+                        'error': repr(e)[:200]}))
+
   print(json.dumps({
       'metric': metric,
       'value': round(steps_per_sec, 3),

@@ -45,19 +45,22 @@ def main(argv=None):
   if not isinstance(model_dir, str):
     model_dir = None
 
-  def save_config(text):
+  def save_config(text, filename):
     if not model_dir or '://' in model_dir:
       return
     os.makedirs(model_dir, exist_ok=True)
-    with open(os.path.join(model_dir, 'operative_config-0.gin'), 'w') as f:
+    with open(os.path.join(model_dir, filename), 'w') as f:
       f.write(text)
 
-  save_config(t2r_config.config_str())
+  # The startup snapshot is the FULL parsed config (the run may crash
+  # before an operative config exists) — named distinctly so
+  # operative_config-0.gin never misrepresents un-consumed bindings.
+  save_config(t2r_config.config_str(), 'config-0.gin')
   train_eval_model = t2r_config.get_configurable('train_eval_model')
   result = train_eval_model()
   operative = t2r_config.operative_config_str()
   logging.info('Operative config:\n%s', operative)
-  save_config(operative)
+  save_config(operative, 'operative_config-0.gin')
   return result
 
 

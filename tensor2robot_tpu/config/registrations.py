@@ -124,3 +124,5 @@ def register() -> None:
   reg(vrgripper_lib.VRGripperEnvTecModel, 'VRGripperEnvTecModel')
   reg(vrgripper_lib.VRGripperEnvSequentialModel,
       'VRGripperEnvSequentialModel')
+  reg(vrgripper_lib.VRGripperEnvLongHorizonModel,
+      'VRGripperEnvLongHorizonModel')

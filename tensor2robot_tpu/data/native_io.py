@@ -262,7 +262,7 @@ class NativeExampleParser:
       pass
 
 
-def _decode_image(raw: bytes, spec):
+def _decode_image(raw: bytes, spec, key=None):
   """PIL image decode with the codec's empty-bytes→zeros convention."""
   import numpy as np
 
@@ -276,6 +276,13 @@ def _decode_image(raw: bytes, spec):
   arr = np.asarray(PIL.Image.open(io.BytesIO(raw)))
   if arr.ndim == 2:
     arr = arr[..., None]
+  if arr.shape != shape:
+    # Validate against the spec like the TF codec path does — a stray
+    # resolution must fail here, by name, not as a np.stack shape error
+    # (or silently mis-shaped features) downstream.
+    raise ValueError(
+        f'Decoded image for feature {key or spec.name!r} has shape '
+        f'{arr.shape}, but the spec declares {shape}.')
   return arr.astype(spec.dtype)
 
 
@@ -316,7 +323,8 @@ def make_native_parse_fn(feature_spec, label_spec=None):
       value = parsed[out_key]
       if isinstance(value, list):  # bytes feature
         if getattr(spec, 'is_encoded_image', False):
-          value = np.stack([_decode_image(raw, spec) for raw in value])
+          value = np.stack(
+              [_decode_image(raw, spec, key=out_key[2:]) for raw in value])
           if len(spec.shape) > 3:  # singleton leading image dims
             value = value.reshape(value.shape[:1] + tuple(spec.shape))
         else:  # plain string: pass through undecoded (TF-codec parity)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import logging
 import os
 import shutil
 import struct
@@ -109,8 +110,12 @@ def serialize_serving_fn(model, serving_variables,
   try:
     exported = jax_export.export(
         jax.jit(serving_fn), platforms=platforms)(var_args, feature_args)
-  except Exception:
+  except Exception as e:
     # Some lowering rules are platform-gated; fall back to the current one.
+    logging.warning(
+        'Multi-platform serving export (platforms=%s) failed; retrying for '
+        'the current backend only — the artifact will NOT be portable '
+        'across platforms. Original error: %r', platforms, e)
     exported = jax_export.export(jax.jit(serving_fn))(var_args, feature_args)
   return exported.serialize()
 
@@ -262,13 +267,23 @@ class ModelExporter:
         with open(os.path.join(tmp_dir, SERVING_FN_FILENAME), 'wb') as f:
           f.write(data)
         serving_fn_ok = True
-      except Exception:
-        pass  # model-class fallback path still works
+      except Exception as e:
+        # The model-class-import fallback still works, but the export is
+        # no longer the self-contained artifact the serving contract
+        # advertises (README §Serving contract) — say so loudly.
+        logging.warning(
+            'Self-contained StableHLO serving export FAILED for %s; the '
+            'export degrades to the model-class fallback (predictors must '
+            'import %s.%s). Recorded as self_contained_serving_fn=false in '
+            'export_meta.json. Error: %r',
+            type(model).__name__, type(model).__module__,
+            type(model).__qualname__, e)
       try:
         write_warmup_requests(
             tmp_dir, model, batch_size=self._warmup_batch_size)
-      except Exception:
-        pass  # warmup is best-effort; never abort the export for it
+      except Exception as e:
+        # Warmup is best-effort; never abort the export for it.
+        logging.warning('Warmup request generation failed: %r', e)
 
     # 4. Reconstruction metadata.
     meta = {

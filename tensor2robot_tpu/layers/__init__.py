@@ -19,6 +19,7 @@ from tensor2robot_tpu.layers.snail import (
     AttentionBlock,
     CausalConv,
     DenseBlock,
+    MultiHeadAttentionBlock,
     TCBlock,
     causally_masked_softmax,
 )

@@ -9,7 +9,7 @@ decomposition.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -73,21 +73,122 @@ def causally_masked_softmax(logits: jnp.ndarray) -> jnp.ndarray:
   return nn.softmax(logits, axis=-1)
 
 
+def _flash_pad_dim(key_size: int, value_size: int) -> int:
+  """Shared head dim for the flash kernels: max(dk, dv) rounded up to 8."""
+  d = max(key_size, value_size)
+  return -(-d // 8) * 8
+
+
+def flash_supported(t: int, key_size: int, value_size: int) -> bool:
+  """Whether the flash path can serve an AttentionBlock problem."""
+  from tensor2robot_tpu.ops import flash_attention as fa
+
+  return fa.is_supported(t, _flash_pad_dim(key_size, value_size))
+
+
+def _flash_causal_read(query: jnp.ndarray, key: jnp.ndarray,
+                       values: jnp.ndarray) -> jnp.ndarray:
+  """Causal attention read via the Pallas flash kernels, O(T·D) memory.
+
+  q/k ([B, T, dk]) and v ([B, T, dv]) are zero-padded to one 8-aligned
+  head dim (zero pads contribute nothing to q·kᵀ or the read), and q is
+  pre-scaled so the kernel's 1/√d_pad matches the SNAIL 1/√dk logits.
+  """
+  from tensor2robot_tpu.ops import flash_attention as fa
+
+  dk, dv = query.shape[-1], values.shape[-1]
+  d = _flash_pad_dim(dk, dv)
+  query = query * np.sqrt(d / dk)
+
+  def pad(x):
+    need = d - x.shape[-1]
+    if need:
+      x = jnp.pad(x, ((0, 0), (0, 0), (0, need)))
+    return x[:, :, None, :]  # single head: [B, T, 1, d]
+
+  out = fa.flash_attention(pad(query), pad(key), pad(values), causal=True)
+  return out[:, :, 0, :dv]
+
+
 class AttentionBlock(nn.Module):
   """Causal single-head attention, read concatenated (snail.py:119-152).
 
-  Returns ([B, T, C + value_size], {'attn_prob': [B, T, T]}).
+  Returns ``([B, T, C + value_size], end_points)``. By default the block
+  dispatches to the Pallas flash-attention kernels whenever the problem
+  is supported (:func:`flash_supported`) — O(T·D) memory, no [B, T, T]
+  materialization — and ``end_points`` is empty. Setting
+  ``return_prob=True`` requests the ``{'attn_prob': [B, T, T]}`` tensor,
+  which forces the dense O(T²) path (that tensor IS the quadratic cost).
+  ``use_flash`` overrides the auto dispatch either way.
   """
 
   key_size: int
   value_size: int
+  return_prob: bool = False
+  use_flash: Optional[bool] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, dict]:
     key = nn.Dense(self.key_size)(x)
     query = nn.Dense(self.key_size)(x)
+    values = nn.Dense(self.value_size)(x)
+    t = x.shape[1]
+    use_flash = self.use_flash
+    if use_flash is None:
+      use_flash = (not self.return_prob and
+                   flash_supported(t, self.key_size, self.value_size))
+    if use_flash:
+      if self.return_prob:
+        raise ValueError(
+            'return_prob=True requires the dense path (the [B, T, T] '
+            'probability tensor is what flash attention avoids); do not '
+            'combine it with use_flash=True.')
+      read = _flash_causal_read(query, key, values)
+      return jnp.concatenate([x, read], axis=2), {}
     logits = jnp.einsum('btk,bsk->bts', query, key)
     probs = causally_masked_softmax(logits / np.sqrt(self.key_size))
-    values = nn.Dense(self.value_size)(x)
     read = jnp.einsum('bts,bsv->btv', probs, values)
-    return jnp.concatenate([x, read], axis=2), {'attn_prob': probs}
+    end_points = {'attn_prob': probs} if self.return_prob else {}
+    return jnp.concatenate([x, read], axis=2), end_points
+
+
+class MultiHeadAttentionBlock(nn.Module):
+  """Causal multi-head SNAIL attention for long-horizon sequences.
+
+  The scaling generalization of :class:`AttentionBlock`: H heads of size
+  D let the read be computed by the Pallas flash kernels AND sharded over
+  a ``seq`` mesh axis — ``attention_fn`` (a
+  ``sequence_parallel.make_ring_attention`` /
+  ``make_ulysses_attention`` product built for the trainer's mesh, causal
+  pre-bound) takes precedence; otherwise flash when supported; otherwise
+  the dense oracle. Returns ``([B, T, C + H·D], {})`` — the read is the
+  concatenated heads, matching the single-head block's read-concat form.
+  """
+
+  num_heads: int
+  head_size: int
+  attention_fn: Optional[Callable] = None
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, dict]:
+    b, t = x.shape[:2]
+    h, d = self.num_heads, self.head_size
+
+    def heads(name):
+      return nn.Dense(h * d, name=name)(x).reshape(b, t, h, d)
+
+    query, key, values = heads('query'), heads('key'), heads('value')
+    if self.attention_fn is not None:
+      out = self.attention_fn(query, key, values)
+    else:
+      from tensor2robot_tpu.ops import flash_attention as fa
+
+      if fa.is_supported(t, d):
+        out = fa.flash_attention(query, key, values, causal=True)
+      else:
+        from tensor2robot_tpu.parallel.sequence_parallel import (
+            reference_attention)
+
+        out = reference_attention(query, key, values, causal=True)
+    read = out.reshape(b, t, h * d)
+    return jnp.concatenate([x, read], axis=2), {}

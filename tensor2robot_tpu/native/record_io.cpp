@@ -108,11 +108,19 @@ struct Reader {
       error = "corrupted record length (crc mismatch)";
       return -1;
     }
-    if (len > (1ull << 40)) {
+    // With CRC verification off, a corrupt length field is caught only
+    // here: cap at 1 GiB (far above any real tf.Example) and never let
+    // resize() throw across the extern "C"/ctypes boundary.
+    if (len > (1ull << 30)) {
       error = "implausible record length";
       return -1;
     }
-    current.resize(len);
+    try {
+      current.resize(len);
+    } catch (const std::exception& e) {
+      error = std::string("record allocation failed: ") + e.what();
+      return -1;
+    }
     if (len && fread(&current[0], 1, len, f) != len) {
       error = "truncated record payload";
       return -1;

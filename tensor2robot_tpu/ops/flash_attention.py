@@ -42,7 +42,10 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/corr math
 
 
 def _use_interpret() -> bool:
-  return jax.default_backend() == 'cpu'
+  # Interpret everywhere Mosaic can't lower (cpu, gpu, ...), not just cpu:
+  # the framework is TPU-first, but the kernels must not hard-fail on
+  # other hosts.
+  return jax.default_backend() != 'tpu'
 
 def _block_live(q0, bq, k0):
   """Causal block-liveness: a key block starting at ``k0`` contributes to
@@ -312,10 +315,11 @@ def _unfold_heads(x, b, h):
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
-# Whole-sequence K/V staging fits VMEM up to 2·t·d·2B ≤ ~8 MB of the
-# ~16 MB; beyond it the streamed kernels (K/V blocks as an inner grid
-# dim, scratch accumulators) take over, bounded only by HBM.
-_MAX_STAGED_T_TIMES_D = 2 * 1024 * 1024
+# Whole-sequence K/V staging fits VMEM up to 2·t·d·itemsize ≤ ~8 MB of
+# the ~16 MB; beyond it the streamed kernels (K/V blocks as an inner grid
+# dim, scratch accumulators) take over, bounded only by HBM. A byte (not
+# element) budget: float32 q/k/v halves the staged-T range vs bfloat16.
+_MAX_STAGED_KV_BYTES = 8 * 1024 * 1024
 
 
 def is_supported(t: int, d: int, block_q: int = DEFAULT_BLOCK_Q,
@@ -331,8 +335,8 @@ def is_supported(t: int, d: int, block_q: int = DEFAULT_BLOCK_Q,
           bq % 8 == 0 and bk % 8 == 0)
 
 
-def _use_streamed(t: int, d: int) -> bool:
-  return t * d > _MAX_STAGED_T_TIMES_D
+def _use_streamed(t: int, d: int, itemsize: int = 2) -> bool:
+  return 2 * t * d * itemsize > _MAX_STAGED_KV_BYTES
 
 
 def _check(q, block_q, block_k):
@@ -364,7 +368,7 @@ def flash_attention(q, k, v, causal: bool = False,
 def _flash_call(q, k, v, causal, bq, bk):
   bh, t, d = q.shape
   scale = 1.0 / np.sqrt(d)
-  if _use_streamed(t, d):
+  if _use_streamed(t, d, q.dtype.itemsize):
     nk = t // bk
     kern = functools.partial(_fwd_kernel_streamed, causal=causal,
                              scale=scale, nk=nk)
@@ -429,7 +433,7 @@ def _flash_bwd(causal, block_q, block_k, res, g):
   delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                   axis=-1)[:, None, :]  # [bh, 1, t]
 
-  if _use_streamed(t, d):
+  if _use_streamed(t, d, qr.dtype.itemsize):
     nk, nq = t // bk, t // bq
     dq_kern = functools.partial(_dq_kernel_streamed, causal=causal,
                                 scale=scale, nk=nk)

@@ -172,6 +172,9 @@ def fsdp_param_sharding(mesh: Mesh, param) -> NamedSharding:
   return NamedSharding(mesh, P(*spec))
 
 
+REPLICATED = 'replicated'
+
+
 def rule_param_sharding(mesh: Mesh, path: str, param,
                         rules) -> Optional[NamedSharding]:
   """First matching (regex, spec) rule → NamedSharding, else None.
@@ -182,7 +185,11 @@ def rule_param_sharding(mesh: Mesh, path: str, param,
   ``(r'fcgrasp/kernel', (None, 'model'))`` column-shards a Dense kernel
   over the tensor-parallel axis (Megatron-style). Axes absent from the
   mesh or not dividing the dim are dropped (replicated on that dim), so
-  one rule set serves every mesh layout.
+  one rule set serves every mesh layout. A rule naming the same mesh axis
+  on two dims is rejected up front (JAX's own error at jit time is
+  opaque). ``spec`` may also be the sentinel string ``'replicated'`` to
+  pin the param fully replicated — distinct from an all-None tuple, which
+  (like a fully degenerated rule) falls through to the default fsdp rule.
   """
   import re
 
@@ -190,8 +197,20 @@ def rule_param_sharding(mesh: Mesh, path: str, param,
   for pattern, spec in rules:
     if re.search(pattern, path) is None:
       continue
+    if isinstance(spec, str):
+      if spec != REPLICATED:
+        raise ValueError(
+            f'Unknown sharding-rule sentinel {spec!r} for pattern '
+            f'{pattern!r}; the only string spec is {REPLICATED!r}.')
+      return replicated(mesh)
     if len(spec) != len(shape):
       continue
+    named = [a for a in spec if a is not None]
+    if len(named) != len(set(named)):
+      raise ValueError(
+          f'Sharding rule {pattern!r} names mesh axis more than once in '
+          f'spec {spec!r} (param {path!r}); each mesh axis may shard at '
+          'most one dimension.')
     fixed = []
     for dim, axis in zip(shape, spec):
       if (axis is None or axis not in mesh.axis_names or

@@ -1,0 +1,73 @@
+"""Reusable actor/critic merge helpers for grasping convnets.
+
+Capability-equivalent of
+``/root/reference/research/dql_grasping_lib/tf_modules.py:28-97``: the
+CEM-megabatch context helpers that merge a conv feature map with a batch
+of per-sample action contexts. Pure ``jnp`` functions — no graph scopes.
+
+The reference's third export, ``argscope`` (``tf_modules.py:28-46``), is
+a tf-slim global-defaults mechanism (truncated-normal init, relu,
+layer-norm, stride-2 VALID convs) with no idiomatic JAX equivalent:
+Flax modules take their init/normalizer/stride as explicit constructor
+arguments, and the grasping towers in
+:mod:`tensor2robot_tpu.research.qtopt.networks` declare exactly those
+defaults inline where the reference would have pulled them from the
+scope. :func:`conv_defaults` records the same defaults as plain kwargs
+for modules that want them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def conv_defaults(stddev: float = 0.01) -> Dict:
+  """The reference argscope's conv/fc defaults, as explicit Flax kwargs.
+
+  ``tf_modules.py:38-46``: truncated-normal(0.01) weight init; stride-2
+  VALID convs (the activation/normalizer are applied by the caller, as
+  everywhere in this framework's explicit module style).
+  """
+  return {
+      'kernel_init': nn.initializers.truncated_normal(stddev=stddev),
+      'strides': (2, 2),
+      'padding': 'VALID',
+  }
+
+
+def tile_to_match_context(net: jnp.ndarray,
+                          context: jnp.ndarray) -> jnp.ndarray:
+  """Tiles ``net`` along a new axis=1 to match ``context``.
+
+  ``tf_modules.py:49-71``: each minibatch element of ``net``
+  ([B, ...]) is repeated to pair with that element's ``num_examples``
+  context rows ([B, num_examples, C]) → [B, num_examples, ...].
+  """
+  num_samples = context.shape[1]
+  net_examples = jnp.expand_dims(net, 1)
+  reps = [1] * net_examples.ndim
+  reps[1] = num_samples
+  return jnp.tile(net_examples, reps)
+
+
+def add_context(net: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+  """Merges a conv feature map with per-sample contexts by addition.
+
+  ``tf_modules.py:74-97``: ``net`` [B, H, W, C] feature maps meet
+  ``context`` [B·num_examples, C] action embeddings (the CEM megabatch
+  layout); each context vector is broadcast across the H, W extent and
+  added → [B·num_examples, H, W, C].
+  """
+  b, h, w, d1 = net.shape
+  d2 = context.shape[-1]
+  if d1 != d2:
+    raise ValueError(
+        f'net channels ({d1}) must equal context size ({d2}).')
+  context = context.reshape(b, -1, d2)
+  net_examples = tile_to_match_context(net, context)  # [B, N, H, W, C]
+  net_flat = net_examples.reshape(-1, h, w, d1)
+  context_flat = context.reshape(-1, 1, 1, d2)
+  return net_flat + context_flat

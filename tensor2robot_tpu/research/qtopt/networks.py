@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 GRASP_PARAM_SIZES = {
@@ -48,15 +49,69 @@ class _ConvBN(nn.Module):
 
   @nn.compact
   def __call__(self, x, train: bool):
+    # No conv bias: BatchNorm's mean subtraction cancels it exactly, so
+    # it is a dead parameter whose (identically zero) gradient still
+    # costs a full reduction over the activation. The reference does the
+    # same: slim omits biases when a normalizer_fn is configured
+    # (dql_grasping_lib/tf_modules.py:38-46 argscope).
     x = nn.Conv(
         self.features, (self.kernel, self.kernel),
         strides=(self.strides, self.strides), padding=self.padding,
-        dtype=self.dtype,
+        dtype=self.dtype, use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01))(x)
     x = nn.BatchNorm(
         use_running_average=not train, momentum=self.decay,
         epsilon=self.epsilon, use_scale=True, dtype=self.dtype)(x)
     return nn.relu(x)
+
+
+class _PooledBatchNormRelu(nn.Module):
+  """BatchNorm(+bias)+relu applied AFTER a max pool, statistics BEFORE.
+
+  Exact algebraic rewrite of ``max_pool(relu(batch_norm(x)))`` for a
+  batch norm without scale: the per-channel normalize ``(x-μ)/σ + β``
+  is strictly increasing (1/σ > 0) and relu is monotonic, so both
+  commute with max pooling — ``pool(relu(bn(x))) == relu(bn(pool(x)))``
+  with μ, σ still computed over the FULL pre-pool tensor (identical
+  train/eval numerics, gradients included: it is the same function).
+
+  Why: profiled on v5e, the conv1-region BN apply/backward chains moved
+  456 MB per pass over the [32,236,236,64] activation at 2.2–2.5× their
+  bandwidth bound (see PERF_NOTES.md); applying the normalize after the
+  3×3/s3 pool shrinks those passes 9×. Variable layout matches
+  ``nn.BatchNorm(use_scale=False)`` (params/bias,
+  batch_stats/{mean,var}) so checkpoints interchange.
+  """
+
+  momentum: float = 0.9997
+  epsilon: float = 0.001
+  dtype: Optional[jnp.dtype] = None
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray, pooled: jnp.ndarray,
+               train: bool) -> jnp.ndarray:
+    features = x.shape[-1]
+    ra_mean = self.variable('batch_stats', 'mean',
+                            lambda: jnp.zeros((features,), jnp.float32))
+    ra_var = self.variable('batch_stats', 'var',
+                           lambda: jnp.ones((features,), jnp.float32))
+    bias = self.param('bias', nn.initializers.zeros, (features,),
+                      jnp.float32)
+    if train:
+      xf = x.astype(jnp.float32)
+      mean = jnp.mean(xf, axis=(0, 1, 2))
+      mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+      var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+      if not self.is_initializing():
+        ra_mean.value = (self.momentum * ra_mean.value +
+                         (1.0 - self.momentum) * mean)
+        ra_var.value = (self.momentum * ra_var.value +
+                        (1.0 - self.momentum) * var)
+    else:
+      mean, var = ra_mean.value, ra_var.value
+    inv = jax.lax.rsqrt(var + self.epsilon)
+    y = (pooled.astype(jnp.float32) - mean) * inv + bias
+    return nn.relu(y).astype(pooled.dtype)
 
 
 class Grasping44(nn.Module):
@@ -98,12 +153,19 @@ class Grasping44(nn.Module):
           dtype=self.dtype)(x)
 
     # --- image tower (networks.py:450-470)
+    # use_bias=False: the following BatchNorm cancels any conv bias (see
+    # _ConvBN); its gradient alone was a 456 MB reduction per step.
     net = nn.Conv(
         64, (6, 6), strides=(2, 2), padding='SAME', dtype=self.dtype,
+        use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name='conv1_1')(images)
-    net = nn.relu(bn(net))
-    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    # pool-then-normalize: exact rewrite of relu(bn) → pool (stats still
+    # from the full 236×236 activation); see _PooledBatchNormRelu.
+    pooled = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    net = _PooledBatchNormRelu(
+        momentum=self.batch_norm_decay, epsilon=self.batch_norm_epsilon,
+        dtype=self.dtype, name='bn1')(net, pooled, train)
     for l in range(2, 2 + self.num_convs[0]):
       net = _ConvBN(64, 5, dtype=self.dtype, name=f'conv{l}')(net, train)
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
@@ -111,7 +173,7 @@ class Grasping44(nn.Module):
 
     # --- grasp-param embedding (networks.py:476-518)
     fcgrasp = nn.Dense(
-        256, dtype=self.dtype,
+        256, dtype=self.dtype, use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         name='fcgrasp')(grasp_params)
     fcgrasp = nn.relu(bn(fcgrasp))
@@ -145,7 +207,7 @@ class Grasping44(nn.Module):
     net = net.reshape((net.shape[0], -1))
     for l in range(self.hid_layers):
       net = nn.Dense(
-          64, dtype=self.dtype,
+          64, dtype=self.dtype, use_bias=False,
           kernel_init=nn.initializers.truncated_normal(stddev=0.01),
           name=f'fc{l}')(net)
       net = nn.relu(bn(net, scale=True))

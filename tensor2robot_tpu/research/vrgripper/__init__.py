@@ -24,6 +24,7 @@ from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
     make_fixed_length,
 )
 from tensor2robot_tpu.research.vrgripper.vrgripper_env_meta_models import (
+    VRGripperEnvLongHorizonModel,
     VRGripperEnvRegressionModelMAML,
     VRGripperEnvSequentialModel,
     VRGripperEnvTecModel,

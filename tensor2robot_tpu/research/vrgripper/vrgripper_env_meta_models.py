@@ -388,6 +388,10 @@ class _SnailSequenceNet(nn.Module):
   num_outputs: int
   sequence_length: int
   filters: int = 32
+  # Diagnostics only: materializing [B, T, T] probabilities forces the
+  # attention blocks onto the dense O(T²) path; the default leaves them
+  # free to dispatch to the Pallas flash kernels (layers/snail.py).
+  return_attention_probs: bool = False
 
   @nn.compact
   def __call__(self, images, aux_input, train: bool = False):
@@ -404,14 +408,17 @@ class _SnailSequenceNet(nn.Module):
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc1')(net)
     net, attn1 = snail.AttentionBlock(
-        key_size=64, value_size=self.filters, name='attn1')(net)
-    end_points['attn_probs/0'] = attn1['attn_prob']
+        key_size=64, value_size=self.filters,
+        return_prob=self.return_attention_probs, name='attn1')(net)
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc2')(net)
     net, attn2 = snail.AttentionBlock(
-        key_size=64, value_size=self.filters, name='attn2')(net)
-    end_points['attn_probs/1'] = attn2['attn_prob']
+        key_size=64, value_size=self.filters,
+        return_prob=self.return_attention_probs, name='attn2')(net)
+    if self.return_attention_probs:
+      end_points['attn_probs/0'] = attn1['attn_prob']
+      end_points['attn_probs/1'] = attn2['attn_prob']
     poses = nn.Dense(self.num_outputs, name='out')(net)
     return poses, end_points
 
@@ -427,10 +434,12 @@ class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
   def __init__(self,
                condition_gripper_pose: bool = False,
                greedy_action: bool = False,
+               return_attention_probs: bool = False,
                **kwargs):
     super().__init__(**kwargs)
     self._condition_gripper_pose = condition_gripper_pose
     self._greedy_action = greedy_action
+    self._return_attention_probs = return_attention_probs
 
   def create_module(self) -> _SnailSequenceNet:
     output_size = self._num_waypoints * self._action_size
@@ -440,7 +449,8 @@ class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
     else:
       num_outputs = output_size
     return _SnailSequenceNet(
-        num_outputs=num_outputs, sequence_length=2 * self._episode_length)
+        num_outputs=num_outputs, sequence_length=2 * self._episode_length,
+        return_attention_probs=self._return_attention_probs)
 
   def _sequence_inputs(self, features):
     """Concatenates condition and inference episode 0 across time.
@@ -522,3 +532,123 @@ class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
         np_features[full_key][0, :timestep] = (
             current_episode_data[full_key][0, :timestep])
     return np_features
+
+
+# ----------------------------------------------------------- long horizon
+
+
+class _LongHorizonSnailNet(nn.Module):
+  """SNAIL stack with multi-head attention for long (sharded) sequences.
+
+  Same skeleton as :class:`_SnailSequenceNet`, but the attention blocks
+  are :class:`~tensor2robot_tpu.layers.snail.MultiHeadAttentionBlock`:
+  flash kernels locally, and — when ``attention_fn`` is set — ring/
+  Ulysses sequence parallelism over the trainer mesh's ``seq`` axis.
+  """
+
+  num_outputs: int
+  sequence_length: int
+  filters: int = 32
+  num_heads: int = 8
+  head_size: int = 8
+  attention_fn: Optional[callable] = None
+
+  @nn.compact
+  def __call__(self, images, aux_input, train: bool = False):
+    b, t = images.shape[:2]
+    merged = images.reshape((-1,) + tuple(images.shape[2:]))
+    frame_features, _ = vision_layers.ImagesToFeaturesModel(
+        name='frame_features')(merged, train=train)
+    net = frame_features.reshape((b, t, -1))
+    net = jnp.concatenate([net, aux_input], axis=-1)
+    net = nn.Dense(64, name='in_proj')(net)
+    net = snail.TCBlock(
+        sequence_length=self.sequence_length, filters=self.filters,
+        name='tc1')(net)
+    net, _ = snail.MultiHeadAttentionBlock(
+        num_heads=self.num_heads, head_size=self.head_size,
+        attention_fn=self.attention_fn, name='attn1')(net)
+    net = snail.TCBlock(
+        sequence_length=self.sequence_length, filters=self.filters,
+        name='tc2')(net)
+    net, _ = snail.MultiHeadAttentionBlock(
+        num_heads=self.num_heads, head_size=self.head_size,
+        attention_fn=self.attention_fn, name='attn2')(net)
+    poses = nn.Dense(self.num_outputs, name='out')(net)
+    return poses, {}
+
+
+class VRGripperEnvLongHorizonModel(VRGripperEnvSequentialModel):
+  """Sequence-parallel SNAIL meta-learner: the long-context consumer.
+
+  Extends the reference's sequential model
+  (``vrgripper_env_meta_models.py:421-571``) past its ≤100-step episode
+  regime: the (condition ‖ inference) sequence is processed with
+  multi-head causal attention that (a) runs the Pallas flash kernels on
+  a single chip and (b) shards the sequence over the trainer mesh's
+  ``seq`` axis via Ulysses all-to-all (ring attention when the head
+  count doesn't divide) — the trainer calls :meth:`set_mesh` so the
+  module picks the layout that matches the run's mesh.
+
+  ``sequence_parallelism``: 'auto' (Ulysses when heads divide the seq
+  axis, else ring), 'ulysses', 'ring', or 'none' (single-device
+  attention even on a seq mesh).
+  """
+
+  def __init__(self,
+               num_attention_heads: int = 8,
+               attention_head_size: int = 8,
+               sequence_parallelism: str = 'auto',
+               **kwargs):
+    kwargs.setdefault('return_attention_probs', False)
+    if kwargs.pop('return_attention_probs'):
+      raise ValueError(
+          'VRGripperEnvLongHorizonModel never materializes [B, T, T] '
+          'attention probabilities (that tensor is what the long-horizon '
+          'path eliminates).')
+    super().__init__(**kwargs)
+    if sequence_parallelism not in ('auto', 'ulysses', 'ring', 'none'):
+      raise ValueError(
+          f'Unknown sequence_parallelism: {sequence_parallelism!r}')
+    self._num_attention_heads = num_attention_heads
+    self._attention_head_size = attention_head_size
+    self._sequence_parallelism = sequence_parallelism
+    self._mesh = None
+
+  def set_mesh(self, mesh) -> None:
+    """Trainer plumbing: the mesh the jitted step runs over."""
+    self._mesh = mesh
+
+  def _attention_fn(self):
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.parallel import sequence_parallel as sp
+
+    mesh = self._mesh
+    if (mesh is None or self._sequence_parallelism == 'none' or
+        mesh.shape.get(mesh_lib.SEQ_AXIS, 1) <= 1):
+      return None
+    seq_size = mesh.shape[mesh_lib.SEQ_AXIS]
+    choice = self._sequence_parallelism
+    if choice == 'auto':
+      choice = ('ulysses' if self._num_attention_heads % seq_size == 0
+                else 'ring')
+    if choice == 'ulysses':
+      if self._num_attention_heads % seq_size:
+        raise ValueError(
+            f'ulysses needs heads ({self._num_attention_heads}) divisible '
+            f'by the seq axis ({seq_size}); use ring.')
+      return sp.make_ulysses_attention(mesh, causal=True)
+    return sp.make_ring_attention(mesh, causal=True)
+
+  def create_module(self) -> _LongHorizonSnailNet:
+    output_size = self._num_waypoints * self._action_size
+    if self._num_mixture_components > 1:
+      num_mus = output_size * self._num_mixture_components
+      num_outputs = self._num_mixture_components + 2 * num_mus
+    else:
+      num_outputs = output_size
+    return _LongHorizonSnailNet(
+        num_outputs=num_outputs, sequence_length=2 * self._episode_length,
+        num_heads=self._num_attention_heads,
+        head_size=self._attention_head_size,
+        attention_fn=self._attention_fn())

@@ -84,6 +84,88 @@ class TrainerConfig:
   log_interval_steps: int = 100
   seed: int = 0
   async_checkpoints: bool = True
+  # Bounded device prefetch: a background thread pulls batches from the
+  # input iterator and stages them on device (shard_batch) up to this
+  # many ahead, overlapping host parse/decode + h2d with the device step
+  # (the role tf.data prefetch + infeed play for the reference's
+  # TPUEstimator). 0 disables (batches fetched inline). Batch order is
+  # preserved, so training is bit-identical either way.
+  prefetch_batches: int = 2
+
+
+class _DevicePrefetcher:
+  """Background thread staging upcoming batches ahead of the step.
+
+  Pulls ``(features, labels)`` from ``it`` and keeps up to ``depth``
+  staged batches in a bounded queue, so host parse/decode overlaps the
+  device step instead of serializing with it. On a real TPU backend the
+  worker also applies ``place`` (the shard_batch h2d placement) so the
+  transfer overlaps too; on the forced-host CPU platform the placement
+  happens on the consumer thread instead — XLA CPU runs an N-device
+  mesh's collectives as N in-process threads, and a concurrent
+  device_put can starve one participant into a rendezvous deadlock
+  (observed as an all-reduce termination timeout → SIGABRT). FIFO:
+  batch order — and therefore training — is unchanged either way.
+  """
+
+  _DONE = object()
+
+  def __init__(self, it: Iterator[Batch], place: Callable[[Batch], Batch],
+               depth: int):
+    import queue
+    import threading
+
+    self._q: 'queue.Queue' = queue.Queue(maxsize=depth)
+    self._err: Optional[BaseException] = None
+    self._stop = threading.Event()
+    place_in_worker = jax.default_backend() == 'tpu'
+    self._consumer_place = None if place_in_worker else place
+
+    def worker():
+      try:
+        for batch in it:
+          if self._stop.is_set():
+            return
+          self._q.put(place(batch) if place_in_worker else batch)
+      except BaseException as e:  # surfaced on the consumer side
+        self._err = e
+      finally:
+        self._q.put(self._DONE)
+
+    self._thread = threading.Thread(
+        target=worker, daemon=True, name='t2r-prefetch')
+    self._thread.start()
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> Batch:
+    item = self._q.get()
+    if item is self._DONE:
+      if self._err is not None:
+        raise self._err
+      raise StopIteration
+    if self._consumer_place is not None:
+      item = self._consumer_place(item)
+    return item
+
+  def close(self) -> None:
+    import queue
+
+    self._stop.set()
+    # Keep draining until the worker exits: a single drain is not enough
+    # (the worker's blocked put() refills the slot, and its final
+    # put(_DONE) could block forever on a depth-1 queue).
+    while self._thread.is_alive():
+      try:
+        self._q.get(timeout=0.05)
+      except queue.Empty:
+        pass
+    try:
+      while True:
+        self._q.get_nowait()
+    except queue.Empty:
+      pass
 
 
 def _mean_metrics(metric_batches: List[MetricDict]) -> MetricDict:
@@ -106,6 +188,10 @@ class Trainer:
     self._model = model
     self._config = config
     self._mesh = mesh if mesh is not None else mesh_lib.single_device_mesh()
+    if hasattr(model, 'set_mesh'):
+      # Mesh-aware models (e.g. sequence-parallel attention layouts) get
+      # the mesh the jitted step will run over before any module build.
+      model.set_mesh(self._mesh)
     self._callbacks = list(callbacks)
     self._preprocessor = model.preprocessor
     self._optimizer = model.create_optimizer()
@@ -278,31 +364,45 @@ class Trainer:
     # Host-side step mirror: reading self.step would force a device sync
     # (int(state.step)) after every dispatch, serializing the pipeline.
     step = self.step
-    while step < config.max_train_steps:
-      if first_batch is not None:
-        features, labels = first_batch
-        first_batch = None
-      else:
-        features, labels = next(train_iter)
-      features = mesh_lib.shard_batch(features, self._mesh)
-      labels = mesh_lib.shard_batch(labels, self._mesh)
-      self._state, scalars = self._train_step_fn(
-          self._state, features, labels)
-      step += 1
-      if should_log(config.log_interval_steps, step):
-        scalars = {k: float(v) for k, v in scalars.items()}
-        dt = time.time() - last_log
-        last_log = time.time()
-        scalars['steps_per_sec'] = config.log_interval_steps / max(dt, 1e-9)
-      for cb in self._callbacks:
-        cb.after_step(self, step, scalars)
-      if (self._manager is not None and
-          step % config.save_interval_steps == 0):
-        self.save_checkpoint()
-      if (eval_iter_fn is not None and config.eval_interval_steps and
-          (step % config.eval_interval_steps == 0 or
-           step >= config.max_train_steps)):
-        eval_metrics = self.evaluate(eval_iter_fn())
+
+    def place(batch: Batch) -> Batch:
+      return (mesh_lib.shard_batch(batch[0], self._mesh),
+              mesh_lib.shard_batch(batch[1], self._mesh))
+
+    prefetcher: Optional[_DevicePrefetcher] = None
+    if config.prefetch_batches > 0:
+      prefetcher = _DevicePrefetcher(train_iter, place,
+                                     config.prefetch_batches)
+      batches: Iterator[Batch] = iter(prefetcher)
+    else:
+      batches = (place(b) for b in train_iter)
+    try:
+      while step < config.max_train_steps:
+        if first_batch is not None:
+          features, labels = place(first_batch)
+          first_batch = None
+        else:
+          features, labels = next(batches)
+        self._state, scalars = self._train_step_fn(
+            self._state, features, labels)
+        step += 1
+        if should_log(config.log_interval_steps, step):
+          scalars = {k: float(v) for k, v in scalars.items()}
+          dt = time.time() - last_log
+          last_log = time.time()
+          scalars['steps_per_sec'] = config.log_interval_steps / max(dt, 1e-9)
+        for cb in self._callbacks:
+          cb.after_step(self, step, scalars)
+        if (self._manager is not None and
+            step % config.save_interval_steps == 0):
+          self.save_checkpoint()
+        if (eval_iter_fn is not None and config.eval_interval_steps and
+            (step % config.eval_interval_steps == 0 or
+             step >= config.max_train_steps)):
+          eval_metrics = self.evaluate(eval_iter_fn())
+    finally:
+      if prefetcher is not None:
+        prefetcher.close()
     self.save_checkpoint(force=True)
     if self._manager is not None:
       self._manager.wait_until_finished()

@@ -128,12 +128,53 @@ class TestSnail:
     np.testing.assert_allclose(probs[0, 1, :2], [0.5, 0.5], rtol=1e-6)
 
   def test_attention_block(self):
-    block = layers.AttentionBlock(key_size=6, value_size=7)
+    block = layers.AttentionBlock(key_size=6, value_size=7, return_prob=True)
     x = jnp.ones((2, 5, 3))
     variables = block.init(jax.random.PRNGKey(0), x)
     y, end_points = block.apply(variables, x)
     assert y.shape == (2, 5, 3 + 7)
     assert end_points['attn_prob'].shape == (2, 5, 5)
+
+  def test_attention_block_default_omits_probs(self):
+    block = layers.AttentionBlock(key_size=6, value_size=7)
+    x = jnp.ones((2, 5, 3))
+    variables = block.init(jax.random.PRNGKey(0), x)
+    _, end_points = block.apply(variables, x)
+    assert end_points == {}
+
+  def test_attention_block_flash_matches_dense(self):
+    from tensor2robot_tpu.layers import snail
+
+    # T=16, key 12, value 7 → padded head dim 16; flash path supported.
+    assert snail.flash_supported(16, 12, 7)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 5),
+                    dtype=jnp.float32)
+    dense = layers.AttentionBlock(key_size=12, value_size=7, use_flash=False)
+    flash = layers.AttentionBlock(key_size=12, value_size=7, use_flash=True)
+    variables = dense.init(jax.random.PRNGKey(0), x)
+    y_dense, _ = dense.apply(variables, x)
+    y_flash, end_points = flash.apply(variables, x)
+    assert end_points == {}
+    np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-5)
+    # Gradients agree too (the flash custom_vjp path).
+    g_dense = jax.grad(
+        lambda v: jnp.sum(dense.apply(v, x)[0] ** 2))(variables)
+    g_flash = jax.grad(
+        lambda v: jnp.sum(flash.apply(v, x)[0] ** 2))(variables)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+        g_dense, g_flash)
+
+  def test_attention_block_return_prob_rejects_flash(self):
+    import pytest
+
+    block = layers.AttentionBlock(key_size=8, value_size=8,
+                                  return_prob=True, use_flash=True)
+    x = jnp.ones((1, 8, 4))
+    with pytest.raises(ValueError, match='dense path'):
+      block.init(jax.random.PRNGKey(0), x)
 
 
 class TestVisionLayers:

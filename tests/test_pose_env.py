@@ -193,6 +193,34 @@ class TestPoseEnvModels:
     threshold = max(100 * measured, 0.2) if measured else 1.0
     assert metrics['pose_mse'] < threshold, metrics['pose_mse']
 
+  @pytest.mark.slow
+  def test_regression_converges_to_recorded_baseline(self, tmp_path):
+    """The convergence gate: training on the checked-in tfrecord must
+    reach the recorded measured baseline (BASELINE.json
+    measured.pose_env_eval_mse = 7.7e-4 @ 400 TPU steps) within 2×
+    headroom — the regression test pinning 'parity' as defined in
+    BASELINE.md. 800 steps here: the CPU run converges more slowly than
+    the recorded bf16-TPU run (seed sweep: 3.3e-4/4.0e-4/1.1e-3 at 800).
+    Generator seeds are pinned so the run is deterministic — the gate
+    checks the recorded trajectory, not the shuffle lottery.
+    Reference analog: research/pose_env/pose_env_models_test.py:50-80."""
+    model = PoseEnvRegressionModel(device_type='tpu')
+    gen = DefaultRecordInputGenerator(file_patterns=TEST_DATA, batch_size=16,
+                                      seed=7)
+    eval_gen = DefaultRecordInputGenerator(
+        file_patterns=TEST_DATA, batch_size=16, seed=8)
+    metrics = train_eval_model(
+        model=model,
+        model_dir=str(tmp_path / 'm'),
+        train_input_generator=gen,
+        eval_input_generator=eval_gen,
+        max_train_steps=800,
+        eval_steps=4,
+        eval_interval_steps=0,
+        save_interval_steps=800,
+        log_interval_steps=0)
+    assert metrics['pose_mse'] <= 1.5e-3, metrics['pose_mse']
+
 
 class TestPoseEnvPolicies:
 

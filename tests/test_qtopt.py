@@ -80,3 +80,50 @@ class TestGraspingModelWrapper:
     packed = model.pack_features(state, actions, 0)
     assert packed['state/image'].shape == (4, 472, 472, 3)
     assert packed['action/height_to_bottom'].shape == (4, 1)
+
+
+class TestGraspingModules:
+  """Grasping context-merge helpers (ref dql_grasping_lib/tf_modules.py)."""
+
+  def test_tile_to_match_context(self):
+    from tensor2robot_tpu.research.dql_grasping_lib import (
+        tile_to_match_context)
+
+    net = jnp.asarray(np.arange(2 * 3).reshape(2, 3), jnp.float32)
+    context = jnp.zeros((2, 5, 7))
+    tiled = tile_to_match_context(net, context)
+    assert tiled.shape == (2, 5, 3)
+    np.testing.assert_allclose(np.asarray(tiled[0, 4]), np.asarray(net[0]))
+    np.testing.assert_allclose(np.asarray(tiled[1, 0]), np.asarray(net[1]))
+
+  def test_add_context_broadcasts_over_hw(self):
+    from tensor2robot_tpu.research.dql_grasping_lib import add_context
+
+    rng = np.random.RandomState(0)
+    net = jnp.asarray(rng.rand(2, 4, 4, 8), jnp.float32)
+    # CEM megabatch: 3 action samples per batch element.
+    context = jnp.asarray(rng.rand(2 * 3, 8), jnp.float32)
+    merged = add_context(net, context)
+    assert merged.shape == (6, 4, 4, 8)
+    # Element [b, n] = net[b] + context[b*3 + n] at every spatial position.
+    np.testing.assert_allclose(
+        np.asarray(merged[4]),
+        np.asarray(net[1]) + np.asarray(context[4])[None, None, :],
+        rtol=1e-6)
+
+  def test_add_context_rejects_channel_mismatch(self):
+    from tensor2robot_tpu.research.dql_grasping_lib import add_context
+
+    with pytest.raises(ValueError, match='channels'):
+      add_context(jnp.zeros((2, 4, 4, 8)), jnp.zeros((2, 7)))
+
+  def test_conv_defaults_shape(self):
+    from tensor2robot_tpu.research.dql_grasping_lib import conv_defaults
+    import flax.linen as nn
+
+    kwargs = conv_defaults()
+    conv = nn.Conv(features=4, kernel_size=(3, 3), **kwargs)
+    x = jnp.ones((1, 9, 9, 3))
+    variables = conv.init(jax.random.PRNGKey(0), x)
+    y = conv.apply(variables, x)
+    assert y.shape == (1, 4, 4, 4)  # stride-2 VALID

@@ -139,6 +139,95 @@ def test_trainer_tensor_parallel_rules(tmp_path):
   assert metrics['accuracy'] > 0.9, metrics
 
 
+def test_prefetch_is_bitwise_identical(tmp_path):
+  """Bounded device prefetch (background staging thread) preserves batch
+  order, so training is bit-identical to the inline path."""
+  import numpy as np
+
+  results = {}
+  for prefetch in (0, 2):
+    model = MockT2RModel(device_type='tpu', create_optimizer_fn=fast_adam)
+    config = TrainerConfig(
+        model_dir='', max_train_steps=20, eval_interval_steps=0,
+        log_interval_steps=0, prefetch_batches=prefetch)
+    trainer = Trainer(model, config)
+    gen = MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    results[prefetch] = jax.device_get(trainer.state.params)
+  flat0 = jax.tree_util.tree_leaves(results[0])
+  flat2 = jax.tree_util.tree_leaves(results[2])
+  for a, b in zip(flat0, flat2):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_depth1_close_terminates_worker():
+  """close() must fully unblock a depth-1 worker (its final _DONE put
+  could otherwise block forever), leaving no leaked thread."""
+  import itertools
+  import threading
+
+  from tensor2robot_tpu.train.trainer import _DevicePrefetcher
+
+  src = iter(itertools.count())
+  prefetcher = _DevicePrefetcher(src, lambda b: b, depth=1)
+  next(iter(prefetcher))  # consume one so the worker is mid-stream
+  prefetcher.close()
+  prefetcher._thread.join(timeout=5)  # pylint: disable=protected-access
+  assert not prefetcher._thread.is_alive()  # pylint: disable=protected-access
+  assert threading.active_count() < 50
+
+
+def test_prefetch_propagates_iterator_errors():
+  """An input-iterator exception surfaces on the training thread."""
+  import pytest
+
+  model = MockT2RModel(device_type='tpu', create_optimizer_fn=fast_adam)
+  config = TrainerConfig(model_dir='', max_train_steps=50,
+                         eval_interval_steps=0, log_interval_steps=0,
+                         prefetch_batches=2)
+  trainer = Trainer(model, config)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  real = gen.create_iterator(ModeKeys.TRAIN)
+
+  def broken():
+    for i, batch in enumerate(real):
+      if i == 5:
+        raise RuntimeError('decode failed')
+      yield batch
+
+  with pytest.raises(RuntimeError, match='decode failed'):
+    trainer.train(broken(), None)
+
+
+def test_sharding_rule_validation():
+  """ADVICE r2: duplicate mesh axes in one rule spec raise a clear error
+  up front, and the 'replicated' sentinel pins a param replicated
+  instead of falling through to the fsdp default."""
+  import numpy as np
+  import pytest
+
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+  mesh = parallel.create_mesh(data=2, fsdp=2, model=2)
+  param = np.zeros((4, 4), np.float32)
+  with pytest.raises(ValueError, match='more than once'):
+    mesh_lib.rule_param_sharding(
+        mesh, 'dense/kernel', param,
+        ((r'kernel$', (parallel.MODEL_AXIS, parallel.MODEL_AXIS)),))
+  with pytest.raises(ValueError, match='sentinel'):
+    mesh_lib.rule_param_sharding(
+        mesh, 'dense/kernel', param, ((r'kernel$', 'bogus'),))
+  pinned = mesh_lib.rule_param_sharding(
+      mesh, 'dense/kernel', param, ((r'kernel$', mesh_lib.REPLICATED),))
+  assert tuple(pinned.spec) == ()
+  # An all-degenerate tuple spec still falls through (returns None) so
+  # the fsdp default applies — distinct from the explicit sentinel.
+  assert mesh_lib.rule_param_sharding(
+      mesh, 'dense/kernel', param, ((r'kernel$', (None, None)),)) is None
+
+
 def test_trainer_fsdp_mesh(tmp_path):
   """Params sharded over the fsdp axis still converge."""
   mesh = parallel.create_mesh(data=2, fsdp=4)

@@ -1,0 +1,288 @@
+"""Per-fusion roofline accounting for a jitted step (VERDICT r2 #1).
+
+Produces the table PERF_NOTES.md needs: for each of the top-N device ops
+in an xplane trace of the step, the achieved time vs. a roofline bound
+computed from the optimized HLO — flops (convolutions/dots inside the
+fusion, with an MXU-occupancy-adjusted peak for narrow output channels)
+and HBM bytes (fusion operands + outputs, ignoring cache reuse).
+
+Usage::
+
+    python tools/fusion_roofline.py          # traces the bench train step
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+  sys.path.insert(0, REPO)
+
+# v5e: bf16 MXU peak and HBM bandwidth.
+PEAK_FLOPS = 197e12
+HBM_GBS = 819e9
+
+_DTYPE_BYTES = {'pred': 1, 's8': 1, 'u8': 1, 'bf16': 2, 'f16': 2, 's16': 2,
+                'u16': 2, 'f32': 4, 's32': 4, 'u32': 4, 'f64': 8, 's64': 8,
+                'u64': 8}
+
+_SHAPE_RE = re.compile(r'(\w+)\[([\d,]*)\]')
+
+
+def _shape_bytes(shape_str: str) -> int:
+  """Total bytes of an HLO shape string (sums tuple elements)."""
+  total = 0
+  for dtype, dims in _SHAPE_RE.findall(shape_str):
+    if dtype not in _DTYPE_BYTES:
+      continue
+    n = 1
+    for d in dims.split(','):
+      if d:
+        n *= int(d)
+    total += n * _DTYPE_BYTES[dtype]
+  return total
+
+
+def _parse_dims(dims: str) -> List[int]:
+  return [int(d) for d in dims.split(',') if d]
+
+
+_DEF_RE = re.compile(r'\s*(?:ROOT\s+)?%?([\w\-.]+)\s*=\s*(.*)')
+_OPERAND_RE = re.compile(r'%([\w\-.]+)')
+
+
+def _first_shape_dims(rest: str) -> List[int]:
+  m = _SHAPE_RE.search(rest)
+  return _parse_dims(m.group(2)) if m else []
+
+
+def _conv_flops(rest: str, operand_dims) -> Tuple[float, int]:
+  """(flops, min_matmul_dim) for a convolution def; operands by lookup."""
+  out_dims = _first_shape_dims(rest)
+  out_elems = 1
+  for d in out_dims:
+    out_elems *= d
+  args = rest.split('convolution(', 1)[1].split(')', 1)[0]
+  names = _OPERAND_RE.findall(args)
+  rhs_dims = operand_dims.get(names[1], []) if len(names) > 1 else []
+  dm = re.search(r'dim_labels=(\w+)_(\w+)->(\w+)', rest)
+  if dm and rhs_dims:
+    rhs_labels = dm.group(2)  # e.g. 01io
+    kin = kout = 1
+    spatial = 1
+    for lab, dim in zip(rhs_labels, rhs_dims):
+      if lab == 'i':
+        kin = dim
+      elif lab == 'o':
+        kout = dim
+      else:
+        spatial *= dim
+    k = kin * spatial
+    return 2.0 * out_elems * k, min(128, kout or 128, k or 128)
+  # Fallback: window size × an assumed 64-channel contraction.
+  wm = re.search(r'window=\{size=(\d+)x(\d+)', rest)
+  k = (int(wm.group(1)) * int(wm.group(2)) if wm else 1) * 64
+  return 2.0 * out_elems * k, 64
+
+
+def _dot_flops(rest: str, operand_dims) -> Tuple[float, int]:
+  out_dims = _first_shape_dims(rest)
+  out_elems = 1
+  for d in out_dims:
+    out_elems *= d
+  args = rest.split('dot(', 1)[1].split(')', 1)[0]
+  names = _OPERAND_RE.findall(args)
+  lhs_dims = operand_dims.get(names[0], []) if names else []
+  cm = re.search(r'lhs_contracting_dims=\{([\d,]*)\}', rest)
+  k = 1
+  if cm and lhs_dims:
+    for i in _parse_dims(cm.group(1)):
+      if i < len(lhs_dims):
+        k *= lhs_dims[i]
+  n = out_dims[-1] if out_dims else 128
+  return 2.0 * out_elems * k, min(128, n or 128, k or 128)
+
+
+def analyze_hlo(hlo_text: str) -> Dict[str, Dict]:
+  """name → {'flops', 'bytes', 'mxu_dim'} for every computation/op.
+
+  Fusions: bytes = operands of the fusion *call* + its outputs (operand
+  shapes resolved through a global name → shape table, since this HLO
+  dialect prints operands as bare names); flops = conv/dot flops inside
+  the fused computation. Standalone convs/dots are accounted from their
+  own def line.
+  """
+  lines = hlo_text.splitlines()
+
+  # Pass 1: global name → (dims, bytes) for every def in every computation.
+  dims_of: Dict[str, List[int]] = {}
+  bytes_of: Dict[str, int] = {}
+  for line in lines:
+    m = _DEF_RE.match(line)
+    if not m:
+      continue
+    name, rest = m.group(1), m.group(2)
+    # Output shape(s): the leading type expression — for tuple results
+    # the shape is parenthesised, so grab up to the closing paren.
+    shape_part = rest.split(' ', 1)[0] if not rest.startswith('(') else (
+        rest[:rest.index(') ') + 1] if ') ' in rest else rest)
+    dims_of[name] = _first_shape_dims(shape_part)
+    bytes_of[name] = _shape_bytes(shape_part)
+
+  # Pass 2: per-computation conv/dot flops.
+  comp_flops: Dict[str, float] = collections.defaultdict(float)
+  comp_mxu: Dict[str, int] = {}
+  current = None
+  for line in lines:
+    hm = re.match(r'\s*%?([\w\-.]+)\s*\([^)]*\)\s*->', line)
+    if hm and '{' in line and '=' not in line.split('(')[0]:
+      current = hm.group(1)
+      continue
+    if current is None:
+      continue
+    m = _DEF_RE.match(line)
+    if not m:
+      continue
+    rest = m.group(2)
+    if ' convolution(' in rest:
+      f, mx = _conv_flops(rest, dims_of)
+      comp_flops[current] += f
+      comp_mxu[current] = min(comp_mxu.get(current, 128), mx)
+    elif ' dot(' in rest:
+      f, mx = _dot_flops(rest, dims_of)
+      comp_flops[current] += f
+      comp_mxu[current] = min(comp_mxu.get(current, 128), mx)
+
+  # Pass 3: every def becomes a reportable op with operand/result bytes.
+  ops: Dict[str, Dict] = {}
+  for line in lines:
+    m = _DEF_RE.match(line)
+    if not m:
+      continue
+    name, rest = m.group(1), m.group(2)
+    body = None
+    cm = re.search(r'calls=%?([\w\-.]+)', rest)
+    if cm:
+      body = cm.group(1)
+    flops = comp_flops.get(body, 0.0) if body else 0.0
+    mxu = comp_mxu.get(body, 128) if body else 128
+    if ' convolution(' in rest:
+      flops, mxu = _conv_flops(rest, dims_of)
+    elif ' dot(' in rest:
+      flops, mxu = _dot_flops(rest, dims_of)
+    in_bytes = 0
+    call = rest.find('(%')  # call-args start (skips tuple-shape parens)
+    if call >= 0:
+      op_args = rest[call + 1:].split(')', 1)[0]
+      for operand in _OPERAND_RE.findall(op_args):
+        in_bytes += bytes_of.get(operand, 0)
+    ops[name] = {
+        'flops': flops,
+        'bytes': bytes_of.get(name, 0) + in_bytes,
+        'mxu_dim': mxu,
+    }
+  return ops
+
+
+def roofline_table(op_times_ms: Dict[str, float], hlo_text: str,
+                   top: int = 15) -> str:
+  """The PERF_NOTES table: per-op achieved vs roofline bound."""
+  info = analyze_hlo(hlo_text)
+  rows = []
+  for name, ms in sorted(op_times_ms.items(), key=lambda kv: -kv[1])[:top]:
+    d = info.get(name, {})
+    flops = d.get('flops', 0.0)
+    nbytes = d.get('bytes', 0)
+    mxu = d.get('mxu_dim', 128)
+    peak = PEAK_FLOPS * (mxu / 128.0)
+    t_mxu = flops / peak * 1e3 if flops else 0.0
+    t_hbm = nbytes / HBM_GBS * 1e3
+    bound = max(t_mxu, t_hbm)
+    ratio = ms / bound if bound > 1e-6 else float('inf')
+    kind = 'mxu' if t_mxu >= t_hbm else 'hbm'
+    rows.append((ms, name, flops / 1e9, nbytes / 1e6, bound, kind, ratio))
+  lines = [f'{"ms":>7} {"GF":>7} {"MB":>7} {"bound ms":>8} {"lim":>3} '
+           f'{"x":>5}  op']
+  for ms, name, gf, mb, bound, kind, ratio in rows:
+    lines.append(f'{ms:7.3f} {gf:7.1f} {mb:7.1f} {bound:8.3f} {kind:>3} '
+                 f'{ratio:5.2f}  {name[:60]}')
+  return '\n'.join(lines)
+
+
+def device_op_times_full(tracedir, device_prefix='/device:TPU'):
+  """Like trace_profile.device_op_times but keeps FULL op names."""
+  from tools.trace_profile import _parse_xplane
+
+  xs = _parse_xplane(tracedir)
+  per_plane = []
+  for p in xs.planes:
+    if not p.name.startswith(device_prefix):
+      continue
+    ev_meta = {m.id: m.name for m in p.event_metadata.values()}
+    ops = collections.Counter()
+    total = 0
+    for line in p.lines:
+      if line.name != 'XLA Ops':
+        continue
+      for ev in line.events:
+        total += ev.duration_ps
+        name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
+        ops[name] += ev.duration_ps
+    per_plane.append((total, ops))
+  if not per_plane:
+    return 0.0, {}
+  total, ops = max(per_plane, key=lambda t: t[0])
+  return total / 1e9, {k: v / 1e9 for k, v in ops.items()}
+
+
+def main():
+  import tempfile
+
+  import jax
+
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+  from tensor2robot_tpu.specs import make_random_numpy
+  from tensor2robot_tpu.train import Trainer, TrainerConfig
+
+  batch_size = 32
+  model = GraspingModelWrapper(device_type='tpu')
+  config = TrainerConfig(model_dir='', max_train_steps=1,
+                         eval_interval_steps=0, log_interval_steps=0)
+  trainer = Trainer(model, config)
+  preprocessor = model.preprocessor
+  feature_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+  label_spec = preprocessor.get_in_label_specification(ModeKeys.TRAIN)
+  features = make_random_numpy(feature_spec, batch_size=batch_size, seed=0)
+  labels = make_random_numpy(label_spec, batch_size=batch_size, seed=100)
+  trainer.train(iter([(features, labels)]), None)
+
+  state = trainer.state
+  step_fn = trainer._train_step_fn  # pylint: disable=protected-access
+  f = mesh_lib.shard_batch(features, trainer.mesh)
+  l = mesh_lib.shard_batch(labels, trainer.mesh)
+  hlo = step_fn.lower(state, f, l).compile().as_text()
+
+  n = 20
+  tracedir = tempfile.mkdtemp(prefix='t2r_roofline_')
+  st = state
+  st, _ = step_fn(st, f, l)
+  jax.block_until_ready(st.params)
+  with jax.profiler.trace(tracedir):
+    for _ in range(n):
+      st, _ = step_fn(st, f, l)
+    jax.block_until_ready(st.params)
+  total_ms, ops = device_op_times_full(tracedir)
+  ops = {k: v / n for k, v in ops.items()}
+  print(f'device ms/step: {total_ms / n:.3f}')
+  print(roofline_table(ops, hlo, top=20))
+
+
+if __name__ == '__main__':
+  main()
