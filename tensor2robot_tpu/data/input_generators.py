@@ -182,6 +182,7 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                shuffle_buffer_size: int = 1000,
                cycle_length: int = 16,
                queue_capacity: int = 64,
+               decode_workers: int = 8,
                seed: Optional[int] = None):
     super().__init__(batch_size)
     if not file_patterns:
@@ -190,6 +191,7 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     self._shuffle_buffer_size = shuffle_buffer_size
     self._cycle_length = cycle_length
     self._queue_capacity = queue_capacity
+    self._decode_workers = decode_workers
     self._seed = seed
 
   def _records(self, mode: str):
@@ -220,8 +222,9 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
   def _create_iterator(self, mode, batch_size):
     from tensor2robot_tpu.data import native_io
 
-    parse_fn = native_io.make_native_parse_fn(self._feature_spec,
-                                              self._label_spec)
+    parse_fn = native_io.make_native_parse_fn(
+        self._feature_spec, self._label_spec,
+        decode_workers=self._decode_workers)
     if parse_fn is None:
       raise ValueError(
           'Specs not natively parseable (sequence/multi-dataset/'

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Callable, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -86,6 +87,14 @@ def flash_supported(t: int, key_size: int, value_size: int) -> bool:
   return fa.is_supported(t, _flash_pad_dim(key_size, value_size))
 
 
+def _flash_auto_ok() -> bool:
+  """Auto-dispatch gate: real TPU only — interpret-mode Pallas loses to
+  the dense einsum off-TPU, and Mosaic custom calls don't lower for CPU
+  serving platforms. Tests monkeypatch this to exercise the flash path
+  in interpret mode."""
+  return jax.default_backend() == 'tpu'
+
+
 def _flash_causal_read(query: jnp.ndarray, key: jnp.ndarray,
                        values: jnp.ndarray) -> jnp.ndarray:
   """Causal attention read via the Pallas flash kernels, O(T·D) memory.
@@ -115,11 +124,16 @@ class AttentionBlock(nn.Module):
 
   Returns ``([B, T, C + value_size], end_points)``. By default the block
   dispatches to the Pallas flash-attention kernels whenever the problem
-  is supported (:func:`flash_supported`) — O(T·D) memory, no [B, T, T]
-  materialization — and ``end_points`` is empty. Setting
-  ``return_prob=True`` requests the ``{'attn_prob': [B, T, T]}`` tensor,
-  which forces the dense O(T²) path (that tensor IS the quadratic cost).
-  ``use_flash`` overrides the auto dispatch either way.
+  is supported (:func:`flash_supported`) AND the backend is a real TPU —
+  O(T·D) memory, no [B, T, T] materialization — and ``end_points`` is
+  empty. Off-TPU the auto default stays dense: interpret-mode Pallas
+  would be slower than the einsum it replaces, and a serving export
+  traced with a Mosaic custom call cannot lower for CPU robot hosts
+  (models additionally force the dense path in PREDICT mode for that
+  reason). Setting ``return_prob=True`` requests the
+  ``{'attn_prob': [B, T, T]}`` tensor, which forces the dense O(T²) path
+  (that tensor IS the quadratic cost). ``use_flash`` overrides the auto
+  dispatch either way.
   """
 
   key_size: int
@@ -135,7 +149,7 @@ class AttentionBlock(nn.Module):
     t = x.shape[1]
     use_flash = self.use_flash
     if use_flash is None:
-      use_flash = (not self.return_prob and
+      use_flash = (not self.return_prob and _flash_auto_ok() and
                    flash_supported(t, self.key_size, self.value_size))
     if use_flash:
       if self.return_prob:
@@ -168,6 +182,7 @@ class MultiHeadAttentionBlock(nn.Module):
   num_heads: int
   head_size: int
   attention_fn: Optional[Callable] = None
+  use_flash: Optional[bool] = None  # None = auto (TPU + supported)
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, dict]:
@@ -183,7 +198,10 @@ class MultiHeadAttentionBlock(nn.Module):
     else:
       from tensor2robot_tpu.ops import flash_attention as fa
 
-      if fa.is_supported(t, d):
+      use_flash = self.use_flash
+      if use_flash is None:
+        use_flash = _flash_auto_ok() and fa.is_supported(t, d)
+      if use_flash:
         out = fa.flash_attention(query, key, values, causal=True)
       else:
         from tensor2robot_tpu.parallel.sequence_parallel import (

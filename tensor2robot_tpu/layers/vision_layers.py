@@ -131,8 +131,12 @@ class ImagesToFeaturesModelHighRes(nn.Module):
   @nn.compact
   def __call__(self, images: jnp.ndarray,
                train: bool = False) -> Tuple[jnp.ndarray, dict]:
+    # use_bias=False: every conv here feeds a BatchNorm, whose mean
+    # subtraction cancels a conv bias exactly (dead param + a wasted
+    # full-tensor gradient reduction; same rationale as qtopt networks).
     conv_kwargs = dict(
         padding='VALID',
+        use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.1))
 
     def norm(net, scale, name):

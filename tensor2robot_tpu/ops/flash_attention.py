@@ -323,16 +323,27 @@ _MAX_STAGED_KV_BYTES = 8 * 1024 * 1024
 
 
 def is_supported(t: int, d: int, block_q: int = DEFAULT_BLOCK_Q,
-                 block_k: int = DEFAULT_BLOCK_K) -> bool:
+                 block_k: int = DEFAULT_BLOCK_K,
+                 interpret: Optional[bool] = None) -> bool:
   """Whether ``flash_attention`` handles a [_, t, _, d] problem.
 
   The dispatch predicate shared with the sequence-parallel wrappers —
   callers fall back to plain attention when this is False.
+
+  On a real TPU the blocks must additionally be at least a lane tile
+  (128): the logsumexp output places the q-block dim in lanes, and
+  Mosaic rejects sub-tile vector stores (found by driving a T=8 SNAIL
+  episode on hardware — interpret mode accepts any 8-aligned block, so
+  the CPU suite can't see this). ``interpret=None`` resolves from the
+  current backend.
   """
+  if interpret is None:
+    interpret = _use_interpret()
   bq, bk = min(block_q, t), min(block_k, t)
+  min_block = 8 if interpret else 128
   return (0 < d <= 128 and d % 8 == 0 and
           t % bq == 0 and t % bk == 0 and
-          bq % 8 == 0 and bk % 8 == 0)
+          bq % min_block == 0 and bk % min_block == 0)
 
 
 def _use_streamed(t: int, d: int, itemsize: int = 2) -> bool:

@@ -394,8 +394,11 @@ class _SnailSequenceNet(nn.Module):
   return_attention_probs: bool = False
 
   @nn.compact
-  def __call__(self, images, aux_input, train: bool = False):
-    # images [B, T, H, W, C]; aux_input [B, T, P].
+  def __call__(self, images, aux_input, train: bool = False,
+               allow_flash: bool = True):
+    # images [B, T, H, W, C]; aux_input [B, T, P]. ``allow_flash=False``
+    # (the PREDICT/serving path) pins the attention blocks to the dense
+    # form so exports lower on every serving platform.
     b, t = images.shape[:2]
     merged = images.reshape((-1,) + tuple(images.shape[2:]))
     frame_features, _ = vision_layers.ImagesToFeaturesModel(
@@ -404,17 +407,18 @@ class _SnailSequenceNet(nn.Module):
     net = jnp.concatenate([net, aux_input], axis=-1)
     net = nn.Dense(64, name='in_proj')(net)
     end_points = {}
+    use_flash = None if allow_flash else False
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc1')(net)
     net, attn1 = snail.AttentionBlock(
-        key_size=64, value_size=self.filters,
+        key_size=64, value_size=self.filters, use_flash=use_flash,
         return_prob=self.return_attention_probs, name='attn1')(net)
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc2')(net)
     net, attn2 = snail.AttentionBlock(
-        key_size=64, value_size=self.filters,
+        key_size=64, value_size=self.filters, use_flash=use_flash,
         return_prob=self.return_attention_probs, name='attn2')(net)
     if self.return_attention_probs:
       end_points['attn_probs/0'] = attn1['attn_prob']
@@ -479,8 +483,10 @@ class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
   def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
     features, _ = self.validated_features(features, mode)
     images, aux, _ = self._sequence_inputs(features)
+    # Dense path for init: parameters are dispatch-independent and the
+    # init trace shouldn't require a Pallas lowering.
     return self.create_module().init({'params': rng}, images, aux,
-                                     train=False)
+                                     train=False, allow_flash=False)
 
   def inference_network_fn(self, variables, features, labels, mode,
                            rng=None):
@@ -488,7 +494,8 @@ class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
     features, _ = self.validated_features(features, mode)
     images, aux, condition_length = self._sequence_inputs(features)
     poses, end_points = self.create_module().apply(
-        variables, images, aux, train=mode == ModeKeys.TRAIN)
+        variables, images, aux, train=mode == ModeKeys.TRAIN,
+        allow_flash=mode != ModeKeys.PREDICT)
     outputs = dict(end_points)
     output_size = self._num_waypoints * self._action_size
     tail = poses[:, condition_length:]
@@ -554,7 +561,8 @@ class _LongHorizonSnailNet(nn.Module):
   attention_fn: Optional[callable] = None
 
   @nn.compact
-  def __call__(self, images, aux_input, train: bool = False):
+  def __call__(self, images, aux_input, train: bool = False,
+               allow_flash: bool = True):
     b, t = images.shape[:2]
     merged = images.reshape((-1,) + tuple(images.shape[2:]))
     frame_features, _ = vision_layers.ImagesToFeaturesModel(
@@ -562,18 +570,21 @@ class _LongHorizonSnailNet(nn.Module):
     net = frame_features.reshape((b, t, -1))
     net = jnp.concatenate([net, aux_input], axis=-1)
     net = nn.Dense(64, name='in_proj')(net)
+    use_flash = None if allow_flash else False
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc1')(net)
     net, _ = snail.MultiHeadAttentionBlock(
         num_heads=self.num_heads, head_size=self.head_size,
-        attention_fn=self.attention_fn, name='attn1')(net)
+        attention_fn=self.attention_fn, use_flash=use_flash,
+        name='attn1')(net)
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc2')(net)
     net, _ = snail.MultiHeadAttentionBlock(
         num_heads=self.num_heads, head_size=self.head_size,
-        attention_fn=self.attention_fn, name='attn2')(net)
+        attention_fn=self.attention_fn, use_flash=use_flash,
+        name='attn2')(net)
     poses = nn.Dense(self.num_outputs, name='out')(net)
     return poses, {}
 

@@ -116,3 +116,15 @@ def test_interpret_on_any_non_tpu_backend(monkeypatch):
   out = fa.flash_attention(q, k, v, False, 64, 64)
   ref = reference_attention(q, k, v)
   np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_is_supported_requires_lane_tile_blocks_on_tpu():
+  """On real TPU the blocks must be >=128 (the lse output puts the
+  q-block dim in lanes; Mosaic rejects sub-tile stores — found on
+  hardware with a T=8 SNAIL episode). Interpret mode keeps 8-aligned."""
+  from tensor2robot_tpu.ops import flash_attention as fa
+
+  assert fa.is_supported(8, 64, interpret=True)
+  assert not fa.is_supported(8, 64, interpret=False)
+  assert fa.is_supported(128, 64, interpret=False)
+  assert fa.is_supported(4096, 64, interpret=False)

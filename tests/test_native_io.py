@@ -427,3 +427,42 @@ class TestStringPassthrough:
     assert feats['instruction'].tolist() == [b'pick up the cup',
                                              b'open drawer']
     np.testing.assert_array_equal(feats['x'], [[1., 2.], [3., 4.]])
+
+
+def test_decode_image_converts_channel_mismatch():
+  """Grayscale-stored jpegs under a 3-channel spec convert like the TF
+  codec path (channels forced from the spec), instead of failing."""
+  import io
+
+  import numpy as np
+  import PIL.Image
+
+  from tensor2robot_tpu.data.native_io import _decode_image
+  from tensor2robot_tpu.specs import TensorSpec
+
+  spec3 = TensorSpec(shape=(8, 10, 3), dtype=np.uint8, name='img',
+                     data_format='JPEG')
+  gray = PIL.Image.fromarray(
+      np.arange(80, dtype=np.uint8).reshape(8, 10), mode='L')
+  buf = io.BytesIO()
+  gray.save(buf, format='JPEG')
+  arr = _decode_image(buf.getvalue(), spec3)
+  assert arr.shape == (8, 10, 3)
+
+  spec1 = TensorSpec(shape=(8, 10, 1), dtype=np.uint8, name='img',
+                     data_format='JPEG')
+  rgb = PIL.Image.fromarray(
+      np.zeros((8, 10, 3), np.uint8), mode='RGB')
+  buf = io.BytesIO()
+  rgb.save(buf, format='JPEG')
+  arr = _decode_image(buf.getvalue(), spec1)
+  assert arr.shape == (8, 10, 1)
+
+  # Genuine resolution mismatch still fails, by name.
+  import pytest
+
+  bad = PIL.Image.fromarray(np.zeros((4, 4), np.uint8), mode='L')
+  buf = io.BytesIO()
+  bad.save(buf, format='JPEG')
+  with pytest.raises(ValueError, match='img'):
+    _decode_image(buf.getvalue(), spec3, key='img')

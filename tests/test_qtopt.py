@@ -127,3 +127,72 @@ class TestGraspingModules:
     variables = conv.init(jax.random.PRNGKey(0), x)
     y = conv.apply(variables, x)
     assert y.shape == (1, 4, 4, 4)  # stride-2 VALID
+
+
+class TestPooledBatchNormRelu:
+  """The pool-then-normalize rewrite is EXACT vs the reference order
+  (PERF_NOTES r3: pool(relu(bn(x))) == relu(bn_stats_from_x(pool(x)))
+  for a scale-free BatchNorm)."""
+
+  def _modules(self):
+    import flax
+    import flax.linen as nn
+
+    from tensor2robot_tpu.research.qtopt.networks import (
+        _PooledBatchNormRelu)
+
+    class Orig(nn.Module):
+
+      @nn.compact
+      def __call__(self, x, train):
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=0.001, use_scale=False)(x)
+        return nn.max_pool(nn.relu(y), (3, 3), strides=(3, 3),
+                           padding='SAME')
+
+    class Pooled(nn.Module):
+
+      @nn.compact
+      def __call__(self, x, train):
+        pooled = nn.max_pool(x, (3, 3), strides=(3, 3), padding='SAME')
+        return _PooledBatchNormRelu(name='bn')(x, pooled, train)
+
+    return Orig(), Pooled(), flax
+
+  def test_outputs_stats_grads_eval_all_equal(self):
+    orig, pooled, flax = self._modules()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 23, 23, 8).astype(np.float32))
+    vo = flax.core.unfreeze(orig.init(jax.random.PRNGKey(0), x, True))
+    vn = flax.core.unfreeze(pooled.init(jax.random.PRNGKey(0), x, True))
+    bias = jnp.asarray(rng.randn(8), jnp.float32)
+    vo['params']['BatchNorm_0']['bias'] = bias
+    vn['params']['bn']['bias'] = bias
+
+    yo, so = orig.apply(vo, x, True, mutable=['batch_stats'])
+    yn, sn = pooled.apply(vn, x, True, mutable=['batch_stats'])
+    np.testing.assert_allclose(np.asarray(yo), np.asarray(yn), atol=1e-5)
+    np.testing.assert_allclose(
+        so['batch_stats']['BatchNorm_0']['mean'],
+        sn['batch_stats']['bn']['mean'], atol=1e-6)
+    np.testing.assert_allclose(
+        so['batch_stats']['BatchNorm_0']['var'],
+        sn['batch_stats']['bn']['var'], atol=1e-6)
+
+    def loss(mod):
+      return lambda v, x: jnp.sum(
+          mod.apply(v, x, True, mutable=['batch_stats'])[0] ** 2)
+
+    go = jax.grad(loss(orig), argnums=(0, 1))(vo, x)
+    gn = jax.grad(loss(pooled), argnums=(0, 1))(vn, x)
+    np.testing.assert_allclose(np.asarray(go[1]), np.asarray(gn[1]),
+                               atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(go[0]['params']['BatchNorm_0']['bias']),
+        np.asarray(gn[0]['params']['bn']['bias']), atol=1e-4)
+
+    yo2 = orig.apply(
+        {'params': vo['params'], 'batch_stats': so['batch_stats']}, x, False)
+    yn2 = pooled.apply(
+        {'params': vn['params'], 'batch_stats': sn['batch_stats']}, x, False)
+    np.testing.assert_allclose(np.asarray(yo2), np.asarray(yn2), atol=1e-5)
