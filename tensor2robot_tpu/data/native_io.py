@@ -277,11 +277,15 @@ def _decode_image(raw: bytes, spec, key=None):
   img = PIL.Image.open(io.BytesIO(raw))
   # Channel-count reconciliation, matching the TF codec's decode
   # (example_codec forces channels from the spec): grayscale-stored
-  # images under a 3-channel spec convert, and vice versa.
-  if shape[-1] == 3 and img.mode != 'RGB':
-    img = img.convert('RGB')
-  elif shape[-1] == 1 and img.mode != 'L':
-    img = img.convert('L')
+  # images under a 3-channel spec convert, and vice versa. High-bit
+  # modes (16-bit PNG 'I;16'/'I', float 'F') are exempt — convert()
+  # would clamp them to 8 bits; they pass through as decoded.
+  high_bit = img.mode in ('I', 'I;16', 'I;16B', 'I;16L', 'F')
+  if not high_bit:
+    if shape[-1] == 3 and img.mode != 'RGB':
+      img = img.convert('RGB')
+    elif shape[-1] == 1 and img.mode != 'L':
+      img = img.convert('L')
   arr = np.asarray(img)
   if arr.ndim == 2:
     arr = arr[..., None]
@@ -308,6 +312,8 @@ def _decode_pool(workers: int):
     if _DECODE_POOL is None or _DECODE_POOL._max_workers < workers:  # pylint: disable=protected-access
       import concurrent.futures
 
+      if _DECODE_POOL is not None:
+        _DECODE_POOL.shutdown(wait=False)  # don't leak the smaller pool
       _DECODE_POOL = concurrent.futures.ThreadPoolExecutor(
           max_workers=workers, thread_name_prefix='t2r-decode')
     return _DECODE_POOL

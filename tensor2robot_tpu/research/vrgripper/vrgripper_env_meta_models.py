@@ -571,19 +571,24 @@ class _LongHorizonSnailNet(nn.Module):
     net = jnp.concatenate([net, aux_input], axis=-1)
     net = nn.Dense(64, name='in_proj')(net)
     use_flash = None if allow_flash else False
+    # The serving path (allow_flash=False) must also drop the
+    # seq-parallel attention_fn: a shard_map all-to-all (with flash
+    # kernels inside) in the PREDICT trace could not lower for
+    # single-device CPU robot hosts.
+    attention_fn = self.attention_fn if allow_flash else None
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc1')(net)
     net, _ = snail.MultiHeadAttentionBlock(
         num_heads=self.num_heads, head_size=self.head_size,
-        attention_fn=self.attention_fn, use_flash=use_flash,
+        attention_fn=attention_fn, use_flash=use_flash,
         name='attn1')(net)
     net = snail.TCBlock(
         sequence_length=self.sequence_length, filters=self.filters,
         name='tc2')(net)
     net, _ = snail.MultiHeadAttentionBlock(
         num_heads=self.num_heads, head_size=self.head_size,
-        attention_fn=self.attention_fn, use_flash=use_flash,
+        attention_fn=attention_fn, use_flash=use_flash,
         name='attn2')(net)
     poses = nn.Dense(self.num_outputs, name='out')(net)
     return poses, {}

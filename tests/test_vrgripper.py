@@ -393,3 +393,40 @@ class TestSequentialModel:
         packed['inference/features/gripper_pose/0'][0, :2], 5.0)
     np.testing.assert_allclose(
         packed['inference/features/gripper_pose/0'][0, 2:], 0.0)
+
+
+def test_long_horizon_predict_drops_seq_parallel_attention():
+  """PREDICT (the serving trace) must not contain the seq-parallel
+  shard_map/flash path even when the model was trained with a seq mesh
+  (code-review r3: attention_fn took precedence over the dense pin)."""
+  import pytest
+
+  from tensor2robot_tpu.parallel import create_mesh
+  from tensor2robot_tpu.research.vrgripper import VRGripperEnvLongHorizonModel
+
+  model = VRGripperEnvLongHorizonModel(
+      episode_length=8, image_size=(48, 48), device_type='cpu',
+      sequence_parallelism='ulysses')
+  model.set_mesh(create_mesh(devices=jax.devices()[:4], data=1, seq=4))
+  features, labels = _tec_meta_features(model)
+  variables = model.init_variables(jax.random.PRNGKey(0), features)
+  out_train, _ = model.inference_network_fn(
+      variables, features, labels, ModeKeys.TRAIN)
+
+  # PREDICT must work and agree even if the seq-parallel fn would fail
+  # (e.g. on a single-device robot host): poison it to prove it is
+  # never called.
+  def boom(*args, **kwargs):
+    raise AssertionError('seq-parallel attention reached in PREDICT')
+
+  model._attention_fn = boom  # the builder, called in create_module
+  with pytest.raises(AssertionError):
+    # Sanity: the poisoned builder WOULD fire on the train path.
+    model.inference_network_fn(variables, features, labels, ModeKeys.TRAIN)
+
+  model._attention_fn = lambda: boom  # attention_fn itself poisoned
+  out_pred, _ = model.inference_network_fn(
+      variables, features, None, ModeKeys.PREDICT)
+  np.testing.assert_allclose(
+      np.asarray(out_pred['inference_output']),
+      np.asarray(out_train['inference_output']), rtol=1e-4, atol=1e-4)

@@ -160,11 +160,15 @@ def run_profiles(pattern: str, batch: int, steps: int,
 
 def main():
   parser = argparse.ArgumentParser()
-  parser.add_argument('--steps', type=int, default=12)
+  parser.add_argument('--steps', type=int, default=12,
+                      help='timed steps per window; must be >= 2 (the '
+                           'first step of each window is dropped)')
   parser.add_argument('--batch', type=int, default=16)
   parser.add_argument('--examples', type=int, default=64)
   parser.add_argument('--per_step', action='store_true')
   args = parser.parse_args()
+  if args.steps < 2:
+    parser.error('--steps must be >= 2 (first step per window is dropped)')
 
   from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
 
