@@ -299,24 +299,31 @@ def _decode_image(raw: bytes, spec, key=None):
   return arr.astype(spec.dtype)
 
 
-_DECODE_POOL = None
+_DECODE_POOLS: dict = {}  # max_workers → ThreadPoolExecutor
 _DECODE_POOL_LOCK = threading.Lock()
 
 
 def _decode_pool(workers: int):
-  """One shared decode pool per process — parse fns are created per
-  iterator (train + every eval round), so a pool per parse fn would
-  churn threads for the process lifetime."""
-  global _DECODE_POOL
+  """Shared decode pools per process — parse fns are created per iterator
+  (train + every eval round), so a pool per parse fn would churn threads
+  for the process lifetime.
+
+  A pool is NEVER shut down once handed out: another iterator thread
+  (train vs eval generators with different ``decode_workers``) may hold a
+  reference and ``.map`` on it concurrently, and an executor raises
+  ``cannot schedule new futures after shutdown`` mid-training. Instead,
+  pools are kept per requested size and a request is served by the
+  largest existing pool that satisfies it — distinct sizes are few (one
+  per generator config), so idle-thread cost stays bounded."""
   with _DECODE_POOL_LOCK:
-    if _DECODE_POOL is None or _DECODE_POOL._max_workers < workers:  # pylint: disable=protected-access
+    best = max((w for w in _DECODE_POOLS if w >= workers), default=None)
+    if best is None:
       import concurrent.futures
 
-      if _DECODE_POOL is not None:
-        _DECODE_POOL.shutdown(wait=False)  # don't leak the smaller pool
-      _DECODE_POOL = concurrent.futures.ThreadPoolExecutor(
+      _DECODE_POOLS[workers] = concurrent.futures.ThreadPoolExecutor(
           max_workers=workers, thread_name_prefix='t2r-decode')
-    return _DECODE_POOL
+      best = workers
+    return _DECODE_POOLS[best]
 
 
 def make_native_parse_fn(feature_spec, label_spec=None,

@@ -13,6 +13,7 @@ endpoints returned as a dict instead of graph-name scraping.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -52,7 +53,8 @@ class _BatchNorm(nn.Module):
         dtype=x.dtype)(x)
 
 
-def _conv_fixed_padding(x, filters, kernel_size, strides, name=None):
+def _conv_fixed_padding(x, filters, kernel_size, strides, name=None,
+                        dtype=None):
   """Strided convs use explicit symmetric padding (resnet fixed_padding)."""
   if strides > 1:
     pad_total = kernel_size - 1
@@ -68,6 +70,7 @@ def _conv_fixed_padding(x, filters, kernel_size, strides, name=None):
       strides=(strides, strides),
       padding=padding,
       use_bias=False,
+      dtype=dtype,
       kernel_init=nn.initializers.variance_scaling(
           2.0, 'fan_out', 'truncated_normal'),
       name=name)(x)
@@ -81,32 +84,34 @@ class _Block(nn.Module):
   bottleneck: bool
   version: int
   project_shortcut: bool
+  # Activation/compute dtype (bfloat16 on TPU); params stay float32 via
+  # flax's param_dtype default, and _BatchNorm statistics are computed in
+  # float32 internally by flax regardless of this dtype.
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x, film_gamma_beta, train: bool):
     shortcut = x
     out_filters = self.filters * (4 if self.bottleneck else 1)
+    conv = functools.partial(_conv_fixed_padding, dtype=self.dtype)
 
     if self.version == 2:
       # v2: pre-activation; projection taken from the pre-activated input.
       pre = _BatchNorm()(x, train)
       pre = nn.relu(pre)
       if self.project_shortcut:
-        shortcut = _conv_fixed_padding(pre, out_filters, 1, self.strides,
-                                       name='proj')
+        shortcut = conv(pre, out_filters, 1, self.strides, name='proj')
       net = pre
       if self.bottleneck:
-        net = _conv_fixed_padding(net, self.filters, 1, 1, name='conv1')
+        net = conv(net, self.filters, 1, 1, name='conv1')
         net = nn.relu(_BatchNorm()(net, train))
-        net = _conv_fixed_padding(net, self.filters, 3, self.strides,
-                                  name='conv2')
+        net = conv(net, self.filters, 3, self.strides, name='conv2')
         net = nn.relu(_BatchNorm()(net, train))
-        net = _conv_fixed_padding(net, out_filters, 1, 1, name='conv3')
+        net = conv(net, out_filters, 1, 1, name='conv3')
       else:
-        net = _conv_fixed_padding(net, self.filters, 3, self.strides,
-                                  name='conv1')
+        net = conv(net, self.filters, 3, self.strides, name='conv1')
         net = nn.relu(_BatchNorm()(net, train))
-        net = _conv_fixed_padding(net, out_filters, 3, 1, name='conv2')
+        net = conv(net, out_filters, 3, 1, name='conv2')
       # FiLM on the block output before the residual add
       # (film_resnet_model.py:219-222, applied pre-shortcut in v2).
       net = apply_film(net, film_gamma_beta)
@@ -114,23 +119,20 @@ class _Block(nn.Module):
 
     # v1: post-activation.
     if self.project_shortcut:
-      shortcut = _conv_fixed_padding(x, out_filters, 1, self.strides,
-                                     name='proj')
+      shortcut = conv(x, out_filters, 1, self.strides, name='proj')
       shortcut = _BatchNorm()(shortcut, train)
     net = x
     if self.bottleneck:
-      net = _conv_fixed_padding(net, self.filters, 1, 1, name='conv1')
+      net = conv(net, self.filters, 1, 1, name='conv1')
       net = nn.relu(_BatchNorm()(net, train))
-      net = _conv_fixed_padding(net, self.filters, 3, self.strides,
-                                name='conv2')
+      net = conv(net, self.filters, 3, self.strides, name='conv2')
       net = nn.relu(_BatchNorm()(net, train))
-      net = _conv_fixed_padding(net, out_filters, 1, 1, name='conv3')
+      net = conv(net, out_filters, 1, 1, name='conv3')
       net = _BatchNorm()(net, train)
     else:
-      net = _conv_fixed_padding(net, self.filters, 3, self.strides,
-                                name='conv1')
+      net = conv(net, self.filters, 3, self.strides, name='conv1')
       net = nn.relu(_BatchNorm()(net, train))
-      net = _conv_fixed_padding(net, out_filters, 3, 1, name='conv2')
+      net = conv(net, out_filters, 3, 1, name='conv2')
       net = _BatchNorm()(net, train)
     # FiLM before the final ReLU (film_resnet_model.py:166-173).
     net = apply_film(net, film_gamma_beta)
@@ -156,6 +158,11 @@ class ResNet(nn.Module):
   version: int = 2
   first_pool: bool = True
   include_initial_layers: bool = True
+  # Activation/compute dtype: bfloat16 on TPU keeps the convs on the MXU's
+  # native input dtype (params stay float32; flax BatchNorm computes its
+  # statistics in float32 internally). None → follow input/param promotion
+  # (float32 params ⇒ float32 compute).
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self,
@@ -168,10 +175,10 @@ class ResNet(nn.Module):
       film_gamma_betas = [[None] * n for n in block_sizes]
     endpoints: Dict[str, Any] = {}
 
-    net = images
+    net = images if self.dtype is None else images.astype(self.dtype)
     if self.include_initial_layers:
       net = _conv_fixed_padding(net, self.num_filters, 7, 2,
-                                name='initial_conv')
+                                name='initial_conv', dtype=self.dtype)
       if self.version == 1:
         net = nn.relu(_BatchNorm()(net, train))
       endpoints['initial_conv'] = net
@@ -191,6 +198,7 @@ class ResNet(nn.Module):
             bottleneck=bottleneck,
             version=self.version,
             project_shortcut=(j == 0),
+            dtype=self.dtype,
             name=f'block_layer{i + 1}_block{j}')(
                 net, film_gamma_betas[i][j], train)
       endpoints[f'block_layer{i + 1}'] = net
@@ -201,7 +209,8 @@ class ResNet(nn.Module):
     net = jnp.mean(net, axis=(1, 2))
     endpoints['final_reduce_mean'] = net
     if self.num_classes is not None:
-      net = nn.Dense(self.num_classes, name='final_dense')(net)
+      net = nn.Dense(self.num_classes, dtype=self.dtype, name='final_dense')(
+          net)
       endpoints['final_dense'] = net
     return net, endpoints
 
@@ -255,6 +264,7 @@ class FilmResNet(nn.Module):
   num_classes: Optional[int] = None
   version: int = 2
   enabled_block_layers: Optional[Sequence[bool]] = None
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, images, embedding=None, train: bool = False):
@@ -262,6 +272,7 @@ class FilmResNet(nn.Module):
         resnet_size=self.resnet_size,
         num_classes=self.num_classes,
         version=self.version,
+        dtype=self.dtype,
         name='resnet')
     film_gamma_betas = None
     if embedding is not None:

@@ -54,7 +54,19 @@ class Policy(abc.ABC):
 
 
 class CEMPolicy(Policy):
-  """CEM argmax over a critic's q_predicted (policies.py:111-190)."""
+  """CEM argmax over a critic's q_predicted (policies.py:111-190).
+
+  ``device_resident=True`` runs the ENTIRE CEM loop (sample → critic →
+  elite refit × ``cem_iters``) as one jitted XLA program against the
+  predictor's traceable serving fn (``device_serving_fn``): one device
+  dispatch and one state-image h2d per robot action, instead of
+  ``cem_iters`` numpy round trips each re-uploading the state tiled
+  ``cem_samples`` times. Selection is identical to the numpy path given
+  the same noise (same elite refit, argmax). Requires a model declaring
+  ``get_state_specification``/``get_action_specification`` (the
+  CriticModel family) whose ``pack_features`` lays actions out as
+  ``action/<key>`` slices of the flat action vector in spec order.
+  """
 
   def __init__(self,
                t2r_model,
@@ -63,6 +75,7 @@ class CEMPolicy(Policy):
                cem_samples: int = 64,
                num_elites: int = 10,
                pack_fn: Optional[Callable] = None,
+               device_resident: bool = False,
                **parent_kwargs):
     super().__init__(**parent_kwargs)
     self._t2r_model = t2r_model
@@ -70,12 +83,20 @@ class CEMPolicy(Policy):
     self._cem_iters = cem_iters
     self._cem_samples = cem_samples
     self._num_elites = num_elites
+    self._device_resident = device_resident
+    self._device_cem = None  # (serving_fn identity, jitted CEM program)
     self.sample_fn = self._default_sample_fn
     self.pack_fn = pack_fn or self._default_pack_fn
 
   def _default_sample_fn(self, mean, stddev):
     return mean + stddev * np.random.standard_normal(
         (self._cem_samples, self._action_size))
+
+  def _draw_noise(self, shape):
+    """Noise for the device path. One standard_normal(I, S, A) fill is
+    the same np.random stream as the numpy path's per-iteration
+    standard_normal(S, A) draws, so seeded runs match across paths."""
+    return np.random.standard_normal(shape).astype(np.float32)
 
   def _default_pack_fn(self, t2r_model, state, context, timestep, samples):
     del context
@@ -106,7 +127,101 @@ class CEMPolicy(Policy):
     }
     return np.asarray(samples)[idx], debug
 
+  def _device_cem_run(self):
+    """Builds (and caches per serving fn) the jitted whole-CEM program."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensor2robot_tpu.specs import algebra
+
+    serving_fn, variables = self._predictor.device_serving_fn()
+    # Weights live ON DEVICE across calls: predictors keep host-side
+    # copies (hot-reload friendly), but re-uploading them through every
+    # SelectAction would dominate the action latency. Re-placed only
+    # when restore() swapped the variables object.
+    if self._device_cem is not None and self._device_cem[2] is variables:
+      device_variables = self._device_cem[3]
+    else:
+      device_variables = jax.device_put(variables)
+    if self._device_cem is None or self._device_cem[0] is not serving_fn:
+      action_spec = algebra.flatten_spec_structure(
+          self._t2r_model.get_action_specification())
+      # The flat action vector splits into action/<key> slices in spec
+      # order — the layout every CriticModel pack_features produces.
+      slices = []
+      offset = 0
+      for key, spec in action_spec.items():
+        size = int(np.prod(spec.shape))
+        slices.append((f'action/{key}', offset, offset + size,
+                       tuple(spec.shape)))
+        offset += size
+      if offset != self._action_size:
+        raise ValueError(
+            f'action specs cover {offset} dims, action_size is '
+            f'{self._action_size}.')
+      num_samples = self._cem_samples
+
+      def pack_device(state_features, samples):
+        packed = {
+            k: jnp.broadcast_to(v, (num_samples,) + tuple(v.shape[1:]))
+            for k, v in state_features.items()
+        }
+        for key, start, end, shape in slices:
+          packed[key] = samples[:, start:end].reshape((num_samples,) + shape)
+        return packed
+
+      def run(variables, state_features, noise, mean, stddev):
+        def objective(samples):
+          outputs = serving_fn(variables, pack_device(state_features,
+                                                      samples))
+          return outputs['q_predicted']
+
+        return cross_entropy.jit_normal_cem(
+            objective, self._num_elites, self._cem_iters)(noise, mean,
+                                                          stddev)
+
+      jitted = jax.jit(run)
+    else:
+      jitted = self._device_cem[1]
+    self._device_cem = (serving_fn, jitted, variables, device_variables)
+    return jitted, device_variables
+
+  def get_cem_action_device(self, state, context, timestep):
+    """Whole-CEM-on-device action selection; returns (action, debug)."""
+    if getattr(self.sample_fn, '__func__', None) is not (
+        CEMPolicy._default_sample_fn):
+      raise NotImplementedError(
+          'device_resident CEM samples on device (mean + stddev * normal '
+          'noise); a custom sample_fn would be silently ignored. Use '
+          'device_resident=False with custom samplers, or override '
+          '_draw_noise for custom noise.')
+    run, variables = self._device_cem_run()
+    # One 1-sample pack resolves the state keys/layout (dict or bare
+    # array states, model-specific key names) via the model's own
+    # packing; only the state/ entries are kept — actions are sliced on
+    # device from the sampled vectors.
+    probe = self.pack_fn(self._t2r_model, state, context, timestep,
+                         np.zeros((1, self._action_size), np.float32))
+    state_features = {
+        k: np.asarray(v) for k, v in probe.items() if k.startswith('state/')
+    }
+    noise = self._draw_noise(
+        (self._cem_iters, self._cem_samples, self._action_size))
+    best, value, mean, stddev = run(
+        variables, state_features, noise,
+        np.zeros(self._action_size, np.float32),
+        np.ones(self._action_size, np.float32))
+    debug = {
+        'q_predicted': float(value),
+        'final_params': {'mean': np.asarray(mean),
+                         'stddev': np.asarray(stddev)},
+    }
+    return np.asarray(best), debug
+
   def SelectAction(self, state, context, timestep):
+    if self._device_resident:
+      action, _ = self.get_cem_action_device(state, context, timestep)
+      return action
 
     def objective_fn(samples):
       np_inputs = self.pack_fn(self._t2r_model, state, context, timestep,
@@ -121,6 +236,12 @@ class LSTMCEMPolicy(CEMPolicy):
   """CEM with cached critic LSTM hidden state (policies.py:193-224)."""
 
   def __init__(self, hidden_state_size: int, **kwargs):
+    if kwargs.get('device_resident'):
+      # The hidden-state feedback (best sample's lstm state threads into
+      # the next SelectAction) is not wired through the jitted CEM
+      # program; accepting the flag would silently run the numpy path.
+      raise NotImplementedError(
+          'LSTMCEMPolicy does not support device_resident=True.')
     self._hidden_state_size = hidden_state_size
     super().__init__(**kwargs)
     self.reset()

@@ -61,6 +61,18 @@ class AbstractPredictor(abc.ABC):
     if not self.is_loaded:
       raise ValueError('The predictor has not been restored yet.')
 
+  def device_serving_fn(self):
+    """``(traceable_fn, variables)`` for composition inside a caller's jit.
+
+    ``traceable_fn(variables, features) -> outputs`` is the restored
+    serving chain as a jax-traceable callable (NOT a numpy wrapper), so
+    callers can close a whole control loop — e.g. the CEM
+    sample/evaluate/update cycle — into one XLA program around it.
+    Raises when this predictor flavor cannot expose one.
+    """
+    raise NotImplementedError(
+        f'{type(self).__name__} does not expose a traceable serving fn.')
+
   @property
   @abc.abstractmethod
   def is_loaded(self) -> bool:
@@ -90,6 +102,9 @@ class _JitForward:
           dict(variables), features_p, None, ModeKeys.PREDICT)
       return dict(model.create_export_outputs_fn(features_p, outputs))
 
+    # The un-jitted chain stays available for composition INSIDE a larger
+    # jitted program (the device-resident CEM loop).
+    self.traceable = forward
     self._fn = jax.jit(forward)
 
   def __call__(self, variables, features: Dict[str, np.ndarray]):
@@ -182,6 +197,10 @@ class CheckpointPredictor(AbstractPredictor):
     features = _expand_to_spec_rank(features, self._feature_spec)
     return self._forward(self._variables, features)
 
+  def device_serving_fn(self):
+    self.assert_is_loaded()
+    return self._forward.traceable, self._variables
+
   @property
   def is_loaded(self) -> bool:
     return self._variables is not None
@@ -213,6 +232,7 @@ class ExportedModelPredictor(AbstractPredictor):
     self._model_kwargs = model_kwargs
     self._timeout = timeout
     self._forward: Optional[Callable] = None
+    self._traceable: Optional[Callable] = None
     self._variables = None
     self._global_step = -1
     self._feature_spec: Optional[SpecStruct] = None
@@ -261,12 +281,16 @@ class ExportedModelPredictor(AbstractPredictor):
 
         serving_call = jax_export.deserialize(serving_bytes).call
 
+        def traceable(variables, features):
+          return dict(serving_call(
+              exporters_lib.to_plain_tree(variables), dict(features)))
+
         def forward(variables, features):
-          outputs = serving_call(
-              exporters_lib.to_plain_tree(variables), dict(features))
+          outputs = traceable(variables, features)
           return {k: np.asarray(v) for k, v in outputs.items()}
 
         self._forward = forward
+        self._traceable = traceable
         self._serving_digest = digest
     else:
       # Model-class fallback: the jitted forward only depends on the model
@@ -276,6 +300,7 @@ class ExportedModelPredictor(AbstractPredictor):
             export_dir, self._model_kwargs)
       if not isinstance(self._forward, _JitForward):
         self._forward = _JitForward(self._model)
+      self._traceable = self._forward.traceable
     self._variables = exporters_lib.load_state_from_export_dir(export_dir)
     self._feature_spec = algebra.filter_required_flat_tensor_spec(feature_spec)
     self._global_step = global_step
@@ -287,6 +312,10 @@ class ExportedModelPredictor(AbstractPredictor):
     self.assert_is_loaded()
     features = _expand_to_spec_rank(features, self._feature_spec)
     return self._forward(self._variables, features)
+
+  def device_serving_fn(self):
+    self.assert_is_loaded()
+    return self._traceable, self._variables
 
   def predict_example_bytes(self, serialized_examples) -> Dict[str, Any]:
     """Serialized tf.Example bytes → actions (the tf_example receiver).

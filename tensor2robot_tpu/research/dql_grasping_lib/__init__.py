@@ -2,7 +2,6 @@
 
 from tensor2robot_tpu.research.dql_grasping_lib.grasping_modules import (
     add_context,
-    conv_defaults,
     tile_to_match_context,
 )
 from tensor2robot_tpu.research.dql_grasping_lib.run_env import run_env

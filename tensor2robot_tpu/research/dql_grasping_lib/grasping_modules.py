@@ -6,36 +6,20 @@ CEM-megabatch context helpers that merge a conv feature map with a batch
 of per-sample action contexts. Pure ``jnp`` functions — no graph scopes.
 
 The reference's third export, ``argscope`` (``tf_modules.py:28-46``), is
-a tf-slim global-defaults mechanism (truncated-normal init, relu,
-layer-norm, stride-2 VALID convs) with no idiomatic JAX equivalent:
-Flax modules take their init/normalizer/stride as explicit constructor
-arguments, and the grasping towers in
-:mod:`tensor2robot_tpu.research.qtopt.networks` declare exactly those
-defaults inline where the reference would have pulled them from the
-scope. :func:`conv_defaults` records the same defaults as plain kwargs
-for modules that want them.
+deliberately waived: it is a tf-slim global-defaults mechanism
+(truncated-normal(0.01) init, relu, layer-norm, stride-2 VALID convs)
+with no idiomatic JAX equivalent. Flax modules take their
+init/normalizer/stride as explicit constructor arguments, and the
+grasping towers in :mod:`tensor2robot_tpu.research.qtopt.networks`
+declare exactly those defaults inline (e.g. ``_ConvBN``'s
+``truncated_normal(stddev=0.01)``) where the reference would have pulled
+them from the scope — so the capability exists at every use site and a
+kwargs-bundle re-export would have no consumer.
 """
 
 from __future__ import annotations
 
-from typing import Dict
-
-import flax.linen as nn
 import jax.numpy as jnp
-
-
-def conv_defaults(stddev: float = 0.01) -> Dict:
-  """The reference argscope's conv/fc defaults, as explicit Flax kwargs.
-
-  ``tf_modules.py:38-46``: truncated-normal(0.01) weight init; stride-2
-  VALID convs (the activation/normalizer are applied by the caller, as
-  everywhere in this framework's explicit module style).
-  """
-  return {
-      'kernel_init': nn.initializers.truncated_normal(stddev=stddev),
-      'strides': (2, 2),
-      'padding': 'VALID',
-  }
 
 
 def tile_to_match_context(net: jnp.ndarray,

@@ -129,8 +129,13 @@ class Grasp2VecModel(AbstractT2RModel):
     return SpecStruct()  # unsupervised
 
   def _modules(self):
-    return (networks.Embedding(resnet_size=self._resnet_size),
-            networks.Embedding(resnet_size=self._resnet_size))
+    # Towers compute in compute_dtype (bfloat16 on TPU — the reference's
+    # wholesale TPU cast, tpu_model_wrapper.py:105-118); the embedding
+    # vectors come back float32 and the loss head stays float32.
+    return (networks.Embedding(resnet_size=self._resnet_size,
+                               dtype=self.compute_dtype),
+            networks.Embedding(resnet_size=self._resnet_size,
+                               dtype=self.compute_dtype))
 
   def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
     features, _ = self.validated_features(features, mode)
@@ -139,9 +144,9 @@ class Grasp2VecModel(AbstractT2RModel):
     scene_images = jnp.concatenate(
         [features['pregrasp_image'], features['postgrasp_image']], axis=0)
     scene_vars = scene_module.init(
-        {'params': scene_rng}, scene_images.astype(jnp.float32))
+        {'params': scene_rng}, scene_images.astype(self.compute_dtype))
     goal_vars = goal_module.init(
-        {'params': goal_rng}, features['goal_image'].astype(jnp.float32))
+        {'params': goal_rng}, features['goal_image'].astype(self.compute_dtype))
     variables = {}
     for col in set(scene_vars) | set(goal_vars):
       variables[col] = {
@@ -161,8 +166,8 @@ class Grasp2VecModel(AbstractT2RModel):
     train = mode == ModeKeys.TRAIN
     scene_images = jnp.concatenate(
         [features['pregrasp_image'], features['postgrasp_image']],
-        axis=0).astype(jnp.float32)
-    goal_images = features['goal_image'].astype(jnp.float32)
+        axis=0).astype(self.compute_dtype)
+    goal_images = features['goal_image'].astype(self.compute_dtype)
 
     scene_vars = self._split_cols(variables, 'scene')
     goal_vars = self._split_cols(variables, 'goal')

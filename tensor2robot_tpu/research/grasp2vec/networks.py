@@ -9,7 +9,7 @@ vector. Reuses the framework ResNet instead of a private copy.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,16 +18,23 @@ from tensor2robot_tpu.layers.resnet import ResNet
 
 
 class Embedding(nn.Module):
-  """Scene/goal embedding: (mean-pooled vector, spatial map)."""
+  """Scene/goal embedding: (mean-pooled vector, spatial map).
+
+  ``dtype`` is the tower compute dtype (bfloat16 on TPU, the reference's
+  wholesale TPU cast ``models/tpu_model_wrapper.py:105-118``); the pooled
+  embedding *vector* is always reduced and returned in float32 — it feeds
+  the numerically sensitive embedding-arithmetic losses.
+  """
 
   resnet_size: int = 50
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, image: jnp.ndarray,
                train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     _, endpoints = ResNet(
-        resnet_size=self.resnet_size, num_classes=None, name='resnet')(
-            image, train=train)
+        resnet_size=self.resnet_size, num_classes=None, dtype=self.dtype,
+        name='resnet')(image, train=train)
     spatial = nn.relu(endpoints['pre_final_pool'])
-    summed = jnp.mean(spatial, axis=(1, 2))
+    summed = jnp.mean(spatial.astype(jnp.float32), axis=(1, 2))
     return summed, spatial

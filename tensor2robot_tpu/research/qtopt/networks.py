@@ -78,9 +78,13 @@ class _PooledBatchNormRelu(nn.Module):
   Why: profiled on v5e, the conv1-region BN apply/backward chains moved
   456 MB per pass over the [32,236,236,64] activation at 2.2–2.5× their
   bandwidth bound (see PERF_NOTES.md); applying the normalize after the
-  3×3/s3 pool shrinks those passes 9×. Variable layout matches
-  ``nn.BatchNorm(use_scale=False)`` (params/bias,
-  batch_stats/{mean,var}) so checkpoints interchange.
+  3×3/s3 pool shrinks those passes 9×. This module's OWN variable layout
+  matches ``nn.BatchNorm(use_scale=False)`` (params/bias,
+  batch_stats/{mean,var}) — but that interchange is module-local only:
+  within ``Grasping44`` the explicit name shifts subsequent auto-numbered
+  BatchNorms and the bias-removal rewrite drops conv/dense bias params,
+  so checkpoints written before these rewrites do not load into the new
+  tree without a key remap.
   """
 
   momentum: float = 0.9997

@@ -161,14 +161,24 @@ class _DevicePrefetcher:
       item = self._consumer_place(item)
     return item
 
-  def close(self) -> None:
+  def close(self, timeout: float = 10.0) -> None:
     import queue
+    import time
 
     self._stop.set()
     # Keep draining until the worker exits: a single drain is not enough
     # (the worker's blocked put() refills the slot, and its final
-    # put(_DONE) could block forever on a depth-1 queue).
+    # put(_DONE) could block forever on a depth-1 queue). Bounded: if the
+    # worker is stuck inside the input iterator's next() (stalled
+    # producer), it can never observe the stop event — abandon the daemon
+    # thread rather than hang end-of-training shutdown.
+    deadline = time.monotonic() + timeout
     while self._thread.is_alive():
+      if time.monotonic() > deadline:
+        logging.warning(
+            'Prefetch worker did not exit within %.1fs (input iterator '
+            'blocked?); abandoning the daemon thread.', timeout)
+        break
       try:
         self._q.get(timeout=0.05)
       except queue.Empty:
@@ -405,7 +415,7 @@ class Trainer:
           scalars['steps_per_sec'] = config.log_interval_steps / max(dt, 1e-9)
         for cb in self._callbacks:
           cb.after_step(self, step, scalars)
-        if (self._manager is not None and
+        if (self._manager is not None and config.save_interval_steps and
             step % config.save_interval_steps == 0):
           self.save_checkpoint()
         if (eval_iter_fn is not None and config.eval_interval_steps and

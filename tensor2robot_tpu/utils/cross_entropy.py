@@ -81,6 +81,44 @@ def normal_cross_entropy_method(objective_fn,
   return final_params['mean'], final_params['stddev']
 
 
+def jit_normal_cem(objective_fn: Callable,
+                   num_elites: int,
+                   num_iterations: int) -> Callable:
+  """Traceable whole-CEM body: sample → objective → elite refit, on device.
+
+  The device-resident counterpart of :func:`normal_cross_entropy_method`
+  (the reference's serving hot loop runs sample/predict/update through
+  numpy + a predictor round trip per iteration,
+  ``/root/reference/policies/policies.py:139-172``; here the whole loop
+  lives inside one XLA program, so a robot action costs a single device
+  dispatch).
+
+  ``objective_fn(samples [S, A]) -> values [S]`` must be jax-traceable
+  (e.g. a restored serving fn closed over device-resident weights).
+  Returns ``run(noise [I, S, A], mean [A], stddev [A]) -> (best_sample,
+  best_value, mean, stddev)``; callers jit it. Elite refit matches the
+  numpy path exactly: top-``num_elites`` by value, mean/std with
+  Bessel's correction — so with the same noise both paths select the
+  same action.
+  """
+  import jax
+  import jax.numpy as jnp
+
+  def run(noise, mean, stddev):
+    samples = values = None
+    for i in range(num_iterations):  # static unroll: iters is tiny (≤5)
+      samples = mean + stddev * noise[i]
+      values = objective_fn(samples).reshape(-1).astype(jnp.float32)
+      _, elite_idx = jax.lax.top_k(values, num_elites)
+      elites = samples[elite_idx]
+      mean = jnp.mean(elites, axis=0)
+      stddev = jnp.std(elites, axis=0, ddof=1)
+    best = jnp.argmax(values)
+    return samples[best], values[best], mean, stddev
+
+  return run
+
+
 # Reference-name aliases.
 CrossEntropyMethod = cross_entropy_method
 NormalCrossEntropyMethod = normal_cross_entropy_method

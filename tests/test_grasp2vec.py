@@ -109,3 +109,93 @@ class TestGrasp2VecModel:
     in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
     assert in_spec['pregrasp_image'].shape == (512, 640, 3)
     assert in_spec['pregrasp_image'].dtype == np.uint8
+
+  def test_bf16_towers_keep_f32_embeddings(self):
+    """device_type='tpu' → towers compute bf16, embedding vectors float32."""
+    model = Grasp2VecModel(
+        scene_size=(48, 48), goal_size=(48, 48), resnet_size=18,
+        device_type='tpu')
+    features = _random_features(model, batch=2, seed=0)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, None, ModeKeys.TRAIN)
+    # Loss head inputs stay float32 (numerically sensitive arithmetic).
+    assert outputs['pre_vector'].dtype == jnp.float32
+    assert outputs['goal_vector'].dtype == jnp.float32
+    # Tower activations (spatial maps) are bfloat16 — MXU-native.
+    assert outputs['pre_spatial'].dtype == jnp.bfloat16
+    # Params stay float32 (param_dtype default).
+    leaf = jax.tree_util.tree_leaves(variables['params'])[0]
+    assert leaf.dtype == jnp.float32
+
+  @pytest.mark.parametrize('loss_name', ['npairs', 'triplet', 'l2'])
+  def test_bf16_losses_converge_to_f32_parity(self, loss_name):
+    """bf16 towers converge like f32 towers on all three loss families.
+
+    The round-3 waiver said the embedding-arithmetic losses were too
+    'numerically sensitive' for bf16 — this makes it a number: same fixed
+    batch, same seeds, N adam steps in each dtype; both must descend and
+    land close.
+    """
+    loss_fn = {
+        'npairs': losses.npairs_loss,
+        'triplet': losses.triplet_loss,
+        'l2': lambda pre, goal, post: losses.l2_arithmetic_loss(
+            pre, goal, post, jnp.ones((pre.shape[0],), jnp.int32)),
+    }[loss_name]
+    histories = {}
+    for device_type in ('tpu', 'cpu'):  # tpu → bf16 towers, cpu → f32
+      model = Grasp2VecModel(
+          scene_size=(48, 48), goal_size=(48, 48), resnet_size=18,
+          embedding_loss_fn=loss_fn, device_type=device_type)
+      histories[device_type] = _train_losses(model, steps=25)
+    for device_type, history in histories.items():
+      assert np.all(np.isfinite(history)), (device_type, history)
+      assert history[-1] < history[0] * 0.8, (device_type, history)
+    # bf16 tracks f32 to within a loose relative band on the smoke
+    # workload — loss scales differ per family, so compare the achieved
+    # *reduction*, which is what training cares about.
+    red_f32 = histories['cpu'][0] - histories['cpu'][-1]
+    red_bf16 = histories['tpu'][0] - histories['tpu'][-1]
+    assert red_bf16 > 0.5 * red_f32, (histories['cpu'], histories['tpu'])
+
+
+def _random_features(model, batch, seed):
+  from tensor2robot_tpu.specs import make_random_numpy
+
+  spec = model.preprocessor.get_out_feature_specification(ModeKeys.TRAIN)
+  features = make_random_numpy(spec, batch_size=batch, seed=seed)
+  return {k: jnp.asarray(v) for k, v in features.items()}
+
+
+def _train_losses(model, steps, batch=4):
+  """Adam descent on one fixed batch; returns the loss history."""
+  import optax
+
+  features = _random_features(model, batch=batch, seed=7)
+  variables = model.init_variables(jax.random.PRNGKey(1), features)
+  tx = optax.adam(1e-3)
+  opt_state = tx.init(variables['params'])
+
+  @jax.jit
+  def step(variables, opt_state):
+    def loss_fn(params):
+      v = dict(variables)
+      v['params'] = params
+      outputs, new_vars = model.inference_network_fn(
+          v, features, None, ModeKeys.TRAIN)
+      loss, _ = model.model_train_fn(features, None, outputs, ModeKeys.TRAIN)
+      return loss, new_vars
+
+    (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables['params'])
+    updates, opt_state = tx.update(grads, opt_state, variables['params'])
+    new_vars = dict(new_vars)
+    new_vars['params'] = optax.apply_updates(variables['params'], updates)
+    return new_vars, opt_state, loss
+
+  history = []
+  for _ in range(steps):
+    variables, opt_state, loss = step(variables, opt_state)
+    history.append(float(loss))
+  return np.asarray(history)

@@ -247,6 +247,57 @@ class TestPoseEnvPolicies:
     action = policy.SelectAction(obs, None, 0)
     assert np.asarray(action).shape == (2,)
 
+  def test_device_cem_policy_matches_numpy_path(self, tmp_path):
+    """Same rng → the jitted whole-CEM program selects the SAME action as
+    the numpy sample/predict/update loop (round-3 verdict #6)."""
+    model = PoseEnvContinuousMCModel(device_type='cpu')
+    predictor = CheckpointPredictor(model, model_dir=str(tmp_path / 'none'))
+    predictor.init_randomly()
+    kwargs = dict(t2r_model=model, predictor=predictor, action_size=2,
+                  cem_samples=16, cem_iters=3, num_elites=4)
+    numpy_policy = CEMPolicy(**kwargs)
+    device_policy = CEMPolicy(device_resident=True, **kwargs)
+    env = PoseToyEnv(seed=11)
+    obs = env.reset()
+    np.random.seed(123)
+    action_numpy = numpy_policy.SelectAction(obs, None, 0)
+    np.random.seed(123)
+    action_device = device_policy.SelectAction(obs, None, 0)
+    np.testing.assert_allclose(
+        np.asarray(action_device), np.asarray(action_numpy),
+        rtol=1e-5, atol=1e-5)
+
+  def test_device_cem_policy_exported_predictor(self, tmp_path):
+    """The device CEM also composes with a restored EXPORT's serving fn
+    (the self-contained StableHLO path a robot host actually runs)."""
+    import jax
+
+    from tensor2robot_tpu.export.exporters import ModelExporter
+    from tensor2robot_tpu.predictors import ExportedModelPredictor
+    from tensor2robot_tpu.specs import make_random_numpy
+    from tensor2robot_tpu.train import train_state as ts_lib
+
+    model = PoseEnvContinuousMCModel(device_type='cpu')
+    features = make_random_numpy(
+        model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT),
+        batch_size=1)
+    features_p, _ = model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT, None)
+    state = ts_lib.create_train_state(
+        model, model.create_optimizer(), jax.random.PRNGKey(0),
+        features_p, ModeKeys.PREDICT)
+    export_root = str(tmp_path / 'export')
+    ModelExporter().export(model, state, export_root)
+    predictor = ExportedModelPredictor(export_root)
+    assert predictor.restore()
+    policy = CEMPolicy(
+        t2r_model=model, predictor=predictor, device_resident=True,
+        action_size=2, cem_samples=16, cem_iters=2, num_elites=4)
+    env = PoseToyEnv(seed=12)
+    action = policy.SelectAction(env.reset(), None, 0)
+    assert np.asarray(action).shape == (2,)
+    assert np.all(np.isfinite(np.asarray(action)))
+
   def test_collect_writes_replay(self, tmp_path):
     env = PoseToyEnv(seed=10)
     policy = PoseEnvRandomPolicy()
@@ -257,3 +308,80 @@ class TestPoseEnvPolicies:
         replay_writer=writer, root_dir=str(tmp_path), tag='collect')
     files = glob.glob(str(tmp_path / 'policy_collect' / '*.tfrecord'))
     assert len(files) == 1
+
+
+class TestContinuousCollectTrainLoop:
+  """The reference's fundamental distributed pattern in ONE test
+  (``/root/reference/utils/continuous_collect_eval.py:85-112``):
+  train → async export → exported-predictor hot-reload → CEM collect →
+  replay tfrecords → a second training phase consumes them."""
+
+  def test_train_export_collect_retrain(self, tmp_path):
+    import functools
+
+    from tensor2robot_tpu.export import exporters as export_lib
+    from tensor2robot_tpu.export.async_export import AsyncExportCallback
+    from tensor2robot_tpu.predictors import ExportedModelPredictor
+    from tensor2robot_tpu.train import Trainer, TrainerConfig
+    from tensor2robot_tpu.utils.continuous_collect_eval import (
+        collect_eval_loop)
+
+    model_dir = str(tmp_path / 'm')
+    model = PoseEnvContinuousMCModel(device_type='tpu')
+
+    # Phase 1 — the trainer binary's path: MC critic trains on the
+    # checked-in transition records; the async export callback publishes
+    # a versioned serving export after the checkpoint save.
+    gen = DefaultRecordInputGenerator(file_patterns=TEST_DATA, batch_size=8)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    callback = AsyncExportCallback()
+    config = TrainerConfig(
+        model_dir=model_dir, max_train_steps=2, save_interval_steps=2,
+        eval_interval_steps=0, log_interval_steps=0, async_checkpoints=False)
+    trainer = Trainer(model, config, callbacks=[callback])
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    callback.join()
+    export_root = os.path.join(model_dir, 'export', 'latest_exporter_numpy')
+    assert export_lib.valid_export_dirs(export_root)
+
+    # Robot side — the collect binary's loop: the policy hot-reloads the
+    # export (restore() inside collect_eval_loop), CEM selects actions,
+    # and the replay writer drops transition tfrecords under
+    # policy_collect/.
+    def policy_class():
+      predictor = ExportedModelPredictor(export_root, t2r_model=model)
+      return CEMPolicy(
+          t2r_model=model, predictor=predictor, action_size=2,
+          cem_samples=8, cem_iters=1, num_elites=2)
+
+    collect_eval_loop(
+        collect_env=PoseToyEnv(seed=13),
+        eval_env=None,
+        policy_class=policy_class,
+        num_collect=3,
+        run_agent_fn=functools.partial(
+            dql_grasping_lib.run_env,
+            episode_to_transitions_fn=episode_to_transitions_pose_toy,
+            replay_writer=TFRecordReplayWriter()),
+        root_dir=str(tmp_path),
+        max_steps=1)
+    # collect_eval_loop hands run_env <root>/policy_collect as its root;
+    # run_env nests its own policy_<tag>/ below that.
+    records = glob.glob(
+        str(tmp_path / 'policy_collect' / '**' / '*.tfrecord*'),
+        recursive=True)
+    assert records, list(tmp_path.rglob('*'))
+
+    # Phase 2 — the trainer consumes ONLY the freshly collected records
+    # (training would fail if collection had produced nothing usable).
+    gen2 = DefaultRecordInputGenerator(file_patterns=records[0], batch_size=4)
+    gen2.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(gen2.create_iterator(ModeKeys.TRAIN))
+    assert labels['reward'].shape == (4, 1)
+    config2 = TrainerConfig(
+        model_dir=str(tmp_path / 'm2'), max_train_steps=2,
+        save_interval_steps=0, eval_interval_steps=0, log_interval_steps=0,
+        async_checkpoints=False)
+    trainer2 = Trainer(model, config2)
+    trainer2.train(gen2.create_iterator(ModeKeys.TRAIN), None)
+    assert trainer2.step == 2

@@ -117,16 +117,6 @@ class TestGraspingModules:
     with pytest.raises(ValueError, match='channels'):
       add_context(jnp.zeros((2, 4, 4, 8)), jnp.zeros((2, 7)))
 
-  def test_conv_defaults_shape(self):
-    from tensor2robot_tpu.research.dql_grasping_lib import conv_defaults
-    import flax.linen as nn
-
-    kwargs = conv_defaults()
-    conv = nn.Conv(features=4, kernel_size=(3, 3), **kwargs)
-    x = jnp.ones((1, 9, 9, 3))
-    variables = conv.init(jax.random.PRNGKey(0), x)
-    y = conv.apply(variables, x)
-    assert y.shape == (1, 4, 4, 4)  # stride-2 VALID
 
 
 class TestPooledBatchNormRelu:

@@ -109,7 +109,15 @@ def measure_wtl_vision() -> float:
   return _steps_per_sec(model, batch_size=4)
 
 
-def measure_pose_env_maml() -> float:
+def measure_pose_env_maml(batch_size: int = 64) -> float:
+  """MAML steps/s at a COMPUTE-BOUND configuration.
+
+  The original batch-4 anchor was sub-millisecond device time — a
+  dispatch-latency measure of the tunneled backend (76–381 steps/s
+  across runs), useless for regression detection. Batch 64 task-batches
+  put the step at several ms of device time, so the recorded number
+  tracks compute.
+  """
   from tensor2robot_tpu.meta_learning import MAMLModel
   from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModelMAML
   from tensor2robot_tpu.research.pose_env.pose_env_models import (
@@ -118,7 +126,19 @@ def measure_pose_env_maml() -> float:
   model = PoseEnvRegressionModelMAML(
       base_model=PoseEnvRegressionModel(device_type='tpu'),
       num_inner_loop_steps=1)
-  return _steps_per_sec(model, batch_size=4)
+  return _steps_per_sec(model, batch_size=batch_size)
+
+
+def measure_qtopt_batch128() -> float:
+  """Secondary QT-Opt number at batch 128 (the batch-32 bench.py
+  headline stays the primary metric). Measured r4: 2.255 steps/s —
+  the conv1-region activations at batch 128 press the 16 GB HBM and
+  per-example throughput drops ~6× vs batch 32, refuting the earlier
+  amortization hypothesis (see PERF_NOTES 'levers')."""
+  from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+
+  return _steps_per_sec(GraspingModelWrapper(device_type='tpu'),
+                        batch_size=128, steps=30)
 
 
 def main():
@@ -140,10 +160,15 @@ def main():
   measured['wtl_vision_steps_per_sec_per_chip'] = round(
       measure_wtl_vision(), 3)
   print(f"  {measured['wtl_vision_steps_per_sec_per_chip']}", flush=True)
-  print('pose_env maml steps/sec ...', flush=True)
-  measured['pose_env_maml_steps_per_sec_per_chip'] = round(
+  print('pose_env maml steps/sec (batch 64, compute-bound) ...', flush=True)
+  measured['pose_env_maml_steps_per_sec_per_chip_batch64'] = round(
       measure_pose_env_maml(), 3)
-  print(f"  {measured['pose_env_maml_steps_per_sec_per_chip']}", flush=True)
+  print(f"  {measured['pose_env_maml_steps_per_sec_per_chip_batch64']}",
+        flush=True)
+  print('qtopt batch-128 steps/sec (secondary) ...', flush=True)
+  measured['qtopt_steps_per_sec_per_chip_batch128'] = round(
+      measure_qtopt_batch128(), 3)
+  print(f"  {measured['qtopt_steps_per_sec_per_chip_batch128']}", flush=True)
 
   print(json.dumps(measured, indent=2))
   if on_tpu:
