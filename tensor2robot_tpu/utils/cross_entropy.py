@@ -99,7 +99,9 @@ def jit_normal_cem(objective_fn: Callable,
   best_value, mean, stddev)``; callers jit it. Elite refit matches the
   numpy path exactly: top-``num_elites`` by value, mean/std with
   Bessel's correction — so with the same noise both paths select the
-  same action.
+  same action, up to exact value TIES (``np.argsort``'s last-k and
+  ``lax.top_k``'s first-k pick differently-ordered elites when
+  candidates score identically, e.g. an untrained critic).
   """
   import jax
   import jax.numpy as jnp

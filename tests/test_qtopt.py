@@ -81,6 +81,55 @@ class TestGraspingModelWrapper:
     assert packed['state/image'].shape == (4, 472, 472, 3)
     assert packed['action/height_to_bottom'].shape == (4, 1)
 
+  def test_device_cem_matches_numpy_on_multikey_actions(self, tmp_path):
+    """Device-resident CEM on the grasping critic: the 5-dim action
+    vector slices into TWO action keys (world_vector + rotation) on
+    device, the device objective is numerically identical to the numpy
+    pack+predict path, and both loops select an argmax-valued action.
+
+    An UNTRAINED Grasping44 scores every candidate within f32 epsilon
+    of 0.5 (stacked 0.01-std inits annihilate the action's influence),
+    so exact-action parity is a tie-break coin flip here (np.argsort
+    vs lax.top_k — see jit_normal_cem); the pose_env parity test covers
+    exact action equality where scores are distinct."""
+    from tensor2robot_tpu.policies import CEMPolicy
+    from tensor2robot_tpu.predictors import CheckpointPredictor
+
+    model = GraspingModelWrapper(
+        device_type='cpu', input_shape=(96, 112, 3), target_shape=(80, 80),
+        num_convs=(2, 2, 1))
+    predictor = CheckpointPredictor(model, model_dir=str(tmp_path / 'none'))
+    predictor.init_randomly()
+    kwargs = dict(t2r_model=model, predictor=predictor, action_size=5,
+                  cem_samples=8, cem_iters=2, num_elites=3)
+    state = np.random.RandomState(0).randint(
+        0, 255, (96, 112, 3), dtype=np.int64).astype(np.uint8)
+
+    # Objective parity on one shared sample batch: numpy pack+predict
+    # vs the traceable serving fn over the device pack (the same seam
+    # the jitted CEM closes over).
+    samples = np.random.RandomState(1).randn(8, 5).astype(np.float32)
+    q_numpy = np.asarray(predictor.predict(
+        model.pack_features(state, samples, 0))['q_predicted'])
+    dev_policy = CEMPolicy(device_resident=True, **kwargs)
+    fn, variables = predictor.device_serving_fn()
+    q_device = np.asarray(fn(variables, dict(
+        model.pack_features(state, samples, 0)))['q_predicted'])
+    np.testing.assert_allclose(q_device, q_numpy, atol=1e-6)
+
+    # Both whole-loop paths return an action scoring at the shared max.
+    np.random.seed(7)
+    a_np = CEMPolicy(**kwargs).SelectAction(state, None, 0)
+    np.random.seed(7)
+    a_dev = dev_policy.SelectAction(state, None, 0)
+    assert np.asarray(a_dev).shape == (5,)
+
+    def q_of(action):
+      packed = model.pack_features(state, np.asarray(action)[None], 0)
+      return float(np.asarray(predictor.predict(packed)['q_predicted'])[0])
+
+    assert abs(q_of(a_dev) - q_of(a_np)) < 1e-5, (a_dev, a_np)
+
 
 class TestGraspingModules:
   """Grasping context-merge helpers (ref dql_grasping_lib/tf_modules.py)."""
