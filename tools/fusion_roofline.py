@@ -8,7 +8,9 @@ and HBM bytes (fusion operands + outputs, ignoring cache reuse).
 
 Usage::
 
-    python tools/fusion_roofline.py          # traces the bench train step
+    python tools/fusion_roofline.py            # qtopt (the bench step)
+    python tools/fusion_roofline.py grasp2vec  # batch-16 bf16 towers
+    python tools/fusion_roofline.py wtl        # batch-32 vision trial
 """
 
 from __future__ import annotations
@@ -240,19 +242,39 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
   return total / 1e9, {k: v / 1e9 for k, v in ops.items()}
 
 
-def main():
+def _build_workload(name: str):
+  """(model, batch_size) for each profiled workload; batch sizes match
+  the PERF_NOTES / BASELINE.json recording configurations."""
+  if name == 'qtopt':
+    from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+
+    return GraspingModelWrapper(device_type='tpu'), 32
+  if name == 'grasp2vec':
+    from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
+
+    return Grasp2VecModel(device_type='tpu'), 16
+  if name == 'wtl':
+    from tensor2robot_tpu.research.vrgripper import (
+        VRGripperEnvVisionTrialModel)
+
+    return VRGripperEnvVisionTrialModel(
+        device_type='tpu', episode_length=40), 32
+  raise SystemExit(f'unknown workload {name!r}; use qtopt|grasp2vec|wtl')
+
+
+def main(argv=None):
   import tempfile
 
   import jax
 
   from tensor2robot_tpu.modes import ModeKeys
   from tensor2robot_tpu.parallel import mesh as mesh_lib
-  from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
   from tensor2robot_tpu.specs import make_random_numpy
   from tensor2robot_tpu.train import Trainer, TrainerConfig
 
-  batch_size = 32
-  model = GraspingModelWrapper(device_type='tpu')
+  argv = sys.argv[1:] if argv is None else argv
+  workload = argv[0] if argv else 'qtopt'
+  model, batch_size = _build_workload(workload)
   config = TrainerConfig(model_dir='', max_train_steps=1,
                          eval_interval_steps=0, log_interval_steps=0)
   trainer = Trainer(model, config)
@@ -260,13 +282,15 @@ def main():
   feature_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
   label_spec = preprocessor.get_in_label_specification(ModeKeys.TRAIN)
   features = make_random_numpy(feature_spec, batch_size=batch_size, seed=0)
-  labels = make_random_numpy(label_spec, batch_size=batch_size, seed=100)
+  labels = (make_random_numpy(label_spec, batch_size=batch_size, seed=100)
+            if label_spec is not None and len(label_spec) else None)
   trainer.train(iter([(features, labels)]), None)
 
   state = trainer.state
   step_fn = trainer._train_step_fn  # pylint: disable=protected-access
   f = mesh_lib.shard_batch(features, trainer.mesh)
-  l = mesh_lib.shard_batch(labels, trainer.mesh)
+  l = (mesh_lib.shard_batch(labels, trainer.mesh)
+       if labels is not None else None)
   hlo = step_fn.lower(state, f, l).compile().as_text()
 
   n = 20
