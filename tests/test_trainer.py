@@ -69,6 +69,23 @@ def test_trainer_resumes_from_checkpoint(tmp_path):
   assert latest_checkpoint_step(os.path.join(model_dir, 'checkpoints')) == 20
 
 
+def test_save_interval_zero_disables_periodic_saves(tmp_path):
+  """``save_interval_steps=0`` means NO periodic checkpoints (the
+  interval==0-disables convention) — it used to modulo-by-zero when a
+  model_dir was set. The end-of-training save still happens."""
+  model = MockT2RModel(device_type='tpu')
+  model_dir = str(tmp_path / 'm')
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  config = TrainerConfig(
+      model_dir=model_dir, max_train_steps=3, save_interval_steps=0,
+      eval_interval_steps=0, log_interval_steps=0, async_checkpoints=False)
+  trainer = Trainer(model, config)
+  trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+  # Only the final forced save exists.
+  assert latest_checkpoint_step(os.path.join(model_dir, 'checkpoints')) == 3
+
+
 def test_trainer_bf16_boundary():
   """TPU dtype policy: device-side features arrive bfloat16."""
   model = MockT2RModel(device_type='tpu')
