@@ -80,11 +80,13 @@ def _flash_pad_dim(key_size: int, value_size: int) -> int:
   return -(-d // 8) * 8
 
 
-def flash_supported(t: int, key_size: int, value_size: int) -> bool:
+def flash_supported(t: int, key_size: int, value_size: int,
+                    itemsize: int = 2) -> bool:
   """Whether the flash path can serve an AttentionBlock problem."""
   from tensor2robot_tpu.ops import flash_attention as fa
 
-  return fa.is_supported(t, _flash_pad_dim(key_size, value_size))
+  return fa.is_supported(t, _flash_pad_dim(key_size, value_size),
+                         itemsize=itemsize)
 
 
 def _flash_auto_ok() -> bool:
@@ -150,7 +152,8 @@ class AttentionBlock(nn.Module):
     use_flash = self.use_flash
     if use_flash is None:
       use_flash = (not self.return_prob and _flash_auto_ok() and
-                   flash_supported(t, self.key_size, self.value_size))
+                   flash_supported(t, self.key_size, self.value_size,
+                                   itemsize=query.dtype.itemsize))
     if use_flash:
       if self.return_prob:
         raise ValueError(
@@ -200,7 +203,8 @@ class MultiHeadAttentionBlock(nn.Module):
 
       use_flash = self.use_flash
       if use_flash is None:
-        use_flash = _flash_auto_ok() and fa.is_supported(t, d)
+        use_flash = _flash_auto_ok() and fa.is_supported(
+            t, d, itemsize=query.dtype.itemsize)
       if use_flash:
         out = fa.flash_attention(query, key, values, causal=True)
       else:

@@ -352,13 +352,18 @@ def _resolve_blocks(t: int, d: int, block_q: Optional[int],
 
 def is_supported(t: int, d: int, block_q: Optional[int] = None,
                  block_k: Optional[int] = None,
-                 interpret: Optional[bool] = None) -> bool:
+                 interpret: Optional[bool] = None,
+                 itemsize: int = 2) -> bool:
   """Whether ``flash_attention`` handles a [_, t, _, d] problem.
 
   The dispatch predicate shared with the sequence-parallel wrappers —
   callers fall back to plain attention when this is False.
   ``block_q``/``block_k`` default to the same regime-dependent
-  resolution ``flash_attention`` itself applies.
+  resolution ``flash_attention`` itself applies; pass the input's
+  ``dtype.itemsize`` so the staged/streamed regime (a VMEM *byte*
+  budget) resolves exactly as the kernel will — the default 2 models
+  bfloat16, and float32 inputs with T·D in the (1M, 2M] band stream
+  where bf16 would stage.
 
   On a real TPU the blocks must additionally be at least a lane tile
   (128): the logsumexp output places the q-block dim in lanes, and
@@ -369,7 +374,7 @@ def is_supported(t: int, d: int, block_q: Optional[int] = None,
   """
   if interpret is None:
     interpret = _use_interpret()
-  block_q, block_k = _resolve_blocks(t, d, block_q, block_k)
+  block_q, block_k = _resolve_blocks(t, d, block_q, block_k, itemsize)
   bq, bk = min(block_q, t), min(block_k, t)
   min_block = 8 if interpret else 128
   return (0 < d <= 128 and d % 8 == 0 and
@@ -388,7 +393,8 @@ def _check(q, block_q, block_k):
     raise ValueError(
         f'sequence length {t} must be divisible by block sizes '
         f'({bq}, {bk}); pad the sequence.')
-  if not is_supported(t, d, block_q, block_k):
+  if not is_supported(t, d, block_q, block_k,
+                      itemsize=q.dtype.itemsize):
     raise ValueError(
         f'flash_attention unsupported for T={t}, D={d} '
         f'(alignment; see is_supported).')

@@ -159,7 +159,7 @@ def ulysses_attention(q: jax.Array,
   from tensor2robot_tpu.ops.flash_attention import (flash_attention,
                                                     is_supported)
 
-  if is_supported(t, d):
+  if is_supported(t, d, itemsize=ql.dtype.itemsize):
     # The full-sequence local attention is exactly the flash kernel's
     # job: O(T·D) HBM memory instead of the [B, H, T, T] logits tensor.
     out = flash_attention(ql, kl, vl, causal)
