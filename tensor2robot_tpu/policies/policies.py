@@ -85,6 +85,12 @@ class CEMPolicy(Policy):
     self._num_elites = num_elites
     self._device_resident = device_resident
     self._device_cem = None  # (serving_fn identity, jitted CEM program)
+    # Serving-output keys (beyond q_predicted) the jitted CEM program
+    # must carry out at the best sample — e.g. LSTMCEMPolicy's
+    # lstm_hidden_state feedback. Class-level: baked into the traced
+    # program.
+    self._device_aux_keys: tuple = getattr(type(self), 'DEVICE_AUX_KEYS',
+                                           ())
     self.sample_fn = self._default_sample_fn
     self.pack_fn = pack_fn or self._default_pack_fn
 
@@ -159,6 +165,7 @@ class CEMPolicy(Policy):
         raise ValueError(
             f'action specs cover {offset} dims, action_size is '
             f'{self._action_size}.')
+      self._device_action_keys = frozenset(key for key, *_ in slices)
       num_samples = self._cem_samples
 
       def pack_device(state_features, samples):
@@ -170,15 +177,19 @@ class CEMPolicy(Policy):
           packed[key] = samples[:, start:end].reshape((num_samples,) + shape)
         return packed
 
+      aux_keys = self._device_aux_keys
+
       def run(variables, state_features, noise, mean, stddev):
         def objective(samples):
           outputs = serving_fn(variables, pack_device(state_features,
                                                       samples))
+          if aux_keys:
+            return outputs['q_predicted'], {k: outputs[k] for k in aux_keys}
           return outputs['q_predicted']
 
         return cross_entropy.jit_normal_cem(
-            objective, self._num_elites, self._cem_iters)(noise, mean,
-                                                          stddev)
+            objective, self._num_elites, self._cem_iters,
+            has_aux=bool(aux_keys))(noise, mean, stddev)
 
       jitted = jax.jit(run)
     else:
@@ -205,17 +216,36 @@ class CEMPolicy(Policy):
     state_features = {
         k: np.asarray(v) for k, v in probe.items() if k.startswith('state/')
     }
+    # The jitted program only forwards state/ features and slices the
+    # action/ keys from the sampled vectors; any other key the model's
+    # pack_features emits (context, timestep features, ...) would vanish
+    # here and resurface as an opaque missing-key error inside tracing.
+    # Fail at the policy boundary instead, naming the dropped keys.
+    dropped = sorted(set(probe) - set(state_features)
+                     - self._device_action_keys)
+    if dropped:
+      raise ValueError(
+          f'device_resident CEM forwards only state/ features and the '
+          f'action/ slices {sorted(self._device_action_keys)}; '
+          f'pack_features emitted additional serving inputs {dropped} '
+          f'that would be silently dropped. Use device_resident=False '
+          f'for this model, or fold these inputs under state/.')
     noise = self._draw_noise(
         (self._cem_iters, self._cem_samples, self._action_size))
-    best, value, mean, stddev = run(
+    results = run(
         variables, state_features, noise,
         np.zeros(self._action_size, np.float32),
         np.ones(self._action_size, np.float32))
+    best, value, mean, stddev = results[:4]
     debug = {
         'q_predicted': float(value),
         'final_params': {'mean': np.asarray(mean),
                          'stddev': np.asarray(stddev)},
     }
+    if self._device_aux_keys:
+      debug['aux'] = {
+          k: np.asarray(v) for k, v in results[4].items()
+      }
     return np.asarray(best), debug
 
   def SelectAction(self, state, context, timestep):
@@ -233,15 +263,21 @@ class CEMPolicy(Policy):
 
 
 class LSTMCEMPolicy(CEMPolicy):
-  """CEM with cached critic LSTM hidden state (policies.py:193-224)."""
+  """CEM with cached critic LSTM hidden state (policies.py:193-224).
+
+  ``device_resident=True`` threads the feedback loop through the jitted
+  CEM program: the cached hidden state rides in as a state feature, the
+  serving outputs' per-sample ``lstm_hidden_state`` rides out at the
+  best sample (final iteration — the numpy loop's semantics), and the
+  next ``SelectAction`` feeds it back. Requires the policy's
+  ``pack_fn`` to place the hidden state under a ``state/`` key (the
+  device pack forwards only ``state/`` features) and the serving fn to
+  emit ``lstm_hidden_state [S, H]``.
+  """
+
+  DEVICE_AUX_KEYS = ('lstm_hidden_state',)
 
   def __init__(self, hidden_state_size: int, **kwargs):
-    if kwargs.get('device_resident'):
-      # The hidden-state feedback (best sample's lstm state threads into
-      # the next SelectAction) is not wired through the jitted CEM
-      # program; accepting the flag would silently run the numpy path.
-      raise NotImplementedError(
-          'LSTMCEMPolicy does not support device_resident=True.')
     self._hidden_state_size = hidden_state_size
     super().__init__(**kwargs)
     self.reset()
@@ -251,6 +287,15 @@ class LSTMCEMPolicy(CEMPolicy):
     self._hidden_state_batch = None
 
   def SelectAction(self, state, context, timestep):
+    if self._device_resident:
+      # The hidden state is constant within one action's CEM iterations
+      # (the numpy loop reads self._hidden_state, not the per-iteration
+      # batch), so it enters the program once as a state feature; the
+      # best sample's final-iteration state comes back in one dispatch.
+      action, debug = self.get_cem_action_device(
+          state, self._hidden_state, timestep)
+      self._hidden_state = debug['aux']['lstm_hidden_state']
+      return action
 
     def objective_fn(samples):
       np_inputs = self.pack_fn(self._t2r_model, state, self._hidden_state,
