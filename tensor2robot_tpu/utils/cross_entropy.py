@@ -83,7 +83,8 @@ def normal_cross_entropy_method(objective_fn,
 
 def jit_normal_cem(objective_fn: Callable,
                    num_elites: int,
-                   num_iterations: int) -> Callable:
+                   num_iterations: int,
+                   has_aux: bool = False) -> Callable:
   """Traceable whole-CEM body: sample → objective → elite refit, on device.
 
   The device-resident counterpart of :func:`normal_cross_entropy_method`
@@ -102,20 +103,34 @@ def jit_normal_cem(objective_fn: Callable,
   same action, up to exact value TIES (``np.argsort``'s last-k and
   ``lax.top_k``'s first-k pick differently-ordered elites when
   candidates score identically, e.g. an untrained critic).
+
+  With ``has_aux=True``, ``objective_fn`` returns ``(values [S],
+  aux_tree)`` where every aux leaf is sample-batched ``[S, ...]``; run
+  additionally returns ``aux_tree[best]`` from the FINAL iteration —
+  matching the numpy loop's semantics of keeping the last objective
+  call's predictions (the stateful-critic feedback LSTMCEMPolicy
+  threads between actions).
   """
   import jax
   import jax.numpy as jnp
 
   def run(noise, mean, stddev):
-    samples = values = None
+    samples = values = aux = None
     for i in range(num_iterations):  # static unroll: iters is tiny (≤5)
       samples = mean + stddev * noise[i]
-      values = objective_fn(samples).reshape(-1).astype(jnp.float32)
+      if has_aux:
+        values, aux = objective_fn(samples)
+      else:
+        values = objective_fn(samples)
+      values = values.reshape(-1).astype(jnp.float32)
       _, elite_idx = jax.lax.top_k(values, num_elites)
       elites = samples[elite_idx]
       mean = jnp.mean(elites, axis=0)
       stddev = jnp.std(elites, axis=0, ddof=1)
     best = jnp.argmax(values)
+    if has_aux:
+      aux_best = jax.tree_util.tree_map(lambda a: a[best], aux)
+      return samples[best], values[best], mean, stddev, aux_best
     return samples[best], values[best], mean, stddev
 
   return run

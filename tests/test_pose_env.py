@@ -267,6 +267,33 @@ class TestPoseEnvPolicies:
         np.asarray(action_device), np.asarray(action_numpy),
         rtol=1e-5, atol=1e-5)
 
+  def test_device_lstm_cem_matches_numpy_path(self):
+    """LSTMCEMPolicy(device_resident=True): the hidden-state feedback
+    (best sample's final-iteration lstm state → next SelectAction)
+    threads through the jitted CEM program and reproduces the numpy
+    loop action-for-action over a 3-action sequence."""
+    from tensor2robot_tpu.policies import LSTMCEMPolicy
+
+    critic = _LstmToyCritic()
+    kwargs = dict(t2r_model=_LstmToyModel(), predictor=critic,
+                  action_size=2, cem_samples=16, cem_iters=3,
+                  num_elites=4, hidden_state_size=3,
+                  pack_fn=_lstm_pack_fn)
+    numpy_policy = LSTMCEMPolicy(**kwargs)
+    device_policy = LSTMCEMPolicy(device_resident=True, **kwargs)
+    np.random.seed(5)
+    actions_numpy = [numpy_policy.SelectAction(None, None, t)
+                     for t in range(3)]
+    np.random.seed(5)
+    actions_device = [device_policy.SelectAction(None, None, t)
+                      for t in range(3)]
+    for a_np, a_dev in zip(actions_numpy, actions_device):
+      np.testing.assert_allclose(np.asarray(a_dev), np.asarray(a_np),
+                                 rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(device_policy._hidden_state,
+                               numpy_policy._hidden_state,
+                               rtol=1e-5, atol=1e-5)
+
   def test_device_cem_policy_exported_predictor(self, tmp_path):
     """The device CEM also composes with a restored EXPORT's serving fn
     (the self-contained StableHLO path a robot host actually runs)."""
@@ -385,3 +412,62 @@ class TestContinuousCollectTrainLoop:
     trainer2 = Trainer(model, config2)
     trainer2.train(gen2.create_iterator(ModeKeys.TRAIN), None)
     assert trainer2.step == 2
+
+
+class _LstmToyModel:
+  """Minimal model surface for the device LSTM CEM path: action spec only
+  (the policy's custom pack_fn owns feature layout)."""
+
+  def get_action_specification(self):
+    from tensor2robot_tpu.specs import ExtendedTensorSpec
+
+    return {'a': ExtendedTensorSpec(shape=(2,), dtype=np.float32, name='a')}
+
+
+class _LstmToyCritic:
+  """Stateful toy critic/predictor: q scores actions against tanh(h·W);
+  serving also emits the NEXT hidden state per sample — the
+  lstm_hidden_state feedback contract LSTMCEMPolicy threads between
+  actions. Numpy predict and the traceable serving fn share weights, so
+  the two CEM paths are comparable to f32 precision."""
+
+  def __init__(self, action_size=2, hidden=3, seed=0):
+    rng = np.random.RandomState(seed)
+    self.w = rng.randn(hidden, action_size).astype(np.float32)
+    self.wh = rng.randn(hidden, hidden).astype(np.float32)
+    self.ua = rng.randn(action_size, hidden).astype(np.float32)
+
+  def predict(self, np_inputs):
+    a = np.asarray(np_inputs['action/a'], np.float32)
+    h = np.asarray(np_inputs['state/h'], np.float32)
+    q = -np.sum((a - np.tanh(h @ self.w)) ** 2, axis=-1)
+    return {'q_predicted': q,
+            'lstm_hidden_state': np.tanh(h @ self.wh + a @ self.ua)}
+
+  def device_serving_fn(self):
+    import jax.numpy as jnp
+
+    w, wh, ua = (jnp.asarray(self.w), jnp.asarray(self.wh),
+                 jnp.asarray(self.ua))
+
+    def serving(variables, features):
+      del variables
+      a = features['action/a'].astype(jnp.float32)
+      h = features['state/h'].astype(jnp.float32)
+      q = -jnp.sum((a - jnp.tanh(h @ w)) ** 2, axis=-1)
+      return {'q_predicted': q,
+              'lstm_hidden_state': jnp.tanh(h @ wh + a @ ua)}
+
+    return serving, {}
+
+
+def _lstm_pack_fn(model, state, hidden, timestep, samples):
+  """Hidden state rides under state/ (the device pack forwards state/
+  features); actions under the spec-ordered action/ key."""
+  del model, state, timestep
+  s = np.asarray(samples, np.float32)
+  h = np.asarray(hidden, np.float32)
+  return {
+      'state/h': np.broadcast_to(h[None], (s.shape[0], h.shape[-1])).copy(),
+      'action/a': s,
+  }
