@@ -152,12 +152,21 @@ class TestGrasp2VecModel:
     for device_type, history in histories.items():
       assert np.all(np.isfinite(history)), (device_type, history)
       assert history[-1] < history[0] * 0.8, (device_type, history)
-    # bf16 tracks f32 to within a loose relative band on the smoke
-    # workload — loss scales differ per family, so compare the achieved
-    # *reduction*, which is what training cares about.
+    # bf16 TRACKS f32: the achieved reduction over the 25-step descent
+    # must match within 10% relative, both directions. Loss scales
+    # differ per family and the final losses sit near convergence where
+    # relative comparison is noise (npairs lands at ~3e-3 in both
+    # dtypes but 1.6x apart relatively), so the reduction — what
+    # training cares about — is the compared quantity. Measured
+    # bf16/f32 reduction ratios on this workload: npairs 1.022,
+    # triplet 1.007, l2 1.003 — the 10% band has >4x margin while a
+    # half-effective bf16 path (which the old >0.5x gate accepted)
+    # fails it loudly.
     red_f32 = histories['cpu'][0] - histories['cpu'][-1]
     red_bf16 = histories['tpu'][0] - histories['tpu'][-1]
-    assert red_bf16 > 0.5 * red_f32, (histories['cpu'], histories['tpu'])
+    np.testing.assert_allclose(
+        red_bf16, red_f32, rtol=0.10,
+        err_msg=repr((histories['cpu'], histories['tpu'])))
 
 
 def _random_features(model, batch, seed):
