@@ -23,9 +23,12 @@ import threading
 from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(__file__), 'record_io.cpp')
+_JPEG_SRC = os.path.join(os.path.dirname(__file__), 'jpeg_decode.cpp')
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_JPEG_LIB: Optional[ctypes.CDLL] = None
+_JPEG_TRIED = False
 
 
 def _build_dir() -> str:
@@ -35,23 +38,27 @@ def _build_dir() -> str:
   return cache
 
 
-def _compile() -> Optional[str]:
-  with open(_SRC, 'rb') as f:
+def _compile_src(src: str, stem: str, what: str,
+                 extra_flags=()) -> Optional[str]:
+  with open(src, 'rb') as f:
     digest = hashlib.sha256(f.read()).hexdigest()[:16]
-  out = os.path.join(_build_dir(), f'libt2r_io_{digest}.so')
+  out = os.path.join(_build_dir(), f'{stem}_{digest}.so')
   if os.path.exists(out):
     return out
   tmp = out + f'.tmp{os.getpid()}'
   cmd = ['g++', '-O3', '-std=c++17', '-shared', '-fPIC', '-pthread',
-         _SRC, '-o', tmp]
+         src, '-o', tmp, *extra_flags]
   try:
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
   except (OSError, subprocess.SubprocessError) as e:
-    logging.warning('native record_io build failed (%s); using TF fallback',
-                    e)
+    logging.warning('native %s build failed (%s); using fallback', what, e)
     return None
   os.replace(tmp, out)  # atomic: racing builders converge on one file
   return out
+
+
+def _compile() -> Optional[str]:
+  return _compile_src(_SRC, 'libt2r_io', 'record_io')
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -124,3 +131,38 @@ def load_record_io() -> Optional[ctypes.CDLL]:
         logging.warning('native record_io load failed (%s)', e)
         _LIB = None
     return _LIB
+
+
+def _bind_jpeg(lib: ctypes.CDLL) -> ctypes.CDLL:
+  lib.t2r_jpeg_decode_batch.restype = ctypes.c_int
+  lib.t2r_jpeg_decode_batch.argtypes = [
+      ctypes.POINTER(ctypes.c_char_p),  # bufs
+      ctypes.POINTER(ctypes.c_uint64),  # lens
+      ctypes.c_int,                     # n
+      ctypes.POINTER(ctypes.c_uint8),   # out
+      ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
+      ctypes.c_int,                     # num_threads
+      ctypes.POINTER(ctypes.c_int32),   # status
+  ]
+  return lib
+
+
+def load_jpeg_decode() -> Optional[ctypes.CDLL]:
+  """Compiles (once, needs libjpeg) and loads the JPEG batch decoder."""
+  global _JPEG_LIB, _JPEG_TRIED
+  if os.environ.get('T2R_NATIVE_DISABLE') or os.environ.get(
+      'T2R_NATIVE_JPEG_DISABLE'):
+    return None
+  with _LOCK:
+    if _JPEG_TRIED:
+      return _JPEG_LIB
+    _JPEG_TRIED = True
+    path = _compile_src(_JPEG_SRC, 'libt2r_jpeg', 'jpeg_decode',
+                        extra_flags=('-ljpeg',))
+    if path is not None:
+      try:
+        _JPEG_LIB = _bind_jpeg(ctypes.CDLL(path))
+      except OSError as e:
+        logging.warning('native jpeg_decode load failed (%s)', e)
+        _JPEG_LIB = None
+    return _JPEG_LIB

@@ -466,3 +466,73 @@ def test_decode_image_converts_channel_mismatch():
   bad.save(buf, format='JPEG')
   with pytest.raises(ValueError, match='img'):
     _decode_image(buf.getvalue(), spec3, key='img')
+
+
+class TestNativeJpegBatch:
+  """C++ libjpeg batch decoder: bitwise parity with the PIL path, the
+  empty-bytes→zeros codec convention, and per-image fallback."""
+
+  @staticmethod
+  def _jpeg_bytes(arr):
+    import io
+
+    import PIL.Image
+
+    buf = io.BytesIO()
+    PIL.Image.fromarray(arr).save(buf, format='JPEG', quality=90)
+    return buf.getvalue()
+
+  def test_bitwise_matches_pil_and_handles_empty(self):
+    from tensor2robot_tpu import native
+    from tensor2robot_tpu.data.native_io import (_decode_image,
+                                                 _native_jpeg_batch)
+    from tensor2robot_tpu.specs import TensorSpec
+
+    if native.load_jpeg_decode() is None:
+      pytest.skip('libjpeg unavailable')
+    spec = TensorSpec(shape=(16, 24, 3), dtype=np.uint8, name='img',
+                      data_format='JPEG')
+    rng = np.random.RandomState(0)
+    raws = [self._jpeg_bytes(rng.randint(0, 255, (16, 24, 3), dtype=np.uint8)
+                             .astype(np.uint8)) for _ in range(5)]
+    raws.insert(2, b'')  # codec convention: empty bytes decode to zeros
+    out = _native_jpeg_batch(raws, spec, workers=2)
+    assert out is not None and out.shape == (6, 16, 24, 3)
+    assert np.all(out[2] == 0)
+    pil = np.stack([_decode_image(r, spec) for r in raws])
+    np.testing.assert_array_equal(out, pil)  # ISLOW DCT: bitwise parity
+
+  def test_non_jpeg_falls_back_per_image(self):
+    """PNG bytes under a JPEG spec decode via the PIL fallback (the TF
+    codec's decode_image accepts any format)."""
+    import io
+
+    import PIL.Image
+
+    from tensor2robot_tpu import native
+    from tensor2robot_tpu.data.native_io import (_decode_image,
+                                                 _native_jpeg_batch)
+    from tensor2robot_tpu.specs import TensorSpec
+
+    if native.load_jpeg_decode() is None:
+      pytest.skip('libjpeg unavailable')
+    spec = TensorSpec(shape=(8, 10, 3), dtype=np.uint8, name='img',
+                      data_format='JPEG')
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+    png = io.BytesIO()
+    PIL.Image.fromarray(img).save(png, format='PNG')
+    raws = [self._jpeg_bytes(img), png.getvalue()]
+    out = _native_jpeg_batch(raws, spec, workers=1)
+    np.testing.assert_array_equal(out[1], img)  # PNG is lossless
+    pil = np.stack([_decode_image(r, spec) for r in raws])
+    np.testing.assert_array_equal(out, pil)
+
+  def test_float_spec_declines(self):
+    """Non-uint8 image specs return None (callers keep the PIL path)."""
+    from tensor2robot_tpu.data.native_io import _native_jpeg_batch
+    from tensor2robot_tpu.specs import TensorSpec
+
+    spec = TensorSpec(shape=(8, 10, 3), dtype=np.float32, name='img',
+                      data_format='JPEG')
+    assert _native_jpeg_batch([b''], spec, workers=1) is None
