@@ -62,6 +62,47 @@ def center_crop_images(images: jax.Array,
   return images[..., oh:oh + th, ow:ow + tw, :]
 
 
+def crop_resize_images(offset_y, offset_x, images: jax.Array,
+                       crop_shape: Sequence[int],
+                       target_shape: Sequence[int],
+                       method: str = 'bilinear') -> jax.Array:
+  """``resize(crop(images, offset, crop_shape), target_shape)`` with the
+  crop FOLDED INTO the resize weight matrices — no materialized crop.
+
+  The [B, H, W, C] crop intermediate (215 MB on the WTL episode batch)
+  and its TPU layout copy are the only reasons the two-step form touches
+  HBM twice; since resize is linear and separable, the same result is
+  two dots with per-axis weight matrices shifted by the crop offset:
+
+    out = (roll(pad(A_h), oy) @ img) @ roll(pad(A_w), ox)^T
+
+  ``A_h [target_h, crop_h]`` comes from resizing an identity matrix, so
+  edge renormalization and antialiasing match ``jax.image.resize``
+  exactly; zero-padding to the full image width and rolling by the
+  (traced) offset reproduces the crop — extra columns multiply by zero.
+  ``offset_y``/``offset_x`` may be traced scalars (the random-crop
+  draw). Input may be uint8; the output is float32 in the INPUT's
+  units (divide by 255 afterwards — scaling commutes with the linear
+  resample and the small output is the cheaper place to do it).
+  """
+  th, tw = int(target_shape[0]), int(target_shape[1])
+  ch, cw = int(crop_shape[0]), int(crop_shape[1])
+  h, w = images.shape[-3], images.shape[-2]
+  _check_crop(images.shape, crop_shape)
+  eye_h = jnp.eye(ch, dtype=jnp.float32)
+  eye_w = jnp.eye(cw, dtype=jnp.float32)
+  a_h = jax.image.resize(eye_h, (th, ch), method)  # [th, ch], constant
+  a_w = jax.image.resize(eye_w, (tw, cw), method)  # [tw, cw], constant
+  a_h = jnp.roll(jnp.pad(a_h, ((0, 0), (0, h - ch))), offset_y, axis=1)
+  a_w = jnp.roll(jnp.pad(a_w, ((0, 0), (0, w - cw))), offset_x, axis=1)
+  x = images.astype(jnp.float32)
+  # H-pass first, then W: measured 29.6 ms/step on the WTL episode
+  # batch vs 31.6 for W-first (the W-first contraction both keeps the
+  # input layout copies AND slows them to 1.8x their HBM bound).
+  x = jnp.einsum('iy,byxc->bixc', a_h, x)
+  return jnp.einsum('jx,bixc->bijc', a_w, x)
+
+
 def custom_crop_images(images: jax.Array,
                        crop_box: Sequence[int]) -> jax.Array:
   """Fixed crop at (y, x) with size (h, w) — crop_box = [y, x, h, w]."""

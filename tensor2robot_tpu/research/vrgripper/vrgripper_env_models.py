@@ -73,21 +73,35 @@ class DefaultVRGripperPreprocessor(AbstractPreprocessor):
       image = features['image']
       lead_shape = image.shape[:-3]
       merged = image.reshape((-1,) + tuple(image.shape[-3:]))
-      if mode == ModeKeys.TRAIN and rng is not None:
+      h, w = merged.shape[-3], merged.shape[-2]
+      ch, cw = self._crop_size
+      training_crop = mode == ModeKeys.TRAIN and rng is not None
+      if training_crop:
         crop_rng, mix_rng = jax.random.split(rng)
-        cropped = image_transformations.random_crop_images(
-            crop_rng, merged, self._crop_size)
       else:
         mix_rng = rng
-        cropped = image_transformations.center_crop_images(
-            merged, self._crop_size)
-      cropped = cropped.astype(jnp.float32) / 255.0
       out_spec = self.get_out_feature_specification(mode)
       target_hw = tuple(out_spec['image'].shape[-3:-1])
       if target_hw != self._crop_size:
-        cropped = jax.image.resize(
-            cropped, (cropped.shape[0],) + target_hw + (cropped.shape[-1],),
-            method='bilinear')
+        # Crop folded into the resize dots: no materialized crop tensor
+        # and no TPU layout copy between crop and resize (WTL roofline:
+        # the two-step form cost ~3.7 ms/step of pure copies + slices
+        # on the episode batch). The offset draw matches
+        # random_crop_images (same rng splits, one offset per batch).
+        if training_crop:
+          rng_h, rng_w = jax.random.split(crop_rng)
+          oh = jax.random.randint(rng_h, (), 0, h - ch + 1)
+          ow = jax.random.randint(rng_w, (), 0, w - cw + 1)
+        else:
+          oh, ow = (h - ch) // 2, (w - cw) // 2
+        cropped = image_transformations.crop_resize_images(
+            oh, ow, merged, self._crop_size, target_hw) / 255.0
+      elif training_crop:
+        cropped = image_transformations.random_crop_images(
+            crop_rng, merged, self._crop_size).astype(jnp.float32) / 255.0
+      else:
+        cropped = image_transformations.center_crop_images(
+            merged, self._crop_size).astype(jnp.float32) / 255.0
       features['original_image'] = features['image']
       features['image'] = cropped.reshape(
           tuple(lead_shape) + cropped.shape[1:])

@@ -157,6 +157,40 @@ class TestCrops:
     b = crop(jax.random.PRNGKey(7), images)
     np.testing.assert_array_equal(a, b)
 
+  @pytest.mark.parametrize('offset', [(0, 0), (3, 7), (20, 20)])
+  def test_crop_resize_matches_two_step_form(self, offset):
+    """crop_resize_images (crop folded into the resize dots) reproduces
+    resize(crop(...)) — including at the image borders, where the
+    resize kernel's edge renormalization must come from the CROP edges,
+    not the full image."""
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(
+        rng.randint(0, 255, (3, 30, 36, 3)), jnp.uint8)
+    oh, ow = offset
+    fused = image_transformations.crop_resize_images(
+        jnp.int32(oh), jnp.int32(ow), images, (10, 16), (5, 8))
+    two_step = jax.image.resize(
+        images[:, oh:oh + 10, ow:ow + 16, :].astype(jnp.float32),
+        (3, 5, 8, 3), method='bilinear')
+    assert fused.shape == (3, 5, 8, 3)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(two_step),
+                               rtol=1e-5, atol=1e-3)
+
+  def test_crop_resize_under_jit_with_traced_offsets(self):
+    images = jnp.arange(2 * 12 * 12 * 1, dtype=jnp.float32).reshape(
+        2, 12, 12, 1)
+
+    @jax.jit
+    def run(oh, ow):
+      return image_transformations.crop_resize_images(
+          oh, ow, images, (8, 8), (4, 4))
+
+    out = run(jnp.int32(2), jnp.int32(4))
+    ref = jax.image.resize(images[:, 2:10, 4:12, :], (2, 4, 4, 1),
+                           method='bilinear')
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
   def test_custom_crop(self):
     images = jnp.zeros((1, 8, 8, 3))
     out = image_transformations.custom_crop_images(images, (2, 3, 4, 5))
