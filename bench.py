@@ -137,6 +137,83 @@ def bench_flash_attention_streamed():
     }))
 
 
+def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
+                           steps: int = 24):
+  """Record-fed training throughput: tfrecord shards → native reader →
+  C++/PIL jpeg decode → h2d → the SAME compiled train step (r4 verdict
+  #1 — the reference's actual operating mode, utils/tfdata.py:254-524).
+
+  Reuses the bench's own trainer/executable (a second executable makes
+  the tunneled backend re-stream per dispatch and poisons every number —
+  see tools/profile_record_train.py). Reports the per-step MEDIAN (the
+  tunnel occasionally stalls a step 2-4x; the median is the sustained
+  rate) and the fraction of the device-resident floor it achieves.
+  """
+  import shutil
+  import tempfile
+
+  import jax
+
+  from tensor2robot_tpu.data.input_generators import (
+      NativeRecordInputGenerator)
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.train import TrainerConfig
+  from tensor2robot_tpu.train.trainer import TrainerCallback
+  from tools.profile_record_train import generate_shards
+
+  class _StepTimer(TrainerCallback):
+
+    def __init__(self):
+      self.samples = []
+      self.last = time.perf_counter()
+
+    def after_step(self, trainer, step, scalars):
+      now = time.perf_counter()
+      self.samples.append(1e3 * (now - self.last))
+      self.last = now
+
+  data_dir = tempfile.mkdtemp(prefix='t2r_bench_rec_')
+  try:
+    pattern = generate_shards(trainer.model, data_dir, num_examples=64)
+    gen = NativeRecordInputGenerator(
+        file_patterns=pattern, batch_size=batch_size,
+        shuffle_buffer_size=8, seed=0)
+    gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+    timer = _StepTimer()
+    trainer._callbacks = [timer]  # pylint: disable=protected-access
+    start = trainer.step
+
+    def run(n):
+      trainer._config = TrainerConfig(  # pylint: disable=protected-access
+          model_dir='', max_train_steps=trainer.step + n,
+          eval_interval_steps=0, log_interval_steps=0)
+      trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+      jax.block_until_ready(trainer.state.params)
+
+    run(4)  # warm the record path (readers, decode pool, h2d placement)
+    timer.samples = []
+    timer.last = time.perf_counter()
+    run(steps)
+    samples = sorted(timer.samples[1:])  # drop the idle-gap re-entry step
+    median_ms = samples[len(samples) // 2]
+    wall_sps = 1000.0 / median_ms if median_ms else 0.0
+    floor_sps = 1000.0 / device_ms if device_ms else 0.0
+    print(json.dumps({
+        'metric': 'qtopt_record_train_steps_per_sec',
+        'value': round(wall_sps, 3),
+        'unit': 'steps/sec',
+        'median_ms_per_step': round(median_ms, 1),
+        'p90_ms_per_step': round(samples[int(len(samples) * 0.9)], 1),
+        'device_floor_steps_per_sec': round(floor_sps, 2),
+        'fraction_of_device_floor': round(wall_sps / floor_sps, 3)
+        if floor_sps else None,
+        'steps': trainer.step - start,
+        'batch_size': batch_size,
+    }))
+  finally:
+    shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def bench_native_reader():
   """Native interleave-reader throughput on generated shards — JSON line."""
   import os
@@ -297,7 +374,14 @@ def main():
           'device_steps_per_sec': round(1000.0 / dev_ms, 2) if dev_ms else 0,
       }))
     except Exception as e:
+      dev_ms = 0.0
       print(json.dumps({'metric': 'qtopt_train_device_ms_per_step',
+                        'error': repr(e)[:200]}))
+    try:
+      trainer._state = state  # pylint: disable=protected-access
+      bench_record_fed_train(trainer, dev_ms, batch_size)
+    except Exception as e:
+      print(json.dumps({'metric': 'qtopt_record_train_steps_per_sec',
                         'error': repr(e)[:200]}))
   try:
     bench_native_reader()

@@ -28,9 +28,10 @@ TEST_DATA = os.path.join(REPO, 'tests', 'test_data',
                          'pose_env_test_data.tfrecord')
 
 
-def _steps_per_sec(model, batch_size: int, steps: int = 50,
-                   generator=None) -> float:
-  """Times the jitted train step over device-resident random batches."""
+def _time_train_step(model, batch_size: int, steps: int = 50,
+                     generator=None, trace: bool = False):
+  """(wall steps/s, trace-measured device ms/step or None) for the
+  jitted train step over device-resident random batches."""
   import jax
 
   from tensor2robot_tpu.data.input_generators import (
@@ -62,7 +63,18 @@ def _steps_per_sec(model, batch_size: int, steps: int = 50,
   for i in range(steps):
     state, _ = step_fn(state, *batches[i % 4])
   jax.block_until_ready(state.params)
-  return steps / (time.perf_counter() - t0)
+  wall = steps / (time.perf_counter() - t0)
+  device_ms = None
+  if trace and jax.default_backend() != 'cpu':
+    from tools.trace_profile import device_ms_per_iter
+
+    device_ms, _ = device_ms_per_iter(step_fn, (state, *batches[0]), n=10)
+  return wall, device_ms
+
+
+def _steps_per_sec(model, batch_size: int, steps: int = 50,
+                   generator=None) -> float:
+  return _time_train_step(model, batch_size, steps, generator)[0]
 
 
 def measure_pose_env_convergence(max_train_steps: int = 400) -> dict:
@@ -100,13 +112,21 @@ def measure_grasp2vec() -> float:
   return _steps_per_sec(Grasp2VecModel(device_type='tpu'), batch_size=16)
 
 
-def measure_wtl_vision() -> float:
+def measure_wtl_vision(batch_size: int = 32):
+  """WTL vision trial at a COMPUTE-BOUND configuration (r4 verdict #3).
+
+  The original batch-4 anchor measured 37-43 steps/s across runs/boxes
+  (dispatch-latency noise straddling the recorded 55.7) — not
+  reproducible, so useless as a regression gate. Batch 32 is ~37 ms of
+  device time per step (rooflined in PERF_NOTES), so the recorded
+  number tracks compute. Returns (wall steps/s, device ms/step)."""
   from tensor2robot_tpu.research.vrgripper import (
       VRGripperEnvVisionTrialModel)
 
   model = VRGripperEnvVisionTrialModel(
       device_type='tpu', episode_length=40)
-  return _steps_per_sec(model, batch_size=4)
+  return _time_train_step(model, batch_size=batch_size, steps=30,
+                          trace=True)
 
 
 def measure_pose_env_maml(batch_size: int = 64) -> float:
@@ -129,53 +149,126 @@ def measure_pose_env_maml(batch_size: int = 64) -> float:
   return _steps_per_sec(model, batch_size=batch_size)
 
 
-def measure_qtopt_batch128() -> float:
-  """Secondary QT-Opt number at batch 128 (the batch-32 bench.py
-  headline stays the primary metric). Measured r4: 2.255 steps/s —
-  the conv1-region activations at batch 128 press the 16 GB HBM and
-  per-example throughput drops ~6× vs batch 32, refuting the earlier
-  amortization hypothesis (see PERF_NOTES 'levers')."""
+def measure_qtopt_batch(batch_size: int, steps: int = 30):
+  """One QT-Opt batch-size point: (wall steps/s, device ms/step)."""
   from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
 
-  return _steps_per_sec(GraspingModelWrapper(device_type='tpu'),
-                        batch_size=128, steps=30)
+  return _time_train_step(GraspingModelWrapper(device_type='tpu'),
+                          batch_size=batch_size, steps=steps, trace=True)
 
 
-def main():
+def measure_qtopt_batch_curve(batches=(32, 48, 64, 96, 128)) -> dict:
+  """Per-example throughput curve (r4 verdict #2).
+
+  Each batch size runs in its OWN subprocess: coexisting compiled
+  executables make the tunneled backend re-stream them per dispatch and
+  poison the numbers (see tools/profile_record_train.py docstring).
+  Returns {batch: {steps_per_sec, device_ms, examples_per_sec}}.
+  """
+  import subprocess
+  import sys
+
+  curve = {}
+  for b in batches:
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--qtopt-batch', str(b)],
+        capture_output=True, text=True)
+    line = None
+    for out_line in proc.stdout.splitlines():
+      if out_line.startswith('{'):
+        line = out_line
+    if line is None:
+      print(f'  batch {b} FAILED:\n{proc.stdout[-500:]}\n{proc.stderr[-800:]}')
+      continue
+    curve[b] = json.loads(line)
+    print(f'  batch {b}: {curve[b]}', flush=True)
+  return curve
+
+
+RETIRED_KEYS = (
+    # batch-4 WTL: box-variance noise, replaced by the batch-32 anchor.
+    'wtl_vision_steps_per_sec_per_chip',
+    # subsumed by the measured batch curve.
+    'qtopt_steps_per_sec_per_chip_batch128',
+)
+
+
+def main(argv=None):
+  import argparse
+
+  parser = argparse.ArgumentParser()
+  parser.add_argument('--qtopt-batch', type=int, default=None,
+                      help='measure ONE qtopt batch point and print one '
+                           'JSON line (subprocess mode for the curve)')
+  parser.add_argument('--only', default=None,
+                      help='comma list of: pose_env, grasp2vec, wtl, '
+                           'maml, qtopt_curve (default: all)')
+  args = parser.parse_args(argv)
+
   import jax
 
   on_tpu = jax.default_backend() != 'cpu'
+
+  if args.qtopt_batch is not None:
+    wall, device_ms = measure_qtopt_batch(args.qtopt_batch)
+    print(json.dumps({
+        'steps_per_sec': round(wall, 3),
+        'device_ms': round(device_ms, 2) if device_ms else None,
+        'examples_per_sec': round(wall * args.qtopt_batch, 1),
+        'device_examples_per_sec': (
+            round(1000.0 / device_ms * args.qtopt_batch, 1)
+            if device_ms else None),
+    }))
+    return
+
   if not on_tpu:
     print('WARNING: not on TPU; numbers will not be recorded.')
+  want = set(args.only.split(',')) if args.only else {
+      'pose_env', 'grasp2vec', 'wtl', 'maml', 'qtopt_curve'}
 
   measured = {}
-  print('pose_env convergence ...', flush=True)
-  measured.update(measure_pose_env_convergence())
-  print(f"  pose_env_eval_mse={measured['pose_env_eval_mse']}", flush=True)
-  print('grasp2vec steps/sec ...', flush=True)
-  measured['grasp2vec_steps_per_sec_per_chip'] = round(
-      measure_grasp2vec(), 3)
-  print(f"  {measured['grasp2vec_steps_per_sec_per_chip']}", flush=True)
-  print('wtl vision steps/sec ...', flush=True)
-  measured['wtl_vision_steps_per_sec_per_chip'] = round(
-      measure_wtl_vision(), 3)
-  print(f"  {measured['wtl_vision_steps_per_sec_per_chip']}", flush=True)
-  print('pose_env maml steps/sec (batch 64, compute-bound) ...', flush=True)
-  measured['pose_env_maml_steps_per_sec_per_chip_batch64'] = round(
-      measure_pose_env_maml(), 3)
-  print(f"  {measured['pose_env_maml_steps_per_sec_per_chip_batch64']}",
-        flush=True)
-  print('qtopt batch-128 steps/sec (secondary) ...', flush=True)
-  measured['qtopt_steps_per_sec_per_chip_batch128'] = round(
-      measure_qtopt_batch128(), 3)
-  print(f"  {measured['qtopt_steps_per_sec_per_chip_batch128']}", flush=True)
+  if 'pose_env' in want:
+    print('pose_env convergence ...', flush=True)
+    measured.update(measure_pose_env_convergence())
+    print(f"  pose_env_eval_mse={measured['pose_env_eval_mse']}", flush=True)
+  if 'grasp2vec' in want:
+    print('grasp2vec steps/sec ...', flush=True)
+    measured['grasp2vec_steps_per_sec_per_chip'] = round(
+        measure_grasp2vec(), 3)
+    print(f"  {measured['grasp2vec_steps_per_sec_per_chip']}", flush=True)
+  if 'wtl' in want:
+    print('wtl vision steps/sec (batch 32, compute-bound) ...', flush=True)
+    wall, device_ms = measure_wtl_vision()
+    measured['wtl_vision_steps_per_sec_per_chip_batch32'] = round(wall, 3)
+    if device_ms:
+      measured['wtl_vision_device_ms_per_step_batch32'] = round(device_ms, 2)
+    print(f'  {wall:.2f} steps/s wall, {device_ms} ms device', flush=True)
+  if 'maml' in want:
+    print('pose_env maml steps/sec (batch 64, compute-bound) ...', flush=True)
+    measured['pose_env_maml_steps_per_sec_per_chip_batch64'] = round(
+        measure_pose_env_maml(), 3)
+    print(f"  {measured['pose_env_maml_steps_per_sec_per_chip_batch64']}",
+          flush=True)
+  if 'qtopt_curve' in want:
+    print('qtopt batch curve (each point in its own subprocess) ...',
+          flush=True)
+    curve = measure_qtopt_batch_curve()
+    for b, point in curve.items():
+      measured[f'qtopt_examples_per_sec_per_chip_batch{b}'] = point[
+          'examples_per_sec']
+    if curve:
+      best = max(curve, key=lambda b: curve[b]['examples_per_sec'])
+      measured['qtopt_optimal_batch'] = int(best)
 
   print(json.dumps(measured, indent=2))
   if on_tpu:
     path = os.path.join(REPO, 'BASELINE.json')
     with open(path) as f:
       record = json.load(f)
-    record.setdefault('measured', {}).update(measured)
+    recorded = record.setdefault('measured', {})
+    recorded.update(measured)
+    for key in RETIRED_KEYS:
+      recorded.pop(key, None)
     with open(path, 'w') as f:
       json.dump(record, f, indent=2)
     print(f'recorded into {path}')

@@ -32,36 +32,62 @@ if REPO not in sys.path:
 
 def generate_shards(model, out_dir: str, num_examples: int = 64,
                     num_shards: int = 4) -> str:
-  """Writes spec-shaped jpeg examples with the native record writer."""
+  """Writes spec-shaped examples (jpeg images, random scalars) with the
+  native record writer; features AND labels share one example, as the
+  reference's recorded episodes do."""
   import numpy as np
 
   from tensor2robot_tpu.data import example_codec, native_io
   from tensor2robot_tpu.modes import ModeKeys
-  from tensor2robot_tpu.specs import SpecStruct
+  from tensor2robot_tpu.specs import SpecStruct, algebra
 
-  in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+  merged = {}
+  for getter in (model.preprocessor.get_in_feature_specification,
+                 model.preprocessor.get_in_label_specification):
+    spec = getter(ModeKeys.TRAIN)
+    if spec is not None:
+      merged.update(algebra.flatten_spec_structure(spec).items())
   rng = np.random.RandomState(0)
   per_shard = num_examples // num_shards
   for s in range(num_shards):
-    path = os.path.join(out_dir, f'grasp2vec-{s:05d}.tfrecord')
+    path = os.path.join(out_dir, f'data-{s:05d}.tfrecord')
     with native_io.NativeRecordWriter(path) as writer:
       for _ in range(per_shard):
         example = SpecStruct()
-        for key, spec in in_spec.items():
-          # Smooth random images: noise jpegs are pathologically large.
-          base = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
-          import PIL.Image
+        for key, spec in merged.items():
+          dtype = np.dtype(spec.dtype)
+          if dtype == np.uint8 and len(spec.shape) == 3:
+            # Smooth random images: noise jpegs are pathologically large.
+            base = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+            import PIL.Image
 
-          img = np.asarray(
-              PIL.Image.fromarray(base).resize(
-                  (spec.shape[1], spec.shape[0]), PIL.Image.BILINEAR))
-          example[key] = img.astype(spec.dtype)
-        writer.write(example_codec.encode_example(in_spec, example))
-  return os.path.join(out_dir, 'grasp2vec-*.tfrecord')
+            img = np.asarray(
+                PIL.Image.fromarray(base).resize(
+                    (spec.shape[1], spec.shape[0]), PIL.Image.BILINEAR))
+            example[key] = img.astype(dtype)
+          elif np.issubdtype(dtype, np.floating):
+            example[key] = rng.randn(*spec.shape).astype(dtype)
+          else:
+            example[key] = rng.randint(
+                0, 2, spec.shape).astype(dtype)
+        writer.write(example_codec.encode_example(merged, example))
+  return os.path.join(out_dir, 'data-*.tfrecord')
+
+
+def make_model(workload: str):
+  if workload == 'grasp2vec':
+    from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
+
+    return Grasp2VecModel(device_type='tpu')
+  if workload == 'qtopt':
+    from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+
+    return GraspingModelWrapper(device_type='tpu')
+  raise ValueError(f'unknown workload {workload!r}')
 
 
 def run_profiles(pattern: str, batch: int, steps: int,
-                 per_step: bool = False):
+                 per_step: bool = False, workload: str = 'grasp2vec'):
   """One Trainer, one compiled executable, three measurements.
 
   Building several Trainers (several executables) makes the tunneled
@@ -75,7 +101,6 @@ def run_profiles(pattern: str, batch: int, steps: int,
       NativeRecordInputGenerator)
   from tensor2robot_tpu.modes import ModeKeys
   from tensor2robot_tpu.parallel import mesh as mesh_lib
-  from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
   from tensor2robot_tpu.train import Trainer, TrainerConfig
 
   def cfg(max_steps, prefetch):
@@ -106,7 +131,7 @@ def run_profiles(pattern: str, batch: int, steps: int,
       self.last = now
 
   timer = _StepTimer()
-  model = Grasp2VecModel(device_type='tpu')
+  model = make_model(workload)
   trainer = Trainer(model, cfg(3, 0), callbacks=[timer])
   gen = NativeRecordInputGenerator(file_patterns=pattern, batch_size=batch,
                                    shuffle_buffer_size=8, seed=0)
@@ -166,19 +191,19 @@ def main():
   parser.add_argument('--batch', type=int, default=16)
   parser.add_argument('--examples', type=int, default=64)
   parser.add_argument('--per_step', action='store_true')
+  parser.add_argument('--workload', default='grasp2vec',
+                      choices=('grasp2vec', 'qtopt'))
   args = parser.parse_args()
   if args.steps < 2:
     parser.error('--steps must be >= 2 (first step per window is dropped)')
 
-  from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
-
   data_dir = tempfile.mkdtemp(prefix='t2r_recdata_')
   pattern = generate_shards(
-      Grasp2VecModel(device_type='tpu'), data_dir,
-      num_examples=args.examples)
+      make_model(args.workload), data_dir, num_examples=args.examples)
   print(f'generated shards: {pattern}')
   results, device_ms = run_profiles(pattern, args.batch, args.steps,
-                                    per_step=args.per_step)
+                                    per_step=args.per_step,
+                                    workload=args.workload)
   print(f'device-resident step: {device_ms:.1f} ms')
   for prefetch, r in results.items():
     print(f"prefetch={prefetch}: median {r['median']:.0f} ms/step "
