@@ -193,6 +193,11 @@ def main():
   parser.add_argument('--per_step', action='store_true')
   parser.add_argument('--workload', default='grasp2vec',
                       choices=('grasp2vec', 'qtopt'))
+  parser.add_argument('--json', action='store_true',
+                      help='emit ONE machine-readable summary line '
+                           '(bench.py subprocess mode); the best prefetch '
+                           'config is the headline — prefetch depth is '
+                           'pipeline configuration, not workload')
   args = parser.parse_args()
   if args.steps < 2:
     parser.error('--steps must be >= 2 (first step per window is dropped)')
@@ -200,10 +205,27 @@ def main():
   data_dir = tempfile.mkdtemp(prefix='t2r_recdata_')
   pattern = generate_shards(
       make_model(args.workload), data_dir, num_examples=args.examples)
-  print(f'generated shards: {pattern}')
+  if not args.json:
+    print(f'generated shards: {pattern}')
   results, device_ms = run_profiles(pattern, args.batch, args.steps,
                                     per_step=args.per_step,
                                     workload=args.workload)
+  if args.json:
+    import json
+
+    best_prefetch = min(results, key=lambda p: results[p]['median'])
+    best = results[best_prefetch]
+    print(json.dumps({
+        'workload': args.workload,
+        'batch_size': args.batch,
+        'median_ms_per_step': round(best['median'], 1),
+        'p90_ms_per_step': round(best['p90'], 1),
+        'steps_per_sec': round(1000.0 / best['median'], 3),
+        'device_ms_per_step': round(device_ms, 1),
+        'fraction_of_device_floor': round(device_ms / best['median'], 3),
+        'prefetch': best_prefetch,
+    }))
+    return
   print(f'device-resident step: {device_ms:.1f} ms')
   for prefetch, r in results.items():
     print(f"prefetch={prefetch}: median {r['median']:.0f} ms/step "
