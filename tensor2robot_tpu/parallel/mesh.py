@@ -127,7 +127,7 @@ def global_batch_size(per_device_batch: int, mesh: Mesh) -> int:
   return per_device_batch * n
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
+def shard_batch(batch: Any, mesh: Mesh, formats: Any = None) -> Any:
   """Places a batch onto the mesh, sharded on the batch axes.
 
   Single-process: ``batch`` is the global batch; a plain sharded
@@ -138,12 +138,22 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
   per-host feeding from TPUEstimator's per-host ``input_fn``
   (``utils/tfdata.py:43-66``); feeding a host-global batch on every host
   would silently duplicate data across hosts.
+
+  ``formats``: optional pytree of ``jax.experimental.layout.Format``
+  matching ``batch`` — place each leaf in the COMPILED EXECUTABLE's
+  preferred layout (see ``Trainer`` auto input layouts) so XLA never
+  re-lays the batch out inside the step. Single-process only; the
+  multi-host assembly path ignores it.
   """
-  sharding = batch_sharding(mesh)
   if jax.process_count() > 1:
+    sharding = batch_sharding(mesh)
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)), batch)
+  if formats is not None:
+    return jax.tree_util.tree_map(
+        lambda x, f: jax.device_put(x, f), batch, formats)
+  sharding = batch_sharding(mesh)
   return jax.tree_util.tree_map(
       lambda x: jax.device_put(x, sharding), batch)
 

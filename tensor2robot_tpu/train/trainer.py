@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -94,6 +95,21 @@ class TrainerConfig:
   # host, the worker thread CONTENDS with dispatch instead of
   # overlapping it (record-fed grasp2vec: 297 → 663 ms/step median).
   prefetch_batches: Optional[int] = None
+  # Compiler-chosen input layouts for the BATCH arguments: the train
+  # step is additionally lowered with AUTO layouts and batches are
+  # placed in the layout the executable prefers, so XLA never inserts
+  # a re-layout copy at the parameter boundary (the WTL episode batch
+  # paid 2×0.9 ms/step re-laying 507 MB of uint8 input). None = auto:
+  # on for TPU backends, off elsewhere and for multi-host feeding
+  # (the process-local assembly path has no layout control).
+  auto_input_layouts: Optional[bool] = None
+
+  def resolved_auto_input_layouts(self) -> bool:
+    if jax.process_count() > 1:
+      return False
+    if self.auto_input_layouts is not None:
+      return self.auto_input_layouts
+    return jax.default_backend() == 'tpu'
 
   def resolved_prefetch_batches(self) -> int:
     if self.prefetch_batches is not None:
@@ -220,6 +236,13 @@ class Trainer:
     self._state: Optional[TrainState] = None
     self._train_step_fn = None
     self._eval_step_fn = None
+    # Auto (compiler-chosen) input-layout executable; built lazily from
+    # the first host batch's avals (see _maybe_build_auto_step).
+    self._auto_step = None
+    self._batch_formats = None
+    self._auto_batch_avals = None
+    self._auto_disabled = not config.resolved_auto_input_layouts()
+    self._auto_build_lock = threading.Lock()
     self._manager: Optional[ckpt_lib.CheckpointManager] = None
     if config.model_dir:
       self._manager = ckpt_lib.CheckpointManager(
@@ -257,7 +280,7 @@ class Trainer:
 
   # ------------------------------------------------------------ step builds
 
-  def _build_train_step(self):
+  def _train_step_body(self):
     model = self._model
     preprocessor = self._preprocessor
     optimizer = self._optimizer
@@ -296,13 +319,86 @@ class Trainer:
       scalars['loss'] = loss
       return new_state, scalars
 
+    return train_step
+
+  def _build_train_step(self):
     state_sharding = self._state_sharding()
     batch_sharding = mesh_lib.batch_sharding(self._mesh)
     return jax.jit(
-        train_step,
+        self._train_step_body(),
         in_shardings=(state_sharding, batch_sharding, batch_sharding),
         out_shardings=(state_sharding, None),
         donate_argnums=(0,))
+
+  def _maybe_build_auto_step(self, features, labels) -> bool:
+    """Compiles the train step with compiler-chosen (AUTO) batch layouts.
+
+    ``features``/``labels`` are a HOST batch (avals only). On success
+    the train loop dispatches ``self._auto_step`` and ``place`` uses
+    ``self._batch_formats``; any failure (backend without layout
+    support, exotic batch leaves) permanently falls back to the default
+    jitted step. Thread-safe: the prefetcher's worker may be the first
+    caller.
+    """
+    if self._auto_step is not None:
+      return True
+    if self._auto_disabled or self._state is None:
+      return False
+    with self._auto_build_lock:
+      if self._auto_step is not None:
+        return True
+      if self._auto_disabled:
+        return False
+      try:
+        from jax.experimental.layout import Format, Layout
+
+        state_sharding = self._state_sharding()
+        auto = Format(Layout.AUTO, mesh_lib.batch_sharding(self._mesh))
+        jitted = jax.jit(
+            self._train_step_body(),
+            in_shardings=(state_sharding, auto, auto),
+            out_shardings=(state_sharding, None),
+            donate_argnums=(0,))
+        compiled = jitted.lower(self._state, features, labels).compile()
+        (state_fmt, feat_fmt, label_fmt), _ = compiled.input_formats
+        leaves, treedef = jax.tree_util.tree_flatten((features, labels))
+        self._auto_batch_avals = (
+            treedef, [(tuple(np.shape(x)), np.result_type(x))
+                      for x in leaves])
+        # The executable's expected STATE layouts must match how the
+        # state is actually placed (state keeps its concrete sharding;
+        # only batches are AUTO) — a mismatch would error mid-train, so
+        # verify statically and fall back instead.
+        placed = [getattr(leaf, 'format', None)
+                  for leaf in jax.tree_util.tree_leaves(self._state)]
+        expected = list(jax.tree_util.tree_leaves(state_fmt))
+        if len(placed) != len(expected) or any(
+            p is not None and p != e for p, e in zip(placed, expected)):
+          raise ValueError('state layout mismatch vs compiled step')
+        self._batch_formats = (feat_fmt, label_fmt)
+        self._auto_step = compiled
+        return True
+      except Exception as e:  # pylint: disable=broad-except
+        logging.info(
+            'Auto input layouts unavailable (%s); using default layouts.',
+            e)
+        self._auto_disabled = True
+        return False
+
+  def _batch_matches_auto(self, batch: Batch) -> bool:
+    """Whether a batch has the avals the AOT auto-layout step expects.
+
+    The compiled executable is shape-specialized; an off-shape batch
+    (e.g. a ragged final batch from an external iterator) must fall
+    back to the jitted step, which retraces transparently.
+    """
+    if self._auto_batch_avals is None:
+      return False
+    treedef, avals = self._auto_batch_avals
+    leaves, td = jax.tree_util.tree_flatten(batch)
+    return td == treedef and all(
+        tuple(np.shape(x)) == shape and np.result_type(x) == dtype
+        for x, (shape, dtype) in zip(leaves, avals))
 
   def _build_eval_step(self):
     model = self._model
@@ -388,8 +484,15 @@ class Trainer:
     step = self.step
 
     def place(batch: Batch) -> Batch:
-      return (mesh_lib.shard_batch(batch[0], self._mesh),
-              mesh_lib.shard_batch(batch[1], self._mesh))
+      # First placement builds the auto-layout executable from this
+      # batch's avals, so every batch (including this one) lands in the
+      # layout the step prefers — no re-layout copy inside the step.
+      # Off-shape batches (ragged tails) place default and the loop
+      # dispatches the jitted step for them.
+      use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
+                  self._batch_matches_auto(batch))
+      return mesh_lib.shard_batch(
+          batch, self._mesh, self._batch_formats if use_auto else None)
 
     prefetcher: Optional[_DevicePrefetcher] = None
     prefetch_depth = config.resolved_prefetch_batches()
@@ -405,8 +508,13 @@ class Trainer:
           first_batch = None
         else:
           features, labels = next(batches)
-        self._state, scalars = self._train_step_fn(
-            self._state, features, labels)
+        batch_pair = (features, labels)
+        if self._auto_step is not None and self._batch_matches_auto(
+            batch_pair):
+          step_fn = self._auto_step
+        else:
+          step_fn = self._train_step_fn
+        self._state, scalars = step_fn(self._state, features, labels)
         step += 1
         if should_log(config.log_interval_steps, step):
           scalars = {k: float(v) for k, v in scalars.items()}

@@ -38,6 +38,12 @@ def collect_eval_loop(collect_env,
   if pre_collect_eval_fn:
     pre_collect_eval_fn()
 
+  # run_env nests its own policy_<tag>/ below the root it receives, so
+  # records land in <root>/policy_collect/policy_collect/ — the
+  # REFERENCE's exact layout (its continuous_collect_eval.py:80-101
+  # passes the same pre-joined dir to its run_env, which joins
+  # 'policy_%s' % tag again, run_env.py:41). Kept for artifact-path
+  # compatibility with reference-trained pipelines.
   collect_dir = os.path.join(root_dir, 'policy_collect')
   eval_dir = os.path.join(root_dir, 'eval')
 

@@ -117,3 +117,27 @@ def op_table(ops, top=15):
   for k, v in list(ops.items())[:top]:
     lines.append(f'{v:8.3f}  {v / total * 100:5.1f}  {k}')
   return '\n'.join(lines)
+
+
+def device_ms_per_step_loop(step_fn, state, batches, n=10, tracedir=None):
+  """Per-step device ms of a STATEFUL step callable (jitted or
+  AOT-compiled — ``Compiled`` objects cannot be wrapped by
+  :func:`device_ms_per_iter`'s chained jit). The state threading through
+  the loop is the data dependency that stops the backend eliding
+  repeated dispatches. Returns ``(ms_per_step, final_state)``.
+  """
+  import jax
+
+  owns = tracedir is None
+  tracedir = tracedir or tempfile.mkdtemp(prefix='t2r_trace_')
+  # Warm outside the trace (first dispatch after idle can stall).
+  state, _ = step_fn(state, *batches[0])
+  jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+  with jax.profiler.trace(tracedir):
+    for i in range(n):
+      state, _ = step_fn(state, *batches[i % len(batches)])
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+  total_ms, _ = device_op_times(tracedir)
+  if owns:
+    shutil.rmtree(tracedir, ignore_errors=True)
+  return total_ms / n, state
