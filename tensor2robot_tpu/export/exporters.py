@@ -225,11 +225,13 @@ class ModelExporter:
                keep: int = 5,
                serialize_serving: bool = True,
                serving_batch_size: Optional[int] = None,
-               warmup_batch_size: int = 1):
+               warmup_batch_size: int = 1,
+               saved_model: bool = False):
     self._keep = keep
     self._serialize_serving = serialize_serving
     self._serving_batch_size = serving_batch_size
     self._warmup_batch_size = warmup_batch_size
+    self._saved_model = saved_model
     self._checkpointer = ocp.StandardCheckpointer()
 
   def export(self, model, state, export_root: str,
@@ -285,11 +287,40 @@ class ModelExporter:
         # Warmup is best-effort; never abort the export for it.
         logging.warning('Warmup request generation failed: %r', e)
 
+    # 3.5. TF-Serving-consumable SavedModel (saved_model.pb + variables/ +
+    # Servo warmup) in the same version dir. Best-effort like warmup: the
+    # StableHLO artifact remains the primary serving contract.
+    saved_model_ok = False
+    if self._saved_model:
+      try:
+        from tensor2robot_tpu.export import savedmodel as savedmodel_lib
+
+        savedmodel_lib.write_saved_model(
+            model, serving_variables, tmp_dir,
+            warmup_batch_sizes=(self._warmup_batch_size,))
+        saved_model_ok = True
+      except Exception as e:
+        logging.warning(
+            'TF SavedModel export failed for %s; the version still carries '
+            'the StableHLO serving artifact. Error: %r',
+            type(model).__name__, e)
+        # A failure AFTER tf.saved_model.save would otherwise publish a
+        # loadable saved_model.pb (consumers key on file presence) that
+        # the meta records as failed — remove the partial artifact.
+        for name in ('saved_model.pb', 'fingerprint.pb', 'variables',
+                     'assets'):
+          partial = os.path.join(tmp_dir, name)
+          if os.path.isdir(partial):
+            shutil.rmtree(partial, ignore_errors=True)
+          elif os.path.exists(partial):
+            os.remove(partial)
+
     # 4. Reconstruction metadata.
     meta = {
         'model_class': f'{type(model).__module__}.{type(model).__qualname__}',
         'global_step': int(state.step),
         'self_contained_serving_fn': serving_fn_ok,
+        'tf_saved_model': saved_model_ok,
     }
     with open(os.path.join(tmp_dir, EXPORT_META_FILENAME), 'w') as f:
       json.dump(meta, f, indent=2)

@@ -1,0 +1,305 @@
+"""TF-Serving-consumable SavedModel export.
+
+Closes the framework's one documented interop waiver: in addition to the
+StableHLO serving artifact, an export version can now carry a genuine TF
+SavedModel that ``tf.saved_model.load`` / TF-Serving's ``SavedModelBundle``
+consume directly, with both reference receiver flavors
+(``/root/reference/export_generators/default_export_generator.py:47-138``):
+
+* ``serving_default`` — flat raw-tensor inputs keyed by spec path, batch
+  dimension polymorphic, preprocessing INSIDE the graph. This is jax2tf of
+  the SAME hermetic serving fn that ``exporters.serialize_serving_fn``
+  serializes as StableHLO, lowered for cpu AND tpu, so the SavedModel and
+  the jax_export artifact are the same program by construction.
+* ``tf_example`` — per-dataset-key ``input_example_<key>`` string batches
+  parsed with the spec-driven TF parser (``data/example_codec.py`` —
+  FixedLen/VarLen schema, JPEG/PNG decode, bf16 cast-back), then the same
+  converted chain.
+
+plus ``assets.extra/tf_serving_warmup_requests`` — a TFRecord of
+``tensorflow_serving.apis.PredictionLog`` protos
+(``/root/reference/export_generators/abstract_export_generator.py:114-147``).
+The serving proto package is not a dependency of this image, so the three
+wrapper messages are encoded directly on the protobuf wire (field numbers
+from the public ``tensorflow_serving/apis/{prediction_log,predict,
+model}.proto``); the ``TensorProto`` payloads come from
+``tf.make_tensor_proto``, so the tensor encoding is TF's own.
+
+The SavedModel files are written INTO the export version directory (next to
+``state/`` and ``serving_fn.jax_export``), because TF-Serving resolves
+``<model_base_path>/<int_version>/saved_model.pb`` — pointing a serving
+fleet at the trainer's ``export_root`` then works as-is, exactly like the
+reference's estimator exports.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import algebra
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs import numpy_gen
+
+WARMUP_FILENAME = 'tf_serving_warmup_requests'
+SAVED_MODEL_PB = 'saved_model.pb'
+TF_EXAMPLE_SIGNATURE = 'tf_example'
+
+
+def _tf():
+  import tensorflow as tf  # local import: host-only dependency
+  return tf
+
+
+# --------------------------------------------------------------------------
+# Protobuf wire encoding for the TF-Serving wrapper messages.
+# --------------------------------------------------------------------------
+
+
+def _varint(value: int) -> bytes:
+  """Unsigned LEB128 — the protobuf varint."""
+  out = bytearray()
+  while True:
+    bits = value & 0x7F
+    value >>= 7
+    if value:
+      out.append(bits | 0x80)
+    else:
+      out.append(bits)
+      return bytes(out)
+
+
+def _delimited(field_number: int, payload: bytes) -> bytes:
+  """A length-delimited (wire type 2) field."""
+  return _varint((field_number << 3) | 2) + _varint(len(payload)) + payload
+
+
+def encode_model_spec(model_name: str, signature_name: str) -> bytes:
+  """``tensorflow_serving.apis.ModelSpec``: name=1, signature_name=3."""
+  return (_delimited(1, model_name.encode('utf-8')) +
+          _delimited(3, signature_name.encode('utf-8')))
+
+
+def encode_predict_request(
+    model_name: str,
+    inputs: Mapping[str, np.ndarray],
+    signature_name: str = 'serving_default') -> bytes:
+  """``tensorflow_serving.apis.PredictRequest``: model_spec=1, inputs=2.
+
+  ``inputs`` is a ``map<string, TensorProto>``; each map entry is a nested
+  message with key=1, value=2.
+  """
+  tf = _tf()
+  body = _delimited(1, encode_model_spec(model_name, signature_name))
+  for key in sorted(inputs):
+    tensor_proto = tf.make_tensor_proto(inputs[key]).SerializeToString()
+    entry = _delimited(1, key.encode('utf-8')) + _delimited(2, tensor_proto)
+    body += _delimited(2, entry)
+  return body
+
+
+def encode_prediction_log(predict_request: bytes) -> bytes:
+  """``PredictionLog(predict_log=PredictLog(request=...))``.
+
+  ``PredictionLog.predict_log`` is field 6; ``PredictLog.request`` field 1.
+  """
+  return _delimited(6, _delimited(1, predict_request))
+
+
+def write_tf_serving_warmup_requests(
+    export_dir: str,
+    model,
+    model_name: Optional[str] = None,
+    batch_sizes: Sequence[int] = (1,),
+    signature_name: str = 'serving_default') -> str:
+  """``assets.extra/tf_serving_warmup_requests`` for Servo.
+
+  One zero-filled ``PredictionLog`` per batch size, keyed by the required
+  PREDICT in-spec — the reference's ``create_warmup_requests_numpy``
+  (``abstract_export_generator.py:114-147``) on the wire format above.
+  """
+  tf = _tf()
+  in_spec = _serving_input_spec(model)
+  assets_dir = os.path.join(export_dir, assets_lib.EXTRA_ASSETS_DIRECTORY)
+  os.makedirs(assets_dir, exist_ok=True)
+  path = os.path.join(assets_dir, WARMUP_FILENAME)
+  name = model_name or type(model).__name__
+  with tf.io.TFRecordWriter(path) as writer:
+    for batch_size in batch_sizes:
+      features = numpy_gen.make_constant_numpy(
+          in_spec, constant_value=0, batch_size=batch_size)
+      request = encode_predict_request(
+          name, {k: np.asarray(v) for k, v in features.items()},
+          signature_name)
+      writer.write(encode_prediction_log(request))
+  return path
+
+
+# --------------------------------------------------------------------------
+# SavedModel writer.
+# --------------------------------------------------------------------------
+
+
+def _serving_input_spec(model) -> 'algebra.SpecStruct':
+  """The flat REQUIRED raw-feature spec the serving fn takes.
+
+  Identical to the spec ``exporters.serialize_serving_fn`` traces over, so
+  both artifacts share one calling convention.
+  """
+  return algebra.filter_required_flat_tensor_spec(
+      model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
+
+
+def _tf_input_signature(in_spec) -> Dict[str, object]:
+  tf = _tf()
+  return {
+      key: tf.TensorSpec([None] + [int(d) for d in spec.shape],
+                         tf.dtypes.as_dtype(spec.dtype.name), name=key)
+      for key, spec in in_spec.items()
+  }
+
+
+def build_serving_module(
+    model,
+    serving_variables,
+    platforms: Optional[Sequence[str]] = None) -> Tuple[object, Dict]:
+  """A ``tf.Module`` holding the variables + its serving signatures.
+
+  Returns ``(module, signatures)`` ready for ``tf.saved_model.save``. The
+  variables live as ``tf.Variable``s inside the module, so TF-Serving's
+  standard variable restore applies; the compute is one ``XlaCallModule``
+  produced by jax2tf native serialization of the hermetic serving fn.
+  """
+  import jax
+  from jax.experimental import jax2tf
+
+  from tensor2robot_tpu.export import exporters
+
+  tf = _tf()
+  in_spec = _serving_input_spec(model)
+  for key, spec in in_spec.items():
+    if spec.is_sequence or any(d is None for d in spec.shape):
+      raise ValueError(
+          f'SavedModel serving requires static per-example shapes; spec '
+          f'{key!r} ({spec}) has a dynamic/sequence dimension. Serve this '
+          f'model through the StableHLO artifact instead.')
+
+  serving_fn = exporters.build_serving_fn(model)
+  variables = exporters.to_plain_tree(serving_variables)
+  poly_features = {
+      key: '(b, ' + ', '.join('_' for _ in spec.shape) + ')'
+      if spec.shape else '(b,)'
+      for key, spec in in_spec.items()
+  }
+  if platforms is None:
+    # jax.default_backend() says 'gpu' where jax2tf's platform set says
+    # 'cuda'/'rocm'; canonicalize and keep only names jax2tf accepts.
+    backend = {'gpu': 'cuda'}.get(jax.default_backend(),
+                                  jax.default_backend())
+    platforms = sorted(
+        ({'cpu', backend} | {'tpu'}) & {'cpu', 'cuda', 'rocm', 'tpu'})
+  converted = jax2tf.convert(
+      serving_fn,
+      polymorphic_shapes=[None, poly_features],
+      with_gradient=False,
+      native_serialization_platforms=tuple(platforms))
+
+  class ServingModule(tf.Module):
+
+    def __init__(self):
+      super().__init__(name='t2r_serving')
+      self.model_variables = tf.nest.map_structure(tf.Variable, variables)
+
+    @tf.function(autograph=False)
+    def serve(self, features):
+      return converted(self.model_variables, features)
+
+  module = ServingModule()
+  signatures = {
+      'serving_default':
+          module.serve.get_concrete_function(_tf_input_signature(in_spec)),
+  }
+
+  example_signature = _build_tf_example_signature(model, module, in_spec)
+  if example_signature is not None:
+    signatures[TF_EXAMPLE_SIGNATURE] = example_signature
+  return module, signatures
+
+
+def _build_tf_example_signature(model, module, in_spec):
+  """The serialized-``tf.Example`` receiver, parse inside the graph.
+
+  Mirrors ``create_serving_input_receiver_tf_example_fn``
+  (``default_export_generator.py:90-138``): one string input per
+  ``dataset_key``, named ``input_example_<key or 'tensor'>``, run through
+  the spec-driven TF parser (schema + image decode + bf16 cast), then the
+  same converted serving chain. Returns None (with a log line) for spec
+  features the batched parser cannot produce with static shapes.
+  """
+  tf = _tf()
+  try:
+    from tensor2robot_tpu.data import example_codec
+  except Exception as e:  # TF host lib unavailable
+    logging.info('tf_example signature skipped: %r', e)
+    return None
+
+  dataset_keys = sorted({spec.dataset_key or '' for spec in in_spec.values()})
+  receiver_names = {
+      dataset_key: 'input_example_' + (dataset_key.rstrip('/') or 'tensor')
+      for dataset_key in dataset_keys
+  }
+  parse_fn = example_codec.make_parse_fn(in_spec)
+
+  # tf.function args must be valid identifiers; map back to dataset keys.
+  arg_names = sorted(receiver_names.values())
+
+  @tf.function(autograph=False)
+  def serve_examples(**kwargs):
+    streams = {
+        dataset_key: kwargs[name]
+        for dataset_key, name in receiver_names.items()
+    }
+    parsed = parse_fn(streams)
+    features = {key: parsed[key] for key in in_spec.keys()}
+    return module.serve(features)
+
+  specs = {
+      name: tf.TensorSpec([None], tf.string, name=name) for name in arg_names
+  }
+  try:
+    return serve_examples.get_concrete_function(**specs)
+  except Exception as e:
+    logging.warning(
+        'tf_example signature could not be traced for %s (the raw-tensor '
+        'serving_default signature is unaffected): %r',
+        type(model).__name__, e)
+    return None
+
+
+def write_saved_model(
+    model,
+    serving_variables,
+    export_dir: str,
+    model_name: Optional[str] = None,
+    warmup_batch_sizes: Sequence[int] = (1,),
+    platforms: Optional[Sequence[str]] = None) -> str:
+  """Writes a TF-Serving-loadable SavedModel into ``export_dir``.
+
+  ``export_dir`` is the (numeric) export version directory; after this call
+  it contains ``saved_model.pb`` + ``variables/`` +
+  ``assets.extra/tf_serving_warmup_requests`` next to the framework's own
+  artifacts, so both a jax robot host and a TF-Serving fleet can consume
+  the same version.
+  """
+  tf = _tf()
+  module, signatures = build_serving_module(
+      model, serving_variables, platforms=platforms)
+  tf.saved_model.save(module, export_dir, signatures=signatures)
+  write_tf_serving_warmup_requests(
+      export_dir, model, model_name=model_name,
+      batch_sizes=warmup_batch_sizes)
+  return export_dir

@@ -5,3 +5,12 @@ from tensor2robot_tpu.predictors.predictors import (
     CheckpointPredictor,
     ExportedModelPredictor,
 )
+
+
+def __getattr__(name):
+  # Lazy: SavedModelPredictor pulls in TF; jax-only hosts shouldn't pay.
+  if name == 'SavedModelPredictor':
+    from tensor2robot_tpu.predictors.savedmodel_predictor import (
+        SavedModelPredictor)
+    return SavedModelPredictor
+  raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -210,6 +210,28 @@ class CheckpointPredictor(AbstractPredictor):
     return self._global_step
 
 
+def poll_and_load_newest(list_dirs_fn, loaded_dir, timeout,
+                         load_fn) -> bool:
+  """Shared restore contract of the export-root predictors.
+
+  Busy-waits (``exported_savedmodel_predictor.py:120-202``): scan with
+  ``list_dirs_fn``, load the newest version when it differs from
+  ``loaded_dir``, and tolerate the trainer not having exported yet until
+  ``timeout`` elapses.
+  """
+  deadline = time.time() + timeout
+  while True:
+    dirs = list_dirs_fn()
+    if dirs:
+      newest = dirs[-1]
+      if newest != loaded_dir:
+        return load_fn(newest)
+      return True
+    if time.time() >= deadline:
+      return False
+    time.sleep(1.0)
+
+
 class ExportedModelPredictor(AbstractPredictor):
   """Polls a versioned export root (exported_savedmodel_predictor.py).
 
@@ -246,17 +268,9 @@ class ExportedModelPredictor(AbstractPredictor):
     return self._feature_spec
 
   def restore(self) -> bool:
-    deadline = time.time() + self._timeout
-    while True:
-      dirs = exporters_lib.valid_export_dirs(self._export_root)
-      if dirs:
-        newest = dirs[-1]
-        if newest != self._loaded_dir:
-          return self._load(newest)
-        return True
-      if time.time() >= deadline:
-        return False
-      time.sleep(1.0)
+    return poll_and_load_newest(
+        lambda: exporters_lib.valid_export_dirs(self._export_root),
+        self._loaded_dir, self._timeout, self._load)
 
   def _load(self, export_dir: str) -> bool:
     import hashlib

@@ -1,0 +1,137 @@
+"""A predictor that serves from the exported TF SavedModel.
+
+The TF-side twin of :class:`~tensor2robot_tpu.predictors.predictors.
+ExportedModelPredictor`: it polls the same versioned export root, but loads
+``saved_model.pb`` with ``tf.saved_model.load`` and serves through a
+SavedModel signature — exactly what a TF-Serving binary does with the same
+files. Exists so the SavedModel interop path
+(``export/savedmodel.py``) has a first-class in-process consumer and a
+parity test surface against the jax predictors
+(``/root/reference/predictors/exported_savedmodel_predictor.py:60-214``).
+
+TF is imported lazily: jax-only robot hosts never pay the dependency unless
+they instantiate this class.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.export import exporters as exporters_lib
+from tensor2robot_tpu.export import savedmodel as savedmodel_lib
+from tensor2robot_tpu.predictors.predictors import (AbstractPredictor,
+                                                    _expand_to_spec_rank,
+                                                    poll_and_load_newest)
+from tensor2robot_tpu.specs import SpecStruct, algebra
+
+
+def _saved_model_dirs(export_root: str):
+  """Export versions that carry a loadable SavedModel."""
+  return [
+      d for d in exporters_lib.valid_export_dirs(export_root)
+      if os.path.exists(os.path.join(d, savedmodel_lib.SAVED_MODEL_PB))
+  ]
+
+
+class SavedModelPredictor(AbstractPredictor):
+  """Serves the newest export version through its SavedModel signature."""
+
+  def __init__(self,
+               export_dir: str,
+               signature_name: str = 'serving_default',
+               timeout: float = 0.0):
+    self._export_root = export_dir
+    self._signature_name = signature_name
+    self._timeout = timeout
+    self._signature = None
+    self._loaded_model = None  # keep the SavedModel object alive
+    self._feature_spec: Optional[SpecStruct] = None
+    self._global_step = -1
+    self._loaded_dir: Optional[str] = None
+
+  def get_feature_specification(self) -> SpecStruct:
+    if self._feature_spec is None:
+      raise ValueError('restore() must succeed before specs are available.')
+    return self._feature_spec
+
+  def restore(self) -> bool:
+    return poll_and_load_newest(
+        lambda: _saved_model_dirs(self._export_root),
+        self._loaded_dir, self._timeout, self._load)
+
+  def _load(self, export_dir: str) -> bool:
+    import tensorflow as tf
+
+    from tensor2robot_tpu.specs import load_specs_from_export_dir
+
+    feature_spec, _, global_step = load_specs_from_export_dir(export_dir)
+    loaded = tf.saved_model.load(export_dir)
+    if self._signature_name not in loaded.signatures:
+      raise ValueError(
+          f'SavedModel at {export_dir!r} has no signature '
+          f'{self._signature_name!r}; available: '
+          f'{sorted(loaded.signatures.keys())}')
+    self._loaded_model = loaded
+    self._signature = loaded.signatures[self._signature_name]
+    self._feature_spec = algebra.filter_required_flat_tensor_spec(
+        feature_spec)
+    self._global_step = global_step
+    self._loaded_dir = export_dir
+    return True
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    import tensorflow as tf
+
+    self.assert_is_loaded()
+    features = _expand_to_spec_rank(features, self._feature_spec)
+    feeds = {}
+    for key, value in features.items():
+      dtype = None
+      if key in self._feature_spec:
+        dtype = tf.dtypes.as_dtype(self._feature_spec[key].dtype.name)
+      feeds[key] = tf.constant(np.asarray(value), dtype=dtype)
+    outputs = self._signature(**feeds)
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def predict_example_bytes(self, serialized_examples) -> Dict[str, Any]:
+    """Serialized tf.Example bytes → outputs via the ``tf_example`` sig."""
+    import tensorflow as tf
+
+    self.assert_is_loaded()
+    examples_sig = self._loaded_model.signatures.get(
+        savedmodel_lib.TF_EXAMPLE_SIGNATURE)
+    if examples_sig is None:
+      raise ValueError(
+          f'SavedModel at {self._loaded_dir!r} was exported without the '
+          f'{savedmodel_lib.TF_EXAMPLE_SIGNATURE!r} signature.')
+    arg_names = sorted(examples_sig.structured_input_signature[1])
+    if len(arg_names) != 1:
+      raise ValueError(
+          'Multi-dataset tf_example signatures need per-dataset feeds; '
+          f'call the signature directly with its named inputs {arg_names}.')
+    batch = tf.constant(list(serialized_examples), dtype=tf.string)
+    outputs = examples_sig(**{arg_names[0]: batch})
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  @property
+  def is_loaded(self) -> bool:
+    return self._signature is not None
+
+  @property
+  def global_step(self) -> int:
+    return self._global_step
+
+  @property
+  def model_path(self) -> Optional[str]:
+    return self._loaded_dir
+
+  @property
+  def export_meta(self) -> Dict[str, Any]:
+    self.assert_is_loaded()
+    with open(os.path.join(self._loaded_dir,
+                           exporters_lib.EXPORT_META_FILENAME)) as f:
+      return json.load(f)

@@ -483,16 +483,20 @@ class Trainer:
     # (int(state.step)) after every dispatch, serializing the pipeline.
     step = self.step
 
-    def place(batch: Batch) -> Batch:
+    def place(batch: Batch):
       # First placement builds the auto-layout executable from this
       # batch's avals, so every batch (including this one) lands in the
       # layout the step prefers — no re-layout copy inside the step.
       # Off-shape batches (ragged tails) place default and the loop
-      # dispatches the jitted step for them.
+      # dispatches the jitted step for them. The auto decision travels
+      # WITH the placed batch: dispatching a default-layout batch into
+      # the layout-specialized executable would be a runtime error, so
+      # the choice is made exactly once, here.
       use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
                   self._batch_matches_auto(batch))
-      return mesh_lib.shard_batch(
+      placed = mesh_lib.shard_batch(
           batch, self._mesh, self._batch_formats if use_auto else None)
+      return placed, use_auto
 
     prefetcher: Optional[_DevicePrefetcher] = None
     prefetch_depth = config.resolved_prefetch_batches()
@@ -504,16 +508,12 @@ class Trainer:
     try:
       while step < config.max_train_steps:
         if first_batch is not None:
-          features, labels = place(first_batch)
+          (features, labels), use_auto = place(first_batch)
           first_batch = None
         else:
-          features, labels = next(batches)
-        batch_pair = (features, labels)
-        if self._auto_step is not None and self._batch_matches_auto(
-            batch_pair):
-          step_fn = self._auto_step
-        else:
-          step_fn = self._train_step_fn
+          (features, labels), use_auto = next(batches)
+        step_fn = (self._auto_step if use_auto and self._auto_step is not None
+                   else self._train_step_fn)
         self._state, scalars = step_fn(self._state, features, labels)
         step += 1
         if should_log(config.log_interval_steps, step):

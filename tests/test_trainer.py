@@ -469,9 +469,12 @@ def test_auto_input_layouts_matches_default_path():
   trainer_auto, loss_auto = run(True)
   trainer_def, loss_def = run(False)
   assert trainer_def._auto_step is None
-  # The auto path either built its executable (and placed batches in
-  # its preferred formats) or fell back loudly-but-gracefully on a
-  # backend without layout support; training matches either way.
-  if trainer_auto._auto_step is not None:
-    assert trainer_auto._batch_formats is not None
+  # XLA CPU (this suite's backend) and TPU both support Layout.AUTO, so
+  # the executable MUST have been built — a silent fallback here would
+  # mean the production dispatch path quietly reverted to default
+  # layouts everywhere (e.g. a jax API rename swallowed by the
+  # build-time except). Backends genuinely without layout support fall
+  # back loudly at build time instead.
+  assert trainer_auto._auto_step is not None
+  assert trainer_auto._batch_formats is not None
   np.testing.assert_allclose(loss_auto, loss_def, rtol=1e-5)
