@@ -109,6 +109,17 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
   axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
   return NamedSharding(mesh, P(axes if axes else None))
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+  """For ``[K, batch, ...]`` step-groups: dim 1 is the batch dim.
+
+  ``Trainer(steps_per_dispatch=K)`` stacks K batches per dispatch; the
+  scan axis (dim 0) stays unsharded, the per-step batch dim shards over
+  the usual batch axes.
+  """
+  axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+  return NamedSharding(mesh, P(None, axes if axes else None))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P())
 
@@ -127,7 +138,8 @@ def global_batch_size(per_device_batch: int, mesh: Mesh) -> int:
   return per_device_batch * n
 
 
-def shard_batch(batch: Any, mesh: Mesh, formats: Any = None) -> Any:
+def shard_batch(batch: Any, mesh: Mesh, formats: Any = None,
+                stacked: bool = False) -> Any:
   """Places a batch onto the mesh, sharded on the batch axes.
 
   Single-process: ``batch`` is the global batch; a plain sharded
@@ -144,16 +156,18 @@ def shard_batch(batch: Any, mesh: Mesh, formats: Any = None) -> Any:
   preferred layout (see ``Trainer`` auto input layouts) so XLA never
   re-lays the batch out inside the step. Single-process only; the
   multi-host assembly path ignores it.
+
+  ``stacked``: the batch is a ``[K, batch, ...]`` step-group
+  (``steps_per_dispatch``); shard dim 1 instead of dim 0.
   """
+  sharding = stacked_batch_sharding(mesh) if stacked else batch_sharding(mesh)
   if jax.process_count() > 1:
-    sharding = batch_sharding(mesh)
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)), batch)
   if formats is not None:
     return jax.tree_util.tree_map(
         lambda x, f: jax.device_put(x, f), batch, formats)
-  sharding = batch_sharding(mesh)
   return jax.tree_util.tree_map(
       lambda x: jax.device_put(x, sharding), batch)
 

@@ -15,7 +15,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from tensor2robot_tpu.train.trainer import TrainerCallback, should_log
+from tensor2robot_tpu.train.trainer import TrainerCallback
 
 
 class VariableLoggerCallback(TrainerCallback):
@@ -30,7 +30,7 @@ class VariableLoggerCallback(TrainerCallback):
     self._log_values = log_values
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if not should_log(self._log_interval_steps, step):
+    if not trainer.crossed(self._log_interval_steps, step):
       return
     flat = jax.tree_util.tree_leaves_with_path(trainer.state.params)
     for path, value in flat:
@@ -57,8 +57,8 @@ class MetricsLoggerCallback(TrainerCallback):
       f.write(json.dumps(record) + '\n')
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if not scalars or not should_log(trainer.config.log_interval_steps,
-                                      step):
+    if not scalars or not trainer.crossed(trainer.config.log_interval_steps,
+                                          step):
       return
     record = {'kind': 'train', 'step': int(step)}
     record.update({k: float(v) for k, v in scalars.items()})
@@ -85,9 +85,15 @@ class ProfilerCallback(TrainerCallback):
     self._stop_step = start_step + num_steps
     self._logdir = logdir
     self._active = False
+    self._done = False
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if step == self._start_step and not self._active:
+    # >= not ==: with steps_per_dispatch > 1 the loop reports only
+    # dispatch-boundary steps; the trace starts at the first boundary
+    # at-or-after start_step and stops at the first at-or-after
+    # stop_step (covering at least one dispatch even when the window is
+    # narrower than the dispatch stride).
+    if step >= self._start_step and not self._active and not self._done:
       logdir = self._logdir or os.path.join(
           trainer.config.model_dir or '/tmp', 'profile')
       os.makedirs(logdir, exist_ok=True)
@@ -96,6 +102,7 @@ class ProfilerCallback(TrainerCallback):
     elif step >= self._stop_step and self._active:
       jax.profiler.stop_trace()
       self._active = False
+      self._done = True
 
   def end(self, trainer) -> None:
     if self._active:
@@ -136,8 +143,8 @@ class TensorBoardCallback(TrainerCallback):
     writer.flush()
 
   def after_step(self, trainer, step: int, scalars) -> None:
-    if not scalars or not should_log(trainer.config.log_interval_steps,
-                                      step):
+    if not scalars or not trainer.crossed(trainer.config.log_interval_steps,
+                                          step):
       return
     self._write(trainer, 'train', step, scalars)
 

@@ -21,6 +21,7 @@ through the callback protocol (the reference's HookBuilder surface).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import os
 import threading
@@ -43,11 +44,17 @@ Batch = Tuple[Any, Any]
 MetricDict = Dict[str, float]
 
 
-def should_log(interval: int, step: int) -> bool:
-  """``interval == 0`` disables periodic logging; logging every step
-  would force a device sync per dispatch. Shared by the trainer's scalar
-  conversion and every logging callback so the cadence can't drift."""
-  return bool(interval) and step % interval == 0
+def crossed_interval(interval: int, step_before: int, step_after: int) -> bool:
+  """Did the step counter cross a multiple of ``interval``?
+
+  The ONE interval test for the trainer loop and every logging callback
+  (via ``Trainer.crossed``), so the cadence can't drift. ``interval == 0``
+  disables. With ``steps_per_dispatch > 1`` the counter advances K at a
+  time and may jump over exact multiples; an interval fires at the first
+  dispatch boundary on or after each multiple. For K == 1 this reduces
+  exactly to ``step_after % interval == 0``.
+  """
+  return bool(interval) and (step_after // interval) > (step_before // interval)
 
 
 class TrainerCallback:
@@ -103,6 +110,17 @@ class TrainerConfig:
   # on for TPU backends, off elsewhere and for multi-host feeding
   # (the process-local assembly path has no layout control).
   auto_input_layouts: Optional[bool] = None
+  # Train steps folded into ONE device dispatch (TPUEstimator's
+  # iterations_per_loop, tpu_config.py in the reference's stack): the
+  # loop stacks K host batches and a lax.scan runs K optimizer steps
+  # per XLA program, so per-dispatch host overhead (RPC latency on
+  # remote/tunneled devices, python dispatch otherwise) amortizes K×.
+  # Training math is IDENTICAL to K single dispatches (same rng stream:
+  # the per-step fold_in keys off state.step). Logging, checkpointing
+  # and eval quantize to dispatch boundaries — intervals fire at the
+  # first boundary ON OR AFTER each multiple, exactly like
+  # iterations_per_loop; callbacks see only boundary steps.
+  steps_per_dispatch: int = 1
 
   def resolved_auto_input_layouts(self) -> bool:
     if jax.process_count() > 1:
@@ -206,6 +224,48 @@ class _DevicePrefetcher:
       pass
 
 
+def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
+                     max_steps: int) -> Iterator[Batch]:
+  """Stacks K host batches into one ``[K, batch, ...]`` step-group.
+
+  Groups are clipped so the train loop never overshoots ``max_steps``,
+  and close early when the next batch's shapes differ (a ragged tail
+  from an external iterator) — the odd batch starts its own group, so
+  ``np.stack`` always sees uniform shapes. Short groups just retrace the
+  scan executable. Tracks emitted steps itself so grouping stays correct
+  when a prefetcher pulls groups ahead of consumption.
+  """
+  emitted = start_step
+
+  def leaf_shapes(batch):
+    return [np.shape(x) for x in jax.tree_util.tree_leaves(batch)]
+
+  def stacked(group):
+    features = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *[b[0] for b in group])
+    labels = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *[b[1] for b in group])
+    return features, labels
+
+  group: List[Batch] = []
+  for batch in it:
+    if group and leaf_shapes(batch) != leaf_shapes(group[0]):
+      yield stacked(group)
+      emitted += len(group)
+      group = []
+      if emitted >= max_steps:
+        return
+    group.append(batch)
+    if len(group) >= min(k, max_steps - emitted):
+      yield stacked(group)
+      emitted += len(group)
+      group = []
+      if emitted >= max_steps:
+        return
+  if group:
+    yield stacked(group)
+
+
 def _mean_metrics(metric_batches: List[MetricDict]) -> MetricDict:
   if not metric_batches:
     return {}
@@ -233,6 +293,7 @@ class Trainer:
     self._callbacks = list(callbacks)
     self._preprocessor = model.preprocessor
     self._optimizer = model.create_optimizer()
+    self._loop_k = max(1, int(config.steps_per_dispatch))
     self._state: Optional[TrainState] = None
     self._train_step_fn = None
     self._eval_step_fn = None
@@ -243,6 +304,9 @@ class Trainer:
     self._auto_batch_avals = None
     self._auto_disabled = not config.resolved_auto_input_layouts()
     self._auto_build_lock = threading.Lock()
+    # Step the current dispatch started from; callbacks use crossed() so
+    # their interval semantics survive steps_per_dispatch > 1.
+    self._dispatch_start_step = 0
     self._manager: Optional[ckpt_lib.CheckpointManager] = None
     if config.model_dir:
       self._manager = ckpt_lib.CheckpointManager(
@@ -277,6 +341,13 @@ class Trainer:
   @property
   def checkpoint_manager(self) -> Optional[ckpt_lib.CheckpointManager]:
     return self._manager
+
+  def crossed(self, interval: int, step: int) -> bool:
+    """Whether the dispatch that just reported ``step`` crossed a multiple
+    of ``interval`` — the interval test callbacks must use instead of
+    ``step % interval == 0``, which boundary steps (multiples of
+    ``steps_per_dispatch``) rarely satisfy."""
+    return crossed_interval(interval, self._dispatch_start_step, step)
 
   # ------------------------------------------------------------ step builds
 
@@ -321,11 +392,40 @@ class Trainer:
 
     return train_step
 
+  def _multi_step_body(self):
+    """K optimizer steps per XLA program over ``[K, batch, ...]`` groups.
+
+    A ``lax.scan`` of the single-step body: same math and the same rng
+    stream as K separate dispatches (the per-step ``fold_in`` keys off
+    ``state.step``, which the scan carry advances). Returns the LAST
+    step's scalars — the value per-step logging would have reported at
+    the dispatch boundary.
+    """
+    step = self._train_step_body()
+
+    def multi_step(state: TrainState, features_k, labels_k):
+      def body(carry, batch):
+        return step(carry, batch[0], batch[1])
+
+      state, scalars_k = jax.lax.scan(body, state, (features_k, labels_k))
+      return state, jax.tree_util.tree_map(lambda x: x[-1], scalars_k)
+
+    return multi_step
+
+  def _loop_step_body(self):
+    """The body the train loop dispatches (single- or K-step)."""
+    return (self._multi_step_body() if self._loop_k > 1
+            else self._train_step_body())
+
+  def _loop_batch_sharding(self):
+    return (mesh_lib.stacked_batch_sharding(self._mesh)
+            if self._loop_k > 1 else mesh_lib.batch_sharding(self._mesh))
+
   def _build_train_step(self):
     state_sharding = self._state_sharding()
-    batch_sharding = mesh_lib.batch_sharding(self._mesh)
+    batch_sharding = self._loop_batch_sharding()
     return jax.jit(
-        self._train_step_body(),
+        self._loop_step_body(),
         in_shardings=(state_sharding, batch_sharding, batch_sharding),
         out_shardings=(state_sharding, None),
         donate_argnums=(0,))
@@ -353,9 +453,9 @@ class Trainer:
         from jax.experimental.layout import Format, Layout
 
         state_sharding = self._state_sharding()
-        auto = Format(Layout.AUTO, mesh_lib.batch_sharding(self._mesh))
+        auto = Format(Layout.AUTO, self._loop_batch_sharding())
         jitted = jax.jit(
-            self._train_step_body(),
+            self._loop_step_body(),
             in_shardings=(state_sharding, auto, auto),
             out_shardings=(state_sharding, None),
             donate_argnums=(0,))
@@ -482,6 +582,7 @@ class Trainer:
     # Host-side step mirror: reading self.step would force a device sync
     # (int(state.step)) after every dispatch, serializing the pipeline.
     step = self.step
+    last_log_step = step
 
     def place(batch: Batch):
       # First placement builds the auto-layout executable from this
@@ -495,39 +596,54 @@ class Trainer:
       use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
                   self._batch_matches_auto(batch))
       placed = mesh_lib.shard_batch(
-          batch, self._mesh, self._batch_formats if use_auto else None)
+          batch, self._mesh, self._batch_formats if use_auto else None,
+          stacked=self._loop_k > 1)
       return placed, use_auto
+
+    if first_batch is not None:
+      train_iter = itertools.chain([first_batch], train_iter)
+    host_iter: Iterator[Batch] = train_iter
+    if self._loop_k > 1:
+      host_iter = _grouped_batches(
+          train_iter, self._loop_k, step, config.max_train_steps)
 
     prefetcher: Optional[_DevicePrefetcher] = None
     prefetch_depth = config.resolved_prefetch_batches()
     if prefetch_depth > 0:
-      prefetcher = _DevicePrefetcher(train_iter, place, prefetch_depth)
+      prefetcher = _DevicePrefetcher(host_iter, place, prefetch_depth)
       batches: Iterator[Batch] = iter(prefetcher)
     else:
-      batches = (place(b) for b in train_iter)
+      batches = (place(b) for b in host_iter)
     try:
       while step < config.max_train_steps:
-        if first_batch is not None:
-          (features, labels), use_auto = place(first_batch)
-          first_batch = None
-        else:
-          (features, labels), use_auto = next(batches)
+        (features, labels), use_auto = next(batches)
         step_fn = (self._auto_step if use_auto and self._auto_step is not None
                    else self._train_step_fn)
         self._state, scalars = step_fn(self._state, features, labels)
-        step += 1
-        if should_log(config.log_interval_steps, step):
+        before = step
+        self._dispatch_start_step = before
+        if self._loop_k > 1:
+          # Group size travels as the leading (scan) dim; the final
+          # group may be short (max_train_steps or an exhausted input).
+          step += jax.tree_util.tree_leaves(features)[0].shape[0]
+        else:
+          step += 1
+        if crossed_interval(config.log_interval_steps, before, step):
           scalars = {k: float(v) for k, v in scalars.items()}
           dt = time.time() - last_log
           last_log = time.time()
-          scalars['steps_per_sec'] = config.log_interval_steps / max(dt, 1e-9)
+          scalars['steps_per_sec'] = (step - last_log_step) / max(dt, 1e-9)
+          last_log_step = step
         for cb in self._callbacks:
           cb.after_step(self, step, scalars)
-        if (self._manager is not None and config.save_interval_steps and
-            step % config.save_interval_steps == 0):
-          self.save_checkpoint()
+        if (self._manager is not None and
+            crossed_interval(config.save_interval_steps, before, step)):
+          # K > 1 boundary steps are rarely exact interval multiples;
+          # the crossing above is the interval authority, so force past
+          # orbax's own multiple-of-interval should_save.
+          self.save_checkpoint(force=self._loop_k > 1)
         if (eval_iter_fn is not None and config.eval_interval_steps and
-            (step % config.eval_interval_steps == 0 or
+            (crossed_interval(config.eval_interval_steps, before, step) or
              step >= config.max_train_steps)):
           eval_metrics = self.evaluate(eval_iter_fn())
     finally:

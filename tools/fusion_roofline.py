@@ -232,8 +232,13 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
       if line.name != 'XLA Ops':
         continue
       for ev in line.events:
-        total += ev.duration_ps
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
+        # Control-flow REGION events span their body ops (counted
+        # separately on the same line) — skip, as trace_profile does,
+        # or every scan/while program reads 2× its true device time.
+        if re.sub(r'[.\d]+$', '', name) in ('while', 'conditional'):
+          continue
+        total += ev.duration_ps
         ops[name] += ev.duration_ps
     per_plane.append((total, ops))
   if not per_plane:

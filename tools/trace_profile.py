@@ -67,9 +67,16 @@ def device_op_times(tracedir, device_prefix='/device:TPU'):
       if line.name != 'XLA Ops':
         continue
       for ev in line.events:
-        total += ev.duration_ps
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
-        ops[re.sub(r'[.\d]+$', '', name)] += ev.duration_ps
+        key = re.sub(r'[.\d]+$', '', name)
+        if key in ('while', 'conditional'):
+          # Control-flow REGION events span their body; the body ops
+          # appear as separate events on the same line. Counting both
+          # doubles every scan/while program (observed: a lax.scan train
+          # step read exactly 2× its true device time).
+          continue
+        total += ev.duration_ps
+        ops[key] += ev.duration_ps
     per_plane.append((total, ops))
   if not per_plane:
     return 0.0, {}
