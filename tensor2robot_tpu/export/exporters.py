@@ -395,9 +395,10 @@ def create_valid_result_larger(metric_key: str):
 class LatestExporter:
   """Exports on every eval, keeping N newest (LatestExporter semantics)."""
 
-  def __init__(self, name: str = 'latest_exporter_numpy', keep: int = 5):
+  def __init__(self, name: str = 'latest_exporter_numpy', keep: int = 5,
+               saved_model: bool = False):
     self.name = name
-    self._exporter = ModelExporter(keep=keep)
+    self._exporter = ModelExporter(keep=keep, saved_model=saved_model)
 
   def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
     del metrics
@@ -411,10 +412,11 @@ class BestExporter:
   def __init__(self,
                name: str = 'best_exporter_numpy',
                compare_fn: Optional[Callable] = None,
-               keep: int = 5):
+               keep: int = 5,
+               saved_model: bool = False):
     self.name = name
     self._compare_fn = compare_fn or create_valid_result_smaller('loss')
-    self._exporter = ModelExporter(keep=keep)
+    self._exporter = ModelExporter(keep=keep, saved_model=saved_model)
     self._best_metrics: Optional[Dict[str, float]] = None
 
   def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
@@ -429,16 +431,21 @@ class BestExporter:
 
 def create_default_exporters(best_metric_key: str = 'loss',
                              compare_larger: bool = False,
-                             keep: int = 5):
-  """Best + latest exporter pair (train_eval.py:295-361)."""
+                             keep: int = 5,
+                             saved_model: bool = False):
+  """Best + latest exporter pair (train_eval.py:295-361).
+
+  ``saved_model=True`` additionally writes the TF-Serving-consumable
+  SavedModel into every export version (export/savedmodel.py).
+  """
 
   def create_exporters_fn(model):
     del model
     compare = (create_valid_result_larger(best_metric_key) if compare_larger
                else create_valid_result_smaller(best_metric_key))
     return [
-        BestExporter(compare_fn=compare, keep=keep),
-        LatestExporter(keep=keep),
+        BestExporter(compare_fn=compare, keep=keep, saved_model=saved_model),
+        LatestExporter(keep=keep, saved_model=saved_model),
     ]
 
   return create_exporters_fn

@@ -92,7 +92,15 @@ class ProfilerCallback(TrainerCallback):
     # dispatch-boundary steps; the trace starts at the first boundary
     # at-or-after start_step and stops at the first at-or-after
     # stop_step (covering at least one dispatch even when the window is
-    # narrower than the dispatch stride).
+    # narrower than the dispatch stride). A run that resumes already
+    # past the window (checkpoint restore at step >> stop_step) must
+    # never start — a spurious one-dispatch trace on every restart is
+    # worse than no trace — so a dispatch that BEGAN at-or-after
+    # stop_step retires the window instead of opening it.
+    if (not self._done and not self._active and
+        trainer.dispatch_start_step >= self._stop_step):
+      self._done = True
+      return
     if step >= self._start_step and not self._active and not self._done:
       logdir = self._logdir or os.path.join(
           trainer.config.model_dir or '/tmp', 'profile')

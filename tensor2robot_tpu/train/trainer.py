@@ -41,6 +41,9 @@ from tensor2robot_tpu.train.train_state import (TrainState, apply_ema,
                                                 create_train_state)
 
 Batch = Tuple[Any, Any]
+# What the train loop's place() emits and the prefetch queue carries:
+# (placed (features, labels), use_auto_layout_executable).
+PlacedBatch = Tuple[Batch, bool]
 MetricDict = Dict[str, float]
 
 
@@ -156,8 +159,8 @@ class _DevicePrefetcher:
 
   _DONE = object()
 
-  def __init__(self, it: Iterator[Batch], place: Callable[[Batch], Batch],
-               depth: int):
+  def __init__(self, it: Iterator[Batch],
+               place: Callable[[Batch], 'PlacedBatch'], depth: int):
     import queue
     import threading
 
@@ -185,7 +188,7 @@ class _DevicePrefetcher:
   def __iter__(self):
     return self
 
-  def __next__(self) -> Batch:
+  def __next__(self) -> 'PlacedBatch':
     item = self._q.get()
     if item is self._DONE:
       if self._err is not None:
@@ -341,6 +344,11 @@ class Trainer:
   @property
   def checkpoint_manager(self) -> Optional[ckpt_lib.CheckpointManager]:
     return self._manager
+
+  @property
+  def dispatch_start_step(self) -> int:
+    """The step the dispatch that just reported began from (callbacks)."""
+    return self._dispatch_start_step
 
   def crossed(self, interval: int, step: int) -> bool:
     """Whether the dispatch that just reported ``step`` crossed a multiple
@@ -611,7 +619,7 @@ class Trainer:
     prefetch_depth = config.resolved_prefetch_batches()
     if prefetch_depth > 0:
       prefetcher = _DevicePrefetcher(host_iter, place, prefetch_depth)
-      batches: Iterator[Batch] = iter(prefetcher)
+      batches: Iterator[PlacedBatch] = iter(prefetcher)
     else:
       batches = (place(b) for b in host_iter)
     try:
@@ -726,7 +734,8 @@ def train_eval_model(model=None,
                      callbacks: Sequence[TrainerCallback] = (),
                      create_exporters_fn=None,
                      use_continuous_eval: bool = False,
-                     eval_timeout_secs: Optional[float] = 30.0
+                     eval_timeout_secs: Optional[float] = 30.0,
+                     steps_per_dispatch: int = 1,
                      ) -> MetricDict:
   """The reference's `train_eval_model` entry (utils/train_eval.py:394-587).
 
@@ -745,7 +754,8 @@ def train_eval_model(model=None,
       save_interval_steps=save_interval_steps,
       max_checkpoints_to_keep=max_checkpoints_to_keep,
       log_interval_steps=log_interval_steps,
-      seed=seed)
+      seed=seed,
+      steps_per_dispatch=steps_per_dispatch)
   callbacks = list(callbacks)
   exporters = []
   if create_exporters_fn is not None:

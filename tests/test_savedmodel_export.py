@@ -283,3 +283,112 @@ class TestTfExampleSignature:
     for key in out_raw:
       np.testing.assert_allclose(
           out_examples[key], out_raw[key], rtol=1e-5, atol=1e-5)
+
+
+class TestExporterFactoryIntegration:
+
+  def test_latest_exporter_with_saved_model(self, tmp_path):
+    """The eval-exporter factory path (create_default_exporters /
+    LatestExporter) threads saved_model=True through to every export
+    version it writes."""
+    trainer, model = _trained(tmp_path)
+    exporter = export_lib.LatestExporter(saved_model=True)
+    path = exporter.export(trainer, {})
+    assert path is not None
+    assert os.path.exists(os.path.join(path, 'saved_model.pb'))
+    fns = export_lib.create_default_exporters(saved_model=True)(None)
+    assert all(e._exporter._saved_model for e in fns)
+
+
+class TestSavedModelPolicyChain:
+
+  def test_regression_policy_over_savedmodel_predictor(self, tmp_path):
+    """The robot-side chain on the TF path: env obs → pack_features →
+    SavedModel signature → action (the role SavedModel exports serve in
+    the reference's collect loop)."""
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRandomInputGenerator)
+    from tensor2robot_tpu.policies import RegressionPolicy
+    from tensor2robot_tpu.research.pose_env import (PoseEnvRegressionModel,
+                                                    PoseToyEnv)
+
+    model = PoseEnvRegressionModel(device_type='tpu')
+    trainer, model = _trained(
+        tmp_path, model=model,
+        generator=DefaultRandomInputGenerator(batch_size=4), steps=2)
+    _, root = _export(tmp_path, trainer, model)
+
+    predictor = SavedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    policy = RegressionPolicy(t2r_model=model, predictor=predictor)
+    env = PoseToyEnv(seed=12)
+    obs = env.reset()
+    action = policy.SelectAction(obs, None, 0)
+    assert np.asarray(action).shape == (2,)
+
+  def test_multi_dataset_tf_example_signature(self, tmp_path):
+    """Multi-dataset parsing inside the exported graph: one
+    input_example_<dataset_key> string input per dataset, routed by the
+    spec dataset_key exactly like the host parser."""
+    from tensor2robot_tpu.data import example_codec
+    from tensor2robot_tpu.specs import SpecStruct, algebra
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class _MultiMLP(nn.Module):
+
+      @nn.compact
+      def __call__(self, features, train: bool = False):
+        x = jnp.concatenate([
+            features['x1/measured_position'].astype(jnp.float32),
+            features['x2/measured_position'].astype(jnp.float32)], axis=-1)
+        return {'a_predicted': jnp.squeeze(nn.Dense(1)(x), axis=-1)}
+
+    class MultiDatasetModel(MockT2RModel):
+      """The mock's spec family with a network that consumes both
+      dataset-routed inputs."""
+
+      def create_module(self):
+        return _MultiMLP()
+
+    model = MultiDatasetModel(device_type='tpu', multi_dataset=True)
+    trainer = Trainer(model, TrainerConfig(
+        model_dir='', max_train_steps=1, eval_interval_steps=0,
+        log_interval_steps=0))
+    feats = SpecStruct()
+    feats['x1/measured_position'] = np.zeros((4, 2), np.float32)
+    feats['x2/measured_position'] = np.zeros((4, 2), np.float32)
+    trainer.initialize(feats)
+    root = str(tmp_path / 'export')
+    export_lib.ModelExporter(saved_model=True).export(
+        model, trainer.state, root)
+
+    predictor = SavedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    sig = predictor._loaded_model.signatures[
+        savedmodel_lib.TF_EXAMPLE_SIGNATURE]
+    arg_names = sorted(sig.structured_input_signature[1])
+    assert arg_names == ['input_example_dataset1', 'input_example_dataset2']
+
+    in_spec = algebra.filter_required_flat_tensor_spec(
+        model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
+    rng = np.random.RandomState(5)
+    x1 = rng.uniform(-1, 1, (3, 2)).astype(np.float32)
+    x2 = rng.uniform(-1, 1, (3, 2)).astype(np.float32)
+    feeds = {}
+    for name, values in (('dataset1', x1), ('dataset2', x2)):
+      spec_subset = algebra.filter_spec_structure_by_dataset(in_spec, name)
+      feeds['input_example_' + name] = tf.constant([
+          example_codec.encode_example(
+              spec_subset, SpecStruct(
+                  {k: values[i] for k in spec_subset.keys()}))
+          for i in range(3)
+      ])
+    out_examples = {k: np.asarray(v) for k, v in sig(**feeds).items()}
+    out_raw = predictor.predict(
+        {'x1/measured_position': x1, 'x2/measured_position': x2})
+    assert set(out_examples) == set(out_raw)
+    for key in out_raw:
+      np.testing.assert_allclose(
+          out_examples[key], out_raw[key], rtol=1e-5, atol=1e-5)

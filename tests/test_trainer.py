@@ -586,3 +586,39 @@ def test_steps_per_dispatch_handles_ragged_tail():
       steps_per_dispatch=3))
   trainer.train(iter([make_batch(8), make_batch(5)]), None)
   assert int(trainer.step) == 2
+
+
+def test_profiler_callback_window_at_k_dispatch(monkeypatch):
+  """The profile window starts at the first dispatch boundary at-or-after
+  start_step, stops at the first at-or-after stop_step — and a run
+  resumed already past the window never starts a spurious trace."""
+  from tensor2robot_tpu.train.callbacks import ProfilerCallback
+
+  events = []
+  monkeypatch.setattr(jax.profiler, 'start_trace',
+                      lambda logdir: events.append('start'))
+  monkeypatch.setattr(jax.profiler, 'stop_trace',
+                      lambda: events.append('stop'))
+
+  class FakeTrainer:
+    def __init__(self):
+      self.dispatch_start_step = 0
+    class config:  # noqa: N801 - attribute container
+      model_dir = ''
+
+  trainer = FakeTrainer()
+
+  # Fresh run, K=8, window [10, 15): starts at boundary 16, stops at 24.
+  cb = ProfilerCallback(start_step=10, num_steps=5)
+  for before, after in ((0, 8), (8, 16), (16, 24), (24, 32)):
+    trainer.dispatch_start_step = before
+    cb.after_step(trainer, after, {})
+  assert events == ['start', 'stop']
+
+  # Resumed far past the window: no trace at all.
+  events.clear()
+  cb = ProfilerCallback(start_step=10, num_steps=5)
+  for before, after in ((5000, 5008), (5008, 5016)):
+    trainer.dispatch_start_step = before
+    cb.after_step(trainer, after, {})
+  assert events == []

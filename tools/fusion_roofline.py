@@ -218,6 +218,7 @@ def roofline_table(op_times_ms: Dict[str, float], hlo_text: str,
 
 def device_op_times_full(tracedir, device_prefix='/device:TPU'):
   """Like trace_profile.device_op_times but keeps FULL op names."""
+  from tools import trace_profile as trace_profile_lib
   from tools.trace_profile import _parse_xplane
 
   xs = _parse_xplane(tracedir)
@@ -233,10 +234,7 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
         continue
       for ev in line.events:
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
-        # Control-flow REGION events span their body ops (counted
-        # separately on the same line) — skip, as trace_profile does,
-        # or every scan/while program reads 2× its true device time.
-        if re.sub(r'[.\d]+$', '', name) in ('while', 'conditional'):
+        if trace_profile_lib.is_region_event(name):
           continue
         total += ev.duration_ps
         ops[name] += ev.duration_ps

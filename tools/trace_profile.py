@@ -48,6 +48,15 @@ def _parse_xplane(tracedir):
   return xs
 
 
+def is_region_event(op_name: str) -> bool:
+  """XLA control-flow REGION events (while/conditional) span their body
+  ops, which appear as separate events on the same trace line — counting
+  both doubles every scan/while program's device time. Shared by every
+  xplane walker in this repo (also tools/fusion_roofline.py) so the rule
+  can't drift."""
+  return re.sub(r'[.\d]+$', '', op_name) in ('while', 'conditional')
+
+
 def device_op_times(tracedir, device_prefix='/device:TPU'):
   """Aggregates per-op device time (ms) from a trace directory.
 
@@ -68,15 +77,10 @@ def device_op_times(tracedir, device_prefix='/device:TPU'):
         continue
       for ev in line.events:
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
-        key = re.sub(r'[.\d]+$', '', name)
-        if key in ('while', 'conditional'):
-          # Control-flow REGION events span their body; the body ops
-          # appear as separate events on the same line. Counting both
-          # doubles every scan/while program (observed: a lax.scan train
-          # step read exactly 2× its true device time).
+        if is_region_event(name):
           continue
         total += ev.duration_ps
-        ops[key] += ev.duration_ps
+        ops[re.sub(r'[.\d]+$', '', name)] += ev.duration_ps
     per_plane.append((total, ops))
   if not per_plane:
     return 0.0, {}
