@@ -289,6 +289,57 @@ def bench_record_fed_grasp2vec():
   }))
 
 
+def bench_device_cem(n_actions: int = 6):
+  """Device-resident CEM serving latency, trace-measured (ms/action).
+
+  The serving hot loop (SURVEY §3.3: 64 samples × 3 CEM iterations per
+  robot action) as ONE jitted XLA program over the full Grasping44
+  critic with real-size 512×640 uint8 frames
+  (``CEMPolicy(device_resident=True)``, PERF_NOTES "Device-resident
+  CEM"). Wall time through the tunnel measures transport, so the metric
+  is the xplane-traced device time per action — what a robot host with a
+  locally attached accelerator pays (reference envelope: 1–10 Hz,
+  ``/root/reference/README.md:53-56``).
+  """
+  import shutil
+  import tempfile
+
+  import jax
+  import numpy as np
+
+  from tensor2robot_tpu.policies import CEMPolicy
+  from tensor2robot_tpu.predictors import CheckpointPredictor
+  from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+  from tools.trace_profile import device_op_times
+
+  model = GraspingModelWrapper(device_type='tpu')
+  predictor = CheckpointPredictor(model, model_dir='/nonexistent')
+  predictor.init_randomly()
+  policy = CEMPolicy(
+      t2r_model=model, predictor=predictor, action_size=5,
+      cem_samples=64, cem_iters=3, num_elites=6, device_resident=True)
+  state = np.random.RandomState(0).randint(
+      0, 255, (512, 640, 3), dtype=np.int64).astype(np.uint8)
+  policy.SelectAction(state, None, 0)  # compile + warm
+  tracedir = tempfile.mkdtemp(prefix='t2r_cem_trace_')
+  try:
+    with jax.profiler.trace(tracedir):
+      for t in range(n_actions):
+        policy.SelectAction(state, None, t)
+    total_ms, _ = device_op_times(tracedir)
+  finally:
+    shutil.rmtree(tracedir, ignore_errors=True)
+  ms = total_ms / n_actions
+  print(json.dumps({
+      'metric': 'cem_action_device_ms',
+      'value': round(ms, 2),
+      'unit': 'ms',
+      'actions_per_sec': round(1000.0 / ms, 1) if ms else 0,
+      'cem': [64, 3],
+      'frame': [512, 640, 3],
+  }))
+
+
 def bench_native_reader():
   """Native interleave-reader throughput on generated shards — JSON line."""
   import os
@@ -537,6 +588,11 @@ def main():
       bench_flash_attention_streamed()
     except Exception as e:
       print(json.dumps({'metric': 'flash_attention_streamed_suite',
+                        'error': repr(e)[:200]}))
+    try:
+      bench_device_cem()
+    except Exception as e:
+      print(json.dumps({'metric': 'cem_action_device_ms',
                         'error': repr(e)[:200]}))
 
   print(json.dumps({

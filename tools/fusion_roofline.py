@@ -218,8 +218,7 @@ def roofline_table(op_times_ms: Dict[str, float], hlo_text: str,
 
 def device_op_times_full(tracedir, device_prefix='/device:TPU'):
   """Like trace_profile.device_op_times but keeps FULL op names."""
-  from tools import trace_profile as trace_profile_lib
-  from tools.trace_profile import _parse_xplane
+  from tools.trace_profile import _parse_xplane, is_region_event
 
   xs = _parse_xplane(tracedir)
   per_plane = []
@@ -234,7 +233,7 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
         continue
       for ev in line.events:
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
-        if trace_profile_lib.is_region_event(name):
+        if is_region_event(name):
           continue
         total += ev.duration_ps
         ops[name] += ev.duration_ps

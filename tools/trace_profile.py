@@ -48,13 +48,18 @@ def _parse_xplane(tracedir):
   return xs
 
 
+def strip_op_suffix(op_name: str) -> str:
+  """``fusion.123`` → ``fusion``: the HLO instance suffix."""
+  return re.sub(r'[.\d]+$', '', op_name)
+
+
 def is_region_event(op_name: str) -> bool:
   """XLA control-flow REGION events (while/conditional) span their body
   ops, which appear as separate events on the same trace line — counting
   both doubles every scan/while program's device time. Shared by every
   xplane walker in this repo (also tools/fusion_roofline.py) so the rule
-  can't drift."""
-  return re.sub(r'[.\d]+$', '', op_name) in ('while', 'conditional')
+  can't drift. Accepts a raw or already-stripped op name."""
+  return strip_op_suffix(op_name) in ('while', 'conditional')
 
 
 def device_op_times(tracedir, device_prefix='/device:TPU'):
@@ -77,10 +82,11 @@ def device_op_times(tracedir, device_prefix='/device:TPU'):
         continue
       for ev in line.events:
         name = ev_meta.get(ev.metadata_id, '?').split(' = ')[0].lstrip('%')
-        if is_region_event(name):
+        key = strip_op_suffix(name)
+        if is_region_event(key):
           continue
         total += ev.duration_ps
-        ops[re.sub(r'[.\d]+$', '', name)] += ev.duration_ps
+        ops[key] += ev.duration_ps
     per_plane.append((total, ops))
   if not per_plane:
     return 0.0, {}
