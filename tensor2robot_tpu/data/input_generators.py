@@ -98,8 +98,9 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     self._parallel_shards = parallel_shards
     self._seed = seed
 
-  def _create_iterator(self, mode, batch_size):
-    batches = pipeline.numpy_batches(
+  def _make_dataset(self, mode, batch_size):
+    """The ONE dataset definition both iterator flavors build from."""
+    return pipeline.make_dataset(
         self._file_patterns,
         self._feature_spec,
         self._label_spec,
@@ -108,9 +109,32 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         shuffle_buffer_size=self._shuffle_buffer_size,
         parallel_shards=self._parallel_shards,
         seed=self._seed)
+
+  def _create_iterator(self, mode, batch_size):
+    batches = pipeline.as_numpy_iterator(
+        self._make_dataset(mode, batch_size),
+        has_labels=self._label_spec is not None)
     if self._label_spec is not None:
       return batches
     return ((features, None) for features in batches)
+
+  def create_checkpointable_iterator(
+      self, mode: str, batch_size: Optional[int] = None
+  ) -> 'pipeline.CheckpointableNumpyIterator':
+    """Like ``create_iterator`` but with a checkpointable stream position.
+
+    Pair with :class:`~tensor2robot_tpu.train.input_state.
+    InputStateCallback` so a restored trainer resumes the data stream
+    mid-epoch (shuffle buffer and reader offsets included) instead of
+    restarting it.
+    """
+    if self._feature_spec is None:
+      raise ValueError(
+          'Input generator has no specs; call set_specification(_from_model) '
+          'first.')
+    return pipeline.CheckpointableNumpyIterator(
+        self._make_dataset(mode, batch_size or self._batch_size),
+        has_labels=self._label_spec is not None)
 
 
 class FractionalRecordInputGenerator(DefaultRecordInputGenerator):

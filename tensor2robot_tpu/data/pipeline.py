@@ -244,14 +244,64 @@ def make_task_grouped_dataset(file_patterns: str,
   return dataset.prefetch(tf.data.AUTOTUNE)
 
 
+def pack_numpy_element(element, has_labels: bool = True):
+  """One parsed dataset element -> the (features, labels) Batch shape.
+
+  The ONE packing convention for both the plain and the checkpointable
+  iterator paths.
+  """
+  if has_labels:
+    features, labels = element
+    return SpecStruct(features), SpecStruct(labels)
+  return SpecStruct(element), None
+
+
 def as_numpy_iterator(dataset, has_labels: bool = True) -> Iterator:
   """Yields SpecStruct numpy batches from a parsed tf.data.Dataset."""
   for element in dataset.as_numpy_iterator():
     if has_labels:
-      features, labels = element
-      yield SpecStruct(features), SpecStruct(labels)
+      yield pack_numpy_element(element, has_labels=True)
     else:
-      yield SpecStruct(element)
+      features, _ = pack_numpy_element(element, has_labels=False)
+      yield features
+
+
+class CheckpointableNumpyIterator:
+  """Packed-numpy-batch iterator whose STREAM POSITION checkpoints.
+
+  Beyond the reference: its estimator input_fns restart the data stream
+  from scratch on every job restart, silently re-feeding early examples.
+  tf.data iterator checkpointing round-trips the full pipeline state —
+  file-shuffle order, reader offsets, the shuffle BUFFER contents, and
+  rng — so a restored trainer continues exactly where the stream left
+  off. ``save``/``restore`` take a path prefix (a tf Checkpoint write);
+  the restoring process must build the iterator from the same dataset
+  definition (same patterns/seed/batch size), which
+  ``DefaultRecordInputGenerator.create_checkpointable_iterator``
+  guarantees by construction.
+  """
+
+  def __init__(self, dataset, has_labels: bool = True):
+    tf = _tf()
+    self._iterator = iter(dataset)
+    self._checkpoint = tf.train.Checkpoint(iterator=self._iterator)
+    self._has_labels = has_labels
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    element = next(self._iterator)
+    element = _tf().nest.map_structure(lambda t: t.numpy(), element)
+    return pack_numpy_element(element, has_labels=self._has_labels)
+
+  def save(self, path_prefix: str) -> str:
+    return self._checkpoint.write(path_prefix)
+
+  def restore(self, path_prefix: str) -> None:
+    # assert_consumed: a silently-unmatched restore would restart the
+    # stream from zero — the failure mode this class exists to prevent.
+    self._checkpoint.read(path_prefix).assert_consumed()
 
 
 def numpy_batches(file_patterns,

@@ -10,6 +10,7 @@ from tensor2robot_tpu.train.train_state import (
     apply_ema,
     create_train_state,
 )
+from tensor2robot_tpu.train.input_state import InputStateCallback
 from tensor2robot_tpu.train.trainer import (
     Trainer,
     TrainerCallback,

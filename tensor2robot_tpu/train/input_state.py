@@ -1,0 +1,124 @@
+"""Input-stream checkpointing: resume the data pipeline with the model.
+
+Beyond the reference: its estimator jobs rebuild the input_fn from
+scratch on every restart (``utils/train_eval.py`` has no input state),
+so a preempted trainer silently re-feeds the examples its shuffle buffer
+and readers had already advanced past. Here the input stream's position
+is saved ATOMICALLY-ADJACENT to each model checkpoint and restored with
+it:
+
+    gen = DefaultRecordInputGenerator(..., seed=7)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    it = gen.create_checkpointable_iterator(ModeKeys.TRAIN)
+    trainer = Trainer(model, config,
+                      callbacks=[InputStateCallback(it)])
+    trainer.train(it, None)   # resumes both model AND stream state
+
+The callback saves on ``after_checkpoint`` (one state per checkpoint
+step, GC'd alongside) and restores on ``begin`` when the trainer
+restored a step for which a state exists. A missing state (pre-feature
+checkpoints, deleted dirs) logs and falls back to a fresh stream — the
+reference's behavior, never an error.
+
+Exactness caveat: with ``prefetch_batches=N`` the prefetcher has pulled
+up to N batches past the training position when the state is saved, so
+a resume SKIPS those never-trained batches (it never repeats any). Run
+``prefetch_batches=0`` when bit-exact resume matters; the exactness
+test pins that configuration.
+
+Cost caveat: ``iterator.save`` synchronously serializes the FULL
+pipeline state — including the shuffle buffer's contents — inside the
+training loop, so the per-checkpoint stall scales with
+``shuffle_buffer_size`` times the example size (hundreds of MB for
+image streams with the default 1000-element buffer). Size the buffer,
+the save interval, or both accordingly; async model checkpointing does
+not cover this write.
+
+Multi-host: every process saves/restores ITS OWN stream position under
+``input_state/<name>/process_<i>/`` — the per-host input shards
+(``pipeline.shard_filenames_for_process`` / element sharding) have
+independent reader/shuffle state, so sharing one state would make every
+host replay one host's shard.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+from typing import Optional
+
+from tensor2robot_tpu.train.trainer import TrainerCallback
+
+INPUT_STATE_DIRNAME = 'input_state'
+_STEP_RE = re.compile(r'^step_(\d+)$')
+
+
+class InputStateCallback(TrainerCallback):
+  """Saves/restores a checkpointable input iterator with the trainer."""
+
+  def __init__(self, iterator, name: str = 'train', keep: int = 5):
+    """``iterator`` must expose ``save(path_prefix)`` / ``restore(path)``
+    (``pipeline.CheckpointableNumpyIterator``)."""
+    self._iterator = iterator
+    self._name = name
+    self._keep = keep
+
+  def _root(self, trainer) -> Optional[str]:
+    if not trainer.config.model_dir:
+      return None
+    import jax
+
+    return os.path.join(trainer.config.model_dir, INPUT_STATE_DIRNAME,
+                        self._name, f'process_{jax.process_index()}')
+
+  def _step_dirs(self, root):
+    try:
+      entries = os.listdir(root)
+    except FileNotFoundError:
+      return {}
+    return {int(m.group(1)): os.path.join(root, e)
+            for e in entries if (m := _STEP_RE.match(e))}
+
+  def begin(self, trainer) -> None:
+    root = self._root(trainer)
+    step = trainer.step
+    if root is None or step == 0:
+      return
+    path = self._step_dirs(root).get(step)
+    if path is None:
+      logging.warning(
+          'No %r input state for restored step %d under %s; the stream '
+          'restarts from the beginning (examples before the checkpoint '
+          'may repeat).', self._name, step, root)
+      return
+    self._iterator.restore(os.path.join(path, 'state'))
+    logging.info('Restored %r input stream state at step %d.', self._name,
+                 step)
+
+  def after_checkpoint(self, trainer, step: int) -> None:
+    root = self._root(trainer)
+    if root is None:
+      return
+    final_dir = os.path.join(root, f'step_{int(step)}')
+    tmp_dir = os.path.join(root, f'.tmp_{int(step)}')
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir, exist_ok=True)
+    self._iterator.save(os.path.join(tmp_dir, 'state'))
+    shutil.rmtree(final_dir, ignore_errors=True)
+    os.replace(tmp_dir, final_dir)  # atomic: restore never sees partials
+    # GC keys off the checkpoint manager's OWN retention: every model
+    # checkpoint that still exists keeps its stream state (deleting it
+    # would turn a rollback into a silent stream restart — the failure
+    # mode this feature exists to prevent). ``keep`` newest is only the
+    # fallback when no manager tracks retention.
+    by_step = self._step_dirs(root)
+    manager = trainer.checkpoint_manager
+    if manager is not None:
+      retained = set(int(s) for s in manager.all_steps()) | {int(step)}
+      for old in sorted(s for s in by_step if s not in retained):
+        shutil.rmtree(by_step[old], ignore_errors=True)
+    elif self._keep:
+      for old in sorted(by_step)[:-self._keep]:
+        shutil.rmtree(by_step[old], ignore_errors=True)

@@ -575,9 +575,16 @@ class Trainer:
     """Interleaved train/eval loop (train_and_evaluate semantics)."""
     config = self._config
     if self._state is None:
+      resuming = (self._manager is not None and
+                  self._manager.latest_step() is not None)
       features, labels = next(train_iter)
       self.initialize(features)
-      first_batch: Optional[Batch] = (features, labels)
+      # On resume the pulled batch served only as the shape probe: the
+      # restored run must not train on it — an InputStateCallback's
+      # begin() rewinds the stream UNDER it, and without one the
+      # restarted stream repeats examples anyway, so dropping it is
+      # never a loss.
+      first_batch: Optional[Batch] = None if resuming else (features, labels)
     else:
       first_batch = None
 
