@@ -111,12 +111,10 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         seed=self._seed)
 
   def _create_iterator(self, mode, batch_size):
-    batches = pipeline.as_numpy_iterator(
-        self._make_dataset(mode, batch_size),
-        has_labels=self._label_spec is not None)
-    if self._label_spec is not None:
-      return batches
-    return ((features, None) for features in batches)
+    dataset = self._make_dataset(mode, batch_size)
+    has_labels = self._label_spec is not None
+    return (pipeline.pack_numpy_element(element, has_labels)
+            for element in dataset.as_numpy_iterator())
 
   def create_checkpointable_iterator(
       self, mode: str, batch_size: Optional[int] = None

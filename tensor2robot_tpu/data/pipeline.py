@@ -245,11 +245,9 @@ def make_task_grouped_dataset(file_patterns: str,
 
 
 def pack_numpy_element(element, has_labels: bool = True):
-  """One parsed dataset element -> the (features, labels) Batch shape.
-
-  The ONE packing convention for both the plain and the checkpointable
-  iterator paths.
-  """
+  """One parsed dataset element -> the (features, labels-or-None) Batch
+  shape the trainer consumes — the ONE convention shared by the plain
+  and the checkpointable input-generator iterators."""
   if has_labels:
     features, labels = element
     return SpecStruct(features), SpecStruct(labels)
@@ -257,7 +255,12 @@ def pack_numpy_element(element, has_labels: bool = True):
 
 
 def as_numpy_iterator(dataset, has_labels: bool = True) -> Iterator:
-  """Yields SpecStruct numpy batches from a parsed tf.data.Dataset."""
+  """Yields SpecStruct numpy batches from a parsed tf.data.Dataset.
+
+  Legacy convenience shape: BARE features when ``has_labels=False``
+  (``numpy_batches`` callers rely on it); input generators use
+  :func:`pack_numpy_element` for the trainer's Batch shape instead.
+  """
   for element in dataset.as_numpy_iterator():
     if has_labels:
       yield pack_numpy_element(element, has_labels=True)
@@ -282,26 +285,36 @@ class CheckpointableNumpyIterator:
   """
 
   def __init__(self, dataset, has_labels: bool = True):
+    import threading
+
     tf = _tf()
     self._iterator = iter(dataset)
     self._checkpoint = tf.train.Checkpoint(iterator=self._iterator)
     self._has_labels = has_labels
+    # save/restore vs a concurrent next() (the trainer's prefetch worker
+    # advances this iterator from its own thread) is undefined in
+    # tf.data — a torn mid-advance serialization would corrupt the
+    # resumed stream. One lock makes position capture atomic.
+    self._lock = threading.Lock()
 
   def __iter__(self):
     return self
 
   def __next__(self):
-    element = next(self._iterator)
+    with self._lock:
+      element = next(self._iterator)
     element = _tf().nest.map_structure(lambda t: t.numpy(), element)
     return pack_numpy_element(element, has_labels=self._has_labels)
 
   def save(self, path_prefix: str) -> str:
-    return self._checkpoint.write(path_prefix)
+    with self._lock:
+      return self._checkpoint.write(path_prefix)
 
   def restore(self, path_prefix: str) -> None:
     # assert_consumed: a silently-unmatched restore would restart the
     # stream from zero — the failure mode this class exists to prevent.
-    self._checkpoint.read(path_prefix).assert_consumed()
+    with self._lock:
+      self._checkpoint.read(path_prefix).assert_consumed()
 
 
 def numpy_batches(file_patterns,

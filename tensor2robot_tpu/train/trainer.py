@@ -743,6 +743,7 @@ def train_eval_model(model=None,
                      use_continuous_eval: bool = False,
                      eval_timeout_secs: Optional[float] = 30.0,
                      steps_per_dispatch: int = 1,
+                     checkpoint_input_state: bool = False,
                      ) -> MetricDict:
   """The reference's `train_eval_model` entry (utils/train_eval.py:394-587).
 
@@ -768,6 +769,36 @@ def train_eval_model(model=None,
   if create_exporters_fn is not None:
     exporters = list(create_exporters_fn(model))
 
+  if train_input_generator is not None:
+    provide_input_generator_with_model_information(
+        train_input_generator, model, ModeKeys.TRAIN)
+  if eval_input_generator is not None:
+    provide_input_generator_with_model_information(
+        eval_input_generator, model, ModeKeys.EVAL)
+
+  train_iter = None
+  if train_input_generator is not None:
+    if checkpoint_input_state:
+      # Resumable stream (train/input_state.py): save the pipeline
+      # position with every checkpoint and restore it on resume. The
+      # generator must support it (record-backed generators do); a
+      # config asking for it on one that doesn't should fail loudly,
+      # not silently restart streams on every preemption.
+      from tensor2robot_tpu.train.input_state import InputStateCallback
+
+      if not hasattr(train_input_generator,
+                     'create_checkpointable_iterator'):
+        raise ValueError(
+            'checkpoint_input_state=True needs a generator with '
+            'create_checkpointable_iterator (e.g. '
+            'DefaultRecordInputGenerator); got '
+            f'{type(train_input_generator).__name__}.')
+      train_iter = train_input_generator.create_checkpointable_iterator(
+          ModeKeys.TRAIN)
+      callbacks.append(InputStateCallback(train_iter))
+    else:
+      train_iter = train_input_generator.create_iterator(ModeKeys.TRAIN)
+
   trainer = Trainer(model, config, mesh=mesh, callbacks=callbacks)
 
   # Spec dump at startup (the reference logs the full in/out spec contract
@@ -782,20 +813,12 @@ def train_eval_model(model=None,
                    '\n'.join(f'  {k}: {v}'
                              for k, v in sorted(spec.items())))
 
-  if train_input_generator is not None:
-    provide_input_generator_with_model_information(
-        train_input_generator, model, ModeKeys.TRAIN)
-  if eval_input_generator is not None:
-    provide_input_generator_with_model_information(
-        eval_input_generator, model, ModeKeys.EVAL)
-
   def run_exporters(metrics: MetricDict) -> None:
     for exporter in exporters:
       exporter.export(trainer, metrics)
 
   try:
-    if train_input_generator is not None:
-      train_iter = train_input_generator.create_iterator(ModeKeys.TRAIN)
+    if train_iter is not None:
       eval_iter_fn = None
       if eval_input_generator is not None:
         eval_iter_fn = lambda: eval_input_generator.create_iterator(

@@ -659,3 +659,42 @@ def test_input_state_resume_is_exact(tmp_path):
   for a, b in zip(jax.tree_util.tree_leaves(straight),
                   jax.tree_util.tree_leaves(resumed)):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_eval_model_checkpoint_input_state(tmp_path):
+  """The gin-surface flag: train_eval_model(checkpoint_input_state=True)
+  wires the resumable stream end-to-end, and rejects generators that
+  cannot checkpoint their position instead of silently restarting."""
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRandomInputGenerator, DefaultRecordInputGenerator)
+  from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+  from tensor2robot_tpu.train.input_state import INPUT_STATE_DIRNAME
+
+  test_data = os.path.join(
+      os.path.dirname(__file__), 'test_data', 'pose_env_test_data.tfrecord')
+
+  def run(max_steps):
+    return train_eval_model(
+        model=PoseEnvRegressionModel(device_type='tpu'),
+        model_dir=str(tmp_path / 'm'),
+        train_input_generator=DefaultRecordInputGenerator(
+            file_patterns=test_data, batch_size=4, shuffle_buffer_size=8,
+            seed=3),
+        max_train_steps=max_steps, save_interval_steps=3,
+        eval_interval_steps=0, log_interval_steps=0,
+        checkpoint_input_state=True)
+
+  run(3)
+  state_root = tmp_path / 'm' / INPUT_STATE_DIRNAME / 'train' / 'process_0'
+  assert (state_root / 'step_3').is_dir(), list(state_root.iterdir())
+  run(6)  # resumes model AND stream
+  assert (state_root / 'step_6').is_dir()
+  assert latest_checkpoint_step(str(tmp_path / 'm' / 'checkpoints')) == 6
+
+  with pytest.raises(ValueError, match='create_checkpointable_iterator'):
+    train_eval_model(
+        model=PoseEnvRegressionModel(device_type='tpu'),
+        model_dir=str(tmp_path / 'm2'),
+        train_input_generator=DefaultRandomInputGenerator(batch_size=4),
+        max_train_steps=2, eval_interval_steps=0, log_interval_steps=0,
+        checkpoint_input_state=True)
