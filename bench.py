@@ -150,30 +150,46 @@ def bench_h2d_transport(host_batch):
   import jax
   import numpy as np
 
-  leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(host_batch)]
-  nbytes = sum(x.nbytes for x in leaves)
-  times = []
-  for _ in range(3):
+  def timed_put(arrays):
     t0 = time.perf_counter()
-    placed = [jax.device_put(x) for x in leaves]
+    placed = [jax.device_put(x) for x in arrays]
     for p in placed:
       p.block_until_ready()
-    # Scalar read from EVERY leaf: forces true completion of each
-    # transfer (block_until_ready alone can return early through the
-    # tunnel, and syncing only one leaf would leave the others in
-    # flight — inflating exactly the degraded-channel readings this
-    # metric exists to expose).
-    for p in placed:
+      # Scalar read from EVERY leaf: forces true completion of each
+      # transfer (block_until_ready alone can return early through the
+      # tunnel, and syncing only one leaf would leave the others in
+      # flight — inflating exactly the degraded-channel readings this
+      # metric exists to expose).
       _ = np.asarray(p.ravel()[0])
-    times.append(time.perf_counter() - t0)
-    del placed
-  med = sorted(times)[1]
-  gbps = nbytes / med / 1e9
+    return time.perf_counter() - t0
+
+  leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(host_batch)]
+  nbytes = sum(x.nbytes for x in leaves)
+  # Separate per-round-trip latency from bandwidth: a degraded channel
+  # can be slow in either axis, and dividing payload by raw wall time
+  # conflates them (a 2 s RTT spike once read as "0.005 GB/s" while the
+  # pipelined record-fed path was visibly moving data much faster).
+  tiny = [np.zeros(1, np.float32)] * len(leaves)
+  # timed_put pays one round trip PER LEAF (serial puts + scalar reads),
+  # so the tiny probe measures len(leaves) trips — the right quantity to
+  # subtract from the equally-leaf-serial payload timing; the per-trip
+  # latency is reported separately.
+  rtt_total = sorted(timed_put(tiny) for _ in range(3))[1]
+  med = sorted(timed_put(leaves) for _ in range(3))[1]
+  transfer = med - rtt_total
+  # A jittery window can median the tiny probe at/above the payload wall
+  # time; the bandwidth component is then unmeasurable — say so rather
+  # than print nbytes/epsilon garbage into the artifact.
+  gbps = (nbytes / transfer / 1e9
+          if transfer > max(0.1 * med, 1e-4) else None)
   print(json.dumps({
       'metric': 'h2d_transport_gbps',
-      'value': round(gbps, 3),
+      'value': round(gbps, 3) if gbps is not None else None,
       'payload_mb': round(nbytes / 1e6, 1),
-      'reps': len(times),
+      'rtt_ms_per_trip': round(rtt_total * 1e3 / len(leaves), 1),
+      'round_trips': len(leaves),
+      'payload_wall_ms': round(med * 1e3, 1),
+      'reps': 3,
   }))
   return gbps
 
