@@ -187,6 +187,11 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
 class NativeRecordInputGenerator(AbstractInputGenerator):
   """TF-free record input on the native C++ runtime.
 
+  No ``create_checkpointable_iterator``: the threaded interleave reader's
+  record order is scheduler-dependent, so there is no deterministic
+  stream position to checkpoint — use :class:`DefaultRecordInputGenerator`
+  when resumable streams (``train/input_state.py``) matter.
+
   Reads TFRecord files with the native interleaved prefetch reader
   (``native/record_io.cpp``), parses tf.Examples with the native
   wire-format parser, and decodes images with PIL — no TensorFlow in the
