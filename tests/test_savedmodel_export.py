@@ -392,3 +392,20 @@ class TestSavedModelPolicyChain:
     for key in out_raw:
       np.testing.assert_allclose(
           out_examples[key], out_raw[key], rtol=1e-5, atol=1e-5)
+
+
+class TestSavedModelPredictorFallbacks:
+
+  def test_restore_returns_false_when_no_saved_model(self, tmp_path):
+    """An export root whose versions carry only the StableHLO artifact
+    (saved_model export off) is invisible to SavedModelPredictor: a
+    zero-timeout restore returns False rather than loading a version it
+    cannot serve."""
+    trainer, model = _trained(tmp_path)
+    root = str(tmp_path / 'export')
+    export_lib.ModelExporter(saved_model=False).export(
+        model, trainer.state, root)
+    assert export_lib.valid_export_dirs(root)  # the version IS complete
+    predictor = SavedModelPredictor(export_dir=root, timeout=0.0)
+    assert predictor.restore() is False
+    assert not predictor.is_loaded

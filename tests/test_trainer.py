@@ -698,3 +698,41 @@ def test_train_eval_model_checkpoint_input_state(tmp_path):
         train_input_generator=DefaultRandomInputGenerator(batch_size=4),
         max_train_steps=2, eval_interval_steps=0, log_interval_steps=0,
         checkpoint_input_state=True)
+
+
+def test_input_state_missing_falls_back_to_fresh_stream(tmp_path, caplog):
+  """A resumed run whose checkpoint predates the input-state feature (or
+  whose state dir was deleted) warns and trains on a fresh stream — the
+  reference's behavior, never an error."""
+  import logging
+
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+  from tensor2robot_tpu.train import InputStateCallback
+
+  test_data = os.path.join(
+      os.path.dirname(__file__), 'test_data', 'pose_env_test_data.tfrecord')
+
+  def run(max_steps, with_callback):
+    model = PoseEnvRegressionModel(device_type='tpu')
+    gen = DefaultRecordInputGenerator(
+        file_patterns=test_data, batch_size=4, shuffle_buffer_size=8,
+        seed=5)
+    gen.set_specification_from_model(model, ModeKeys.TRAIN)
+    it = gen.create_checkpointable_iterator(ModeKeys.TRAIN)
+    callbacks = [InputStateCallback(it)] if with_callback else []
+    trainer = Trainer(model, TrainerConfig(
+        model_dir=str(tmp_path / 'm'), max_train_steps=max_steps,
+        save_interval_steps=2, eval_interval_steps=0, log_interval_steps=0,
+        prefetch_batches=0, auto_input_layouts=False,
+        async_checkpoints=False), callbacks=callbacks)
+    trainer.train(it, None)
+    return trainer
+
+  run(2, with_callback=False)   # checkpoint WITHOUT input state
+  with caplog.at_level(logging.WARNING):
+    trainer = run(4, with_callback=True)  # resumes; no state for step 2
+  assert int(trainer.step) == 4
+  assert any('no' in r.message.lower() and 'input state' in r.message.lower()
+             for r in caplog.records), [r.message for r in caplog.records]
