@@ -64,13 +64,17 @@ def _time_train_step(model, batch_size: int, steps: int = 50,
   batches = [
       mesh_lib.shard_batch(b, trainer.mesh, formats) for b in host_batches
   ]
+  # Sync via a scalar device READ, not block_until_ready: through the
+  # tunneled backend block_until_ready can return before short dispatch
+  # chains complete (observed as a wall "steps/s" 3.6x ABOVE the traced
+  # device rate); reading state.step data-depends on the last dispatch.
   for i in range(3):
     state, _ = step_fn(state, *batches[i % 4])
-  jax.block_until_ready(state.params)
+  int(state.step)
   t0 = time.perf_counter()
   for i in range(steps):
     state, _ = step_fn(state, *batches[i % 4])
-  jax.block_until_ready(state.params)
+  int(state.step)
   wall = steps / (time.perf_counter() - t0)
   device_ms = None
   if trace and jax.default_backend() != 'cpu':
@@ -141,14 +145,17 @@ def measure_wtl_vision(batch_size: int = 32):
                           trace=True)
 
 
-def measure_pose_env_maml(batch_size: int = 64) -> float:
-  """MAML steps/s at a COMPUTE-BOUND configuration.
+def measure_pose_env_maml(batch_size: int = 64):
+  """MAML (wall steps/s, TRACE-measured device ms/step) at batch 64.
 
   The original batch-4 anchor was sub-millisecond device time — a
   dispatch-latency measure of the tunneled backend (76–381 steps/s
-  across runs), useless for regression detection. Batch 64 task-batches
-  put the step at several ms of device time, so the recorded number
-  tracks compute.
+  across runs), useless for regression detection. Batch 64 helps but is
+  not enough: the step is ~4 ms of device time, so WALL still carries
+  more tunnel dispatch overhead than compute (46.8 → 174.9 steps/s
+  between windows with the device time unchanged). The regression
+  anchor is therefore the xplane-traced DEVICE ms — channel-immune,
+  like WTL's — with wall recorded as context only.
   """
   from tensor2robot_tpu.meta_learning import MAMLModel
   from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModelMAML
@@ -158,7 +165,7 @@ def measure_pose_env_maml(batch_size: int = 64) -> float:
   model = PoseEnvRegressionModelMAML(
       base_model=PoseEnvRegressionModel(device_type='tpu'),
       num_inner_loop_steps=1)
-  return _steps_per_sec(model, batch_size=batch_size)
+  return _time_train_step(model, batch_size=batch_size, trace=True)
 
 
 def measure_qtopt_batch(batch_size: int, steps: int = 30):
@@ -256,11 +263,19 @@ def main(argv=None):
       measured['wtl_vision_device_ms_per_step_batch32'] = round(device_ms, 2)
     print(f'  {wall:.2f} steps/s wall, {device_ms} ms device', flush=True)
   if 'maml' in want:
-    print('pose_env maml steps/sec (batch 64, compute-bound) ...', flush=True)
-    measured['pose_env_maml_steps_per_sec_per_chip_batch64'] = round(
-        measure_pose_env_maml(), 3)
-    print(f"  {measured['pose_env_maml_steps_per_sec_per_chip_batch64']}",
-          flush=True)
+    print('pose_env maml (batch 64, trace-anchored) ...', flush=True)
+    wall, device_ms = measure_pose_env_maml()
+    if device_ms:
+      measured['pose_env_maml_steps_per_sec_per_chip_batch64'] = round(
+          wall, 3)
+      measured['pose_env_maml_device_ms_per_step_batch64'] = round(
+          device_ms, 2)
+      print(f'  {wall:.2f} steps/s wall, {device_ms} ms device', flush=True)
+    else:
+      # The device ms IS the regression anchor; recording a fresh wall
+      # next to a stale anchor would look coherent while gating nothing.
+      print('  TRACE FAILED: refusing to record a wall number without '
+            'the device-ms anchor.', flush=True)
   if 'qtopt_curve' in want:
     print('qtopt batch curve (each point in its own subprocess) ...',
           flush=True)

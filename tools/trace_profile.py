@@ -117,11 +117,14 @@ def device_ms_per_iter(fn, args, n=20, tracedir=None):
 
   chained_j = jax.jit(chained)
   acc = chained_j(jnp.float32(0), *args)
-  jax.block_until_ready(acc)
+  float(acc)  # scalar READ: block_until_ready can return early (tunnel)
   with jax.profiler.trace(tracedir):
     for _ in range(n):
       acc = chained_j(acc, *args)
-    jax.block_until_ready(acc)
+    # The read forces every chained dispatch to have executed before the
+    # trace window closes — an early exit would drop device ops from the
+    # trace and undercount.
+    float(acc)
   total_ms, ops = device_op_times(tracedir)
   if owns:
     shutil.rmtree(tracedir, ignore_errors=True)
@@ -147,13 +150,21 @@ def device_ms_per_step_loop(step_fn, state, batches, n=10, tracedir=None):
 
   owns = tracedir is None
   tracedir = tracedir or tempfile.mkdtemp(prefix='t2r_trace_')
+  import numpy as np
+
+  def force(s):
+    # Scalar READ of a state leaf: a true data dependency on the last
+    # dispatch (block_until_ready can return early through the tunneled
+    # backend; an early trace-close would undercount device time).
+    _ = np.asarray(jax.tree_util.tree_leaves(s)[0]).ravel()[:1]
+
   # Warm outside the trace (first dispatch after idle can stall).
   state, _ = step_fn(state, *batches[0])
-  jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+  force(state)
   with jax.profiler.trace(tracedir):
     for i in range(n):
       state, _ = step_fn(state, *batches[i % len(batches)])
-    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    force(state)
   total_ms, _ = device_op_times(tracedir)
   if owns:
     shutil.rmtree(tracedir, ignore_errors=True)
