@@ -453,21 +453,21 @@ def main():
   ]
   flops_per_step = _step_flops(step_fn, state, *device_batches[0])
 
-  # A scalar device READ is the sync, not block_until_ready: through the
-  # tunneled backend block_until_ready can return before short dispatch
-  # chains complete (observed: a 6-dispatch loop "finishing" in 7 ms),
-  # while reading state.step forces true completion of the last dispatch
-  # it data-depends on.
+  # One shared sync idiom: a scalar device read that data-depends on the
+  # last dispatch (tools/trace_profile.force_completion — through the
+  # tunnel, block_until_ready can return before short chains complete).
+  from tools.trace_profile import force_completion
+
   for i in range(3):  # warmup post-compile
     f, l = device_batches[i % len(device_batches)]
     state, _ = step_fn(state, f, l)
-  int(state.step)
+  force_completion(state)
 
   t0 = time.perf_counter()
   for i in range(steps):
     f, l = device_batches[i % len(device_batches)]
     state, scalars = step_fn(state, f, l)
-  int(state.step)
+  force_completion(state)
   dt = time.perf_counter() - t0
 
   steps_per_sec = steps / dt
@@ -502,13 +502,13 @@ def main():
       for i in range(2):  # compile + warm
         fk, lk = stacked[i % len(stacked)]
         state_k, _ = step_fn_k(state_k, fk, lk)
-      int(state_k.step)  # scalar read = reliable sync (see above)
+      force_completion(state_k)
       n_dispatches = max(1, steps // k_dispatch)
       t0 = time.perf_counter()
       for i in range(n_dispatches):
         fk, lk = stacked[i % len(stacked)]
         state_k, _ = step_fn_k(state_k, fk, lk)
-      int(state_k.step)
+      force_completion(state_k)
       k_sps = n_dispatches * k_dispatch / (time.perf_counter() - t0)
       if k_sps > steps_per_sec:
         steps_per_sec = k_sps

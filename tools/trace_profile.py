@@ -48,6 +48,25 @@ def _parse_xplane(tracedir):
   return xs
 
 
+def force_completion(tree) -> None:
+  """Forces every dispatch the arrays of ``tree`` depend on to complete.
+
+  A one-scalar device READ (sliced on device, so nothing big moves):
+  ``jax.block_until_ready`` can return early through the tunneled
+  backend for short dispatch chains (observed: a 6-dispatch loop
+  "finishing" in 7 ms, wall rates 3.6× above the traced device rate).
+  Every timing loop in this repo syncs through this ONE helper so the
+  workaround can't drift.
+  """
+  import jax
+  import numpy as np
+
+  leaf = jax.tree_util.tree_leaves(tree)[0]
+  if hasattr(leaf, 'ravel') and getattr(leaf, 'ndim', 0) > 0:
+    leaf = leaf.ravel()[:1]  # device-side slice: transfer ONE element
+  _ = np.asarray(leaf)
+
+
 def strip_op_suffix(op_name: str) -> str:
   """``fusion.123`` → ``fusion``: the HLO instance suffix."""
   return re.sub(r'[.\d]+$', '', op_name)
@@ -117,14 +136,13 @@ def device_ms_per_iter(fn, args, n=20, tracedir=None):
 
   chained_j = jax.jit(chained)
   acc = chained_j(jnp.float32(0), *args)
-  float(acc)  # scalar READ: block_until_ready can return early (tunnel)
+  force_completion(acc)
   with jax.profiler.trace(tracedir):
     for _ in range(n):
       acc = chained_j(acc, *args)
-    # The read forces every chained dispatch to have executed before the
-    # trace window closes — an early exit would drop device ops from the
-    # trace and undercount.
-    float(acc)
+    # Forces every chained dispatch to have executed before the trace
+    # window closes — an early exit would drop device ops and undercount.
+    force_completion(acc)
   total_ms, ops = device_op_times(tracedir)
   if owns:
     shutil.rmtree(tracedir, ignore_errors=True)
@@ -150,21 +168,13 @@ def device_ms_per_step_loop(step_fn, state, batches, n=10, tracedir=None):
 
   owns = tracedir is None
   tracedir = tracedir or tempfile.mkdtemp(prefix='t2r_trace_')
-  import numpy as np
-
-  def force(s):
-    # Scalar READ of a state leaf: a true data dependency on the last
-    # dispatch (block_until_ready can return early through the tunneled
-    # backend; an early trace-close would undercount device time).
-    _ = np.asarray(jax.tree_util.tree_leaves(s)[0]).ravel()[:1]
-
   # Warm outside the trace (first dispatch after idle can stall).
   state, _ = step_fn(state, *batches[0])
-  force(state)
+  force_completion(state)
   with jax.profiler.trace(tracedir):
     for i in range(n):
       state, _ = step_fn(state, *batches[i % len(batches)])
-    force(state)
+    force_completion(state)
   total_ms, _ = device_op_times(tracedir)
   if owns:
     shutil.rmtree(tracedir, ignore_errors=True)
