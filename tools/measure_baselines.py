@@ -285,12 +285,25 @@ def main(argv=None):
     print('qtopt batch curve (each point in its own subprocess) ...',
           flush=True)
     curve = measure_qtopt_batch_curve()
-    for b, point in curve.items():
-      measured[f'qtopt_examples_per_sec_per_chip_batch{b}'] = point[
-          'examples_per_sec']
-    if curve:
-      best = max(curve, key=lambda b: curve[b]['examples_per_sec'])
-      measured['qtopt_optimal_batch'] = int(best)
+    # DEVICE examples/s is the recorded curve (channel-immune, like
+    # every other anchor); wall examples/s varies with the tunnel
+    # window (batch-32 read 1482 then 1108 in one afternoon with the
+    # device number unchanged at 1800). A point whose trace failed is
+    # refused outright — recording its wall number under the
+    # device-labeled key would mix units and could mis-pick the optimum.
+    device_curve = {
+        b: point['device_examples_per_sec']
+        for b, point in curve.items()
+        if point.get('device_examples_per_sec')
+    }
+    for b in sorted(set(curve) - set(device_curve)):
+      print(f'  batch {b}: TRACE FAILED — refusing to record its wall '
+            'number under the device-anchored key.', flush=True)
+    for b, value in device_curve.items():
+      measured[f'qtopt_examples_per_sec_per_chip_batch{b}'] = value
+    if device_curve:
+      measured['qtopt_optimal_batch'] = int(
+          max(device_curve, key=device_curve.get))
 
   print(json.dumps(measured, indent=2))
   if on_tpu:
