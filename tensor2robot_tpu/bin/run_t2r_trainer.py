@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import sys
 
 from tensor2robot_tpu import config as t2r_config
 
@@ -24,8 +25,34 @@ def main(argv=None):
                       help='Path to a gin config file (repeatable).')
   parser.add_argument('--gin_bindings', action='append', default=[],
                       help='Individual gin bindings (repeatable).')
+  parser.add_argument(
+      '--handle_preemption', action=argparse.BooleanOptionalAction,
+      default=True,
+      help='Convert SIGTERM/SIGINT into a forced checkpoint and a '
+           'distinct resumable exit status (42).')
   args = parser.parse_args(argv)
 
+  # Install the preemption handler BEFORE any work: a SIGTERM during
+  # config parsing or state init should still exit resumable, and the
+  # trainer honors the process-global handler at every dispatch boundary.
+  from tensor2robot_tpu.train import resilience
+
+  shutdown = None
+  if args.handle_preemption:
+    shutdown = resilience.install_graceful_shutdown()
+
+  try:
+    return _run(args, resilience)
+  finally:
+    # Restore signal dispositions on the way out: once training is over
+    # a SIGTERM should kill normally, and embedding callers (tests, or
+    # programs invoking main() directly) must not inherit a process-
+    # global handler as a side effect.
+    if shutdown is not None:
+      shutdown.uninstall()
+
+
+def _run(args, resilience):
   t2r_config.register_framework_configurables()
   t2r_config.parse_config_files_and_bindings(
       config_files=args.gin_configs, bindings=args.gin_bindings)
@@ -57,7 +84,14 @@ def main(argv=None):
   # operative_config-0.gin never misrepresents un-consumed bindings.
   save_config(t2r_config.config_str(), 'config-0.gin')
   train_eval_model = t2r_config.get_configurable('train_eval_model')
-  result = train_eval_model()
+  try:
+    result = train_eval_model()
+  except resilience.PreemptedError as e:
+    # The trainer already forced a checkpoint (+ input state). Exit with
+    # the DISTINCT resumable status so schedulers restart rather than
+    # fail the job; the restarted run restores and continues.
+    logging.warning('%s; exiting with resumable status %d.', e, e.exit_code)
+    sys.exit(e.exit_code)
   operative = t2r_config.operative_config_str()
   logging.info('Operative config:\n%s', operative)
   save_config(operative, 'operative_config-0.gin')

@@ -57,6 +57,14 @@ def register() -> None:
   reg(callbacks_lib.MetricsLoggerCallback, 'MetricsLoggerCallback')
   reg(callbacks_lib.VariableLoggerCallback, 'VariableLoggerCallback')
   reg(callbacks_lib.ProfilerCallback, 'ProfilerCallback')
+  reg(callbacks_lib.ResilienceLoggerCallback, 'ResilienceLoggerCallback')
+  # Fault tolerance (train/resilience.py): the preemption handler for
+  # jobs driven by configs rather than bin/run_t2r_trainer.py; the
+  # nonfinite/error-budget knobs ride on train_eval_model and the input
+  # generators' own parameters.
+  from tensor2robot_tpu.train import resilience as resilience_lib
+
+  reg(resilience_lib.install_graceful_shutdown, 'install_graceful_shutdown')
   # Mesh.
   reg(mesh_lib.create_mesh, 'create_mesh')
   reg(mesh_lib.MeshSpec, 'MeshSpec')

@@ -25,10 +25,22 @@ Batch = Tuple[SpecStruct, Optional[SpecStruct]]
 
 
 class AbstractInputGenerator(abc.ABC):
-  """Holds in-specs and produces an iterator of packed numpy batches."""
+  """Holds in-specs and produces an iterator of packed numpy batches.
 
-  def __init__(self, batch_size: int = 32):
+  ``error_budget`` (None disables, the default) bounds tolerated batch
+  production failures: a failed ``next()`` on the underlying iterator
+  (transient IO, a corrupt record surfacing as a parse error) is
+  charged, logged, and the stream is rebuilt — training continues on
+  the surviving data until the budget is spent, at which point
+  ``utils.retry.DataErrorBudgetExceededError`` raises with full
+  accounting. Rebuilding restarts the stream definition, so budget data
+  sources should shuffle or repeat.
+  """
+
+  def __init__(self, batch_size: int = 32,
+               error_budget: Optional[int] = None):
     self._batch_size = batch_size
+    self._error_budget = error_budget
     self._feature_spec: Optional[SpecStruct] = None
     self._label_spec: Optional[SpecStruct] = None
 
@@ -67,7 +79,15 @@ class AbstractInputGenerator(abc.ABC):
       raise ValueError(
           'Input generator has no specs; call set_specification(_from_model) '
           'first.')
-    return self._create_iterator(mode, batch_size or self._batch_size)
+    batch_size = batch_size or self._batch_size
+    if self._error_budget is None:
+      return self._create_iterator(mode, batch_size)
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    return retry_lib.ResilientIterator(
+        lambda: self._create_iterator(mode, batch_size),
+        budget=retry_lib.ErrorBudget(
+            self._error_budget, name=f'{type(self).__name__} batch'))
 
   @abc.abstractmethod
   def _create_iterator(self, mode: str, batch_size: int) -> Iterator[Batch]:
@@ -86,8 +106,9 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
                batch_size: int = 32,
                shuffle_buffer_size: int = 1000,
                parallel_shards: int = 10,
-               seed: Optional[int] = None):
-    super().__init__(batch_size)
+               seed: Optional[int] = None,
+               error_budget: Optional[int] = None):
+    super().__init__(batch_size, error_budget=error_budget)
     if not file_patterns and not dataset_map:
       raise ValueError('Provide file_patterns or dataset_map.')
     if file_patterns and dataset_map:
@@ -210,8 +231,10 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                cycle_length: int = 16,
                queue_capacity: int = 64,
                decode_workers: int = 8,
-               seed: Optional[int] = None):
-    super().__init__(batch_size)
+               seed: Optional[int] = None,
+               error_budget: Optional[int] = None,
+               open_retries: int = 3):
+    super().__init__(batch_size, error_budget=error_budget)
     if not file_patterns:
       raise ValueError('Provide file_patterns.')
     self._file_patterns = file_patterns
@@ -220,10 +243,20 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     self._queue_capacity = queue_capacity
     self._decode_workers = decode_workers
     self._seed = seed
+    self._open_retries = open_retries
 
   def _records(self, mode: str):
-    """Yields raw serialized examples forever (train) or one epoch."""
+    """Yields raw serialized examples forever (train) or one epoch.
+
+    With ``error_budget`` set, a RECORD-level ``ErrorBudget`` is shared
+    across reader reopens: a corrupt record ends the current interleave
+    pass (framing cannot resync) and the train loop's reopen continues
+    on the surviving bytes, bounded by the budget; reader OPENS are
+    additionally retried with jittered backoff (transient filesystem
+    errors should not kill a multi-day run).
+    """
     from tensor2robot_tpu.data import native_io, records
+    from tensor2robot_tpu.utils import retry as retry_lib
 
     data_format, filenames = records.get_data_format_and_filenames(
         self._file_patterns)
@@ -234,11 +267,22 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
 
     element_shard = not sharded and jax.process_count() > 1
     training = modes.is_training(mode)
+    read_budget = None
+    if self._error_budget is not None:
+      read_budget = retry_lib.ErrorBudget(
+          self._error_budget, name=f'{type(self).__name__} record stream')
+    open_policy = retry_lib.RetryPolicy(max_attempts=max(1,
+                                                         self._open_retries))
     while True:
-      with native_io.NativeInterleaveReader(
+      reader = retry_lib.retry_call(
+          native_io.NativeInterleaveReader,
           sorted(filenames) if element_shard else filenames,
           cycle_length=self._cycle_length,
-          queue_capacity=self._queue_capacity) as reader:
+          queue_capacity=self._queue_capacity,
+          error_budget=read_budget,
+          policy=open_policy,
+          describe='native interleave open')
+      with reader:
         for i, record in enumerate(reader):
           if element_shard and i % jax.process_count() != jax.process_index():
             continue

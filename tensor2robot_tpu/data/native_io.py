@@ -14,11 +14,13 @@ which falls back to TF automatically.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import threading
 from typing import Iterator, List, Optional, Sequence
 
 from tensor2robot_tpu import native
+from tensor2robot_tpu.utils import retry as retry_lib
 
 
 def available() -> bool:
@@ -67,10 +69,21 @@ class NativeRecordWriter:
 
 
 class NativeRecordReader:
-  """Sequential reader with CRC verification."""
+  """Sequential reader with CRC verification.
 
-  def __init__(self, path: str, verify_crc: bool = True):
+  ``error_budget`` (a ``utils.retry.ErrorBudget``) bounds tolerated read
+  errors: a corrupt record breaks TFRecord framing irrecoverably, so a
+  within-budget error is charged, logged, and the file is treated as
+  truncated at that point (records before the corruption were already
+  yielded) — the budget raises loudly once spent. Without a budget, read
+  errors raise immediately (historical behavior).
+  """
+
+  def __init__(self, path: str, verify_crc: bool = True,
+               error_budget: Optional['retry_lib.ErrorBudget'] = None):
     self._lib = _lib()
+    self._path = path
+    self._error_budget = error_budget
     self._h = self._lib.t2r_reader_open(path.encode(), int(verify_crc))
     if not self._h:
       raise IOError(f'cannot open {path!r}')
@@ -83,7 +96,14 @@ class NativeRecordReader:
         return
       if n == -2:
         err = self._lib.t2r_reader_error(self._h).decode()
-        raise IOError(f'record read failed: {err}')
+        exc = IOError(f'record read failed: {err}')
+        if self._error_budget is None:
+          raise exc
+        self._error_budget.record(exc)  # raises once the budget is spent
+        logging.warning(
+            'Treating %r as truncated after a framing-breaking read '
+            'error.', self._path)
+        return
       yield ctypes.string_at(buf, n)
 
   def close(self) -> None:
@@ -108,10 +128,12 @@ class NativeInterleaveReader:
   """
 
   def __init__(self, paths: Sequence[str], cycle_length: int = 16,
-               queue_capacity: int = 64, verify_crc: bool = True):
+               queue_capacity: int = 64, verify_crc: bool = True,
+               error_budget: Optional['retry_lib.ErrorBudget'] = None):
     if not paths:
       raise ValueError('need at least one path')
     self._lib = _lib()
+    self._error_budget = error_budget
     arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
     self._h = self._lib.t2r_interleave_open(
         arr, len(paths), cycle_length, queue_capacity, int(verify_crc))
@@ -126,7 +148,18 @@ class NativeInterleaveReader:
         return
       if n == -2:
         err = self._lib.t2r_interleave_error(self._h).decode()
-        raise IOError(f'interleave read failed: {err}')
+        exc = IOError(f'interleave read failed: {err}')
+        if self._error_budget is None:
+          raise exc
+        # A read error poisons the whole interleave (the failing slot
+        # cannot resync mid-file): charge the budget and end this pass;
+        # callers that loop epochs (train) reopen and continue on the
+        # surviving bytes, bounded by the shared budget.
+        self._error_budget.record(exc)  # raises once the budget is spent
+        logging.warning(
+            'Ending interleave pass early after a read error (budget '
+            'remaining: %d).', self._error_budget.remaining)
+        return
       yield ctypes.string_at(buf, n)
 
   def close(self) -> None:

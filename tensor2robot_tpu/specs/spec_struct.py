@@ -281,7 +281,16 @@ def _register_pytree() -> None:
     return [(jax.tree_util.DictKey(k), struct[k]) for k in keys], tuple(keys)
 
   def unflatten(keys, values):
-    return SpecStruct(zip(keys, values))
+    # MUST bypass __setitem__'s leaf validation: jax internals unflatten
+    # treedefs around sentinel objects (e.g. pjit's in_shardings prefix
+    # matching builds a dummy tree of plain object()s), and a validating
+    # unflatten breaks the pytree contract — observed as pjit's
+    # "Please open a bug report!" assertion on sharded SpecStruct args.
+    struct = SpecStruct.__new__(SpecStruct)
+    object.__setattr__(struct, '_store',
+                       collections.OrderedDict(zip(keys, values)))
+    object.__setattr__(struct, '_prefix', '')
+    return struct
 
   try:
     jax.tree_util.register_pytree_with_keys(

@@ -11,6 +11,15 @@ from tensor2robot_tpu.train.train_state import (
     create_train_state,
 )
 from tensor2robot_tpu.train.input_state import InputStateCallback
+from tensor2robot_tpu.train.resilience import (
+    PREEMPTED_EXIT_CODE,
+    GracefulShutdown,
+    NonFiniteError,
+    NonFinitePolicy,
+    PreemptedError,
+    active_shutdown,
+    install_graceful_shutdown,
+)
 from tensor2robot_tpu.train.trainer import (
     Trainer,
     TrainerCallback,

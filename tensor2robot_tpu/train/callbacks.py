@@ -70,6 +70,47 @@ class MetricsLoggerCallback(TrainerCallback):
     self._write(trainer, record)
 
 
+class ResilienceLoggerCallback(TrainerCallback):
+  """Surfaces fault-tolerance counters in the normal log stream.
+
+  At each crossed log interval, reports the non-finite guard's skipped-
+  update totals (``train/resilience.py``) and any batch error budget the
+  train iterator carries (``utils/retry.ResilientIterator``), so a run
+  quietly absorbing faults is VISIBLY absorbing them — silent resilience
+  ages into silent data loss.
+  """
+
+  def __init__(self, log_interval_steps: Optional[int] = None,
+               iterator=None):
+    self._log_interval_steps = log_interval_steps
+    self._iterator = iterator
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    interval = (self._log_interval_steps
+                if self._log_interval_steps is not None
+                else trainer.config.log_interval_steps)
+    if not trainer.crossed(interval, step):
+      return
+    policy = trainer.nonfinite_policy
+    if policy is not None and policy.bad_steps:
+      logging.info(
+          'resilience: %d non-finite update(s) skipped so far '
+          '(%d consecutive bad dispatch(es), mode=%s).',
+          policy.bad_steps, policy.consecutive_bad, policy.mode)
+    budget = getattr(self._iterator, 'budget', None)
+    if budget is not None and budget.errors:
+      logging.info(
+          'resilience: %s absorbed %d/%d error(s); last: %r.',
+          budget.name, budget.errors, budget.max_errors, budget.last_error)
+
+  def end(self, trainer) -> None:
+    policy = trainer.nonfinite_policy
+    if policy is not None and policy.bad_steps:
+      logging.warning(
+          'resilience: run finished with %d non-finite update(s) skipped.',
+          policy.bad_steps)
+
+
 class ProfilerCallback(TrainerCallback):
   """Captures a ``jax.profiler`` trace over a step window.
 

@@ -12,6 +12,7 @@ cannot delete it mid-restore (``utils/train_eval.py:590-707``).
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import time
@@ -47,21 +48,56 @@ class CheckpointManager:
     return self._directory
 
   def save(self, step: int, state, force: bool = False) -> bool:
+    # Hand Orbax the DEVICE arrays: its async path owns the device→host
+    # copy (blocking only for the D2H transfer, writing to disk in the
+    # background). An eager jax.device_get here would serialize a full
+    # host copy into the train loop even with async_save=True, defeating
+    # async checkpointing. Safe against buffer donation: Orbax completes
+    # the D2H copy before save() returns.
     step = int(step)
     if step in self._manager.all_steps():
       return False  # already saved (e.g. final forced save after an in-loop one)
-    state = jax.device_get(state)
     return self._manager.save(
         step, args=ocp.args.StandardSave(state), force=force)
 
-  def restore(self, state, step: Optional[int] = None):
-    """Restores into the structure of ``state`` (an abstract/concrete tree)."""
-    if step is None:
-      step = self.latest_step()
-    if step is None:
+  def restore(self, state, step: Optional[int] = None,
+              fallback_to_older: bool = True):
+    """Restores into the structure of ``state`` (an abstract/concrete tree).
+
+    With ``fallback_to_older`` (the default when no explicit ``step`` is
+    requested), a truncated/corrupt latest checkpoint — the signature of
+    a save cut off by preemption or a torn filesystem — falls back to
+    the next-older step instead of killing the resume. Only when EVERY
+    step fails does the last error propagate; an explicit ``step``
+    restores exactly that step or raises.
+    """
+    if step is not None:
+      return self._manager.restore(
+          int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+    steps = sorted(self._manager.all_steps(), reverse=True)
+    if not steps:
       return None
-    return self._manager.restore(
-        int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+    target = jax.device_get(state)
+    last_exc: Optional[BaseException] = None
+    for i, s in enumerate(steps):
+      try:
+        restored = self._manager.restore(
+            int(s), args=ocp.args.StandardRestore(target))
+        if i > 0:
+          logging.warning(
+              'Restored checkpoint step %d after %d newer step(s) failed '
+              'to load (latest was likely truncated by a preemption).', s, i)
+        return restored
+      except Exception as e:  # pylint: disable=broad-except
+        last_exc = e
+        if not fallback_to_older:
+          raise
+        logging.warning(
+            'Checkpoint step %d failed to restore (%r); falling back to '
+            'the next-older step.', s, e)
+    raise RuntimeError(
+        f'All {len(steps)} checkpoint(s) under {self._directory!r} failed '
+        f'to restore; last error: {last_exc!r}') from last_exc
 
   def latest_step(self) -> Optional[int]:
     return self._manager.latest_step()
@@ -83,15 +119,24 @@ class CheckpointManager:
 
 
 def latest_checkpoint_step(directory: str) -> Optional[int]:
-  """Latest finalized step in ``directory`` without opening a manager."""
+  """Latest finalized step in ``directory`` without opening a manager.
+
+  Non-numeric ``ckpt_*`` entries (stray tmp dirs, editor droppings,
+  backup copies) are skipped rather than crashing the scan — this
+  function gates resume decisions and continuous eval, so it must stay
+  robust to whatever accumulates in a long-lived model dir.
+  """
   try:
-    steps = [
-        int(name.rsplit('_', 1)[-1])
-        for name in os.listdir(directory)
-        if name.startswith('ckpt_') and not name.endswith('.orbax-checkpoint-tmp')
-    ]
+    names = os.listdir(directory)
   except FileNotFoundError:
     return None
+  steps = []
+  for name in names:
+    if not name.startswith('ckpt_') or name.endswith('.orbax-checkpoint-tmp'):
+      continue
+    suffix = name.rsplit('_', 1)[-1]
+    if suffix.isdigit():
+      steps.append(int(suffix))
   return max(steps) if steps else None
 
 

@@ -1,0 +1,190 @@
+"""Preemption-safe shutdown and non-finite-update policy for the trainer.
+
+The *survival* half of fault tolerance for the train layer (checkpoints
+and resumable input streams are the recovery half):
+
+* :class:`GracefulShutdown` — a SIGTERM/SIGINT handler for preemptible
+  fleets. The signal only sets a flag; ``Trainer.train`` checks it at
+  each dispatch boundary, finishes the in-flight dispatch, forces a
+  checkpoint (+ input-state save via the normal ``after_checkpoint``
+  callbacks), and raises :class:`PreemptedError`, which the trainer
+  binary converts to the distinct resumable exit status
+  ``PREEMPTED_EXIT_CODE``. A second signal falls through to the previous
+  handler (the handlers are restored after the first), so an operator
+  can still hard-kill a stuck save.
+
+* :class:`NonFinitePolicy` — the host-side decision for the device-side
+  ``all_finite(loss, grads)`` flag the jitted train step folds into its
+  scalars. The step itself always guards the update (``where(ok, new,
+  old)``), so params are never corrupted by a NaN/Inf batch; the policy
+  decides what the HOST does about it: raise immediately, or skip and
+  count, halting after N consecutive bad dispatches. The flag is
+  evaluated one dispatch behind (the trainer checks the previous
+  dispatch's flag after queueing the next), so policy enforcement adds
+  no device sync to the pipeline — the lag is safe precisely because the
+  update was already guarded on device.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional, Tuple
+
+# The distinct, resumable exit status the trainer binary uses for
+# preemption: schedulers/wrappers restart the job, and the restarted run
+# restores the forced checkpoint + input state.
+PREEMPTED_EXIT_CODE = 42
+
+
+class PreemptedError(RuntimeError):
+  """Training stopped by a preemption signal AFTER a forced checkpoint.
+
+  Resumable: rerunning the same job restores the checkpoint this error
+  acknowledges. ``exit_code`` is the status long-running binaries should
+  exit with so the scheduler distinguishes preemption from failure.
+  """
+
+  exit_code = PREEMPTED_EXIT_CODE
+
+  def __init__(self, step: int):
+    super().__init__(
+        f'training preempted at step {step}; checkpoint saved, resumable')
+    self.step = int(step)
+
+
+class NonFiniteError(RuntimeError):
+  """The non-finite policy halted training (params are still finite)."""
+
+
+class GracefulShutdown:
+  """Converts SIGTERM/SIGINT into a flag checked at dispatch boundaries.
+
+  ``install()`` registers handlers (main thread only — callers on other
+  threads should use :meth:`request`); the first signal sets the flag
+  and restores the previous handlers, so a second signal behaves as if
+  this class were never there. Usable as a context manager.
+  """
+
+  def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                 signal.SIGINT)):
+    self._signals = tuple(signals)
+    self._event = threading.Event()
+    self._prev = {}
+    self._installed = False
+
+  @property
+  def requested(self) -> bool:
+    return self._event.is_set()
+
+  def request(self) -> None:
+    """Programmatic preemption (tests, cluster agents without signals)."""
+    self._event.set()
+
+  def _handler(self, signum, frame) -> None:
+    del frame
+    logging.warning(
+        'Received signal %d: finishing the in-flight dispatch, then '
+        'checkpointing and exiting resumable (next signal kills).', signum)
+    self._event.set()
+    self.uninstall()
+
+  def install(self) -> 'GracefulShutdown':
+    if not self._installed:
+      for s in self._signals:
+        self._prev[s] = signal.signal(s, self._handler)
+      self._installed = True
+    return self
+
+  def uninstall(self) -> None:
+    if self._installed:
+      for s, prev in self._prev.items():
+        signal.signal(s, prev)
+      self._prev.clear()
+      self._installed = False
+
+  def __enter__(self) -> 'GracefulShutdown':
+    return self.install()
+
+  def __exit__(self, *exc) -> None:
+    self.uninstall()
+
+
+_GLOBAL_SHUTDOWN: Optional[GracefulShutdown] = None
+
+
+def install_graceful_shutdown() -> GracefulShutdown:
+  """Installs (idempotently) the process-wide shutdown handler.
+
+  Long-running binaries call this once at startup; any Trainer in the
+  process then honors it via :func:`active_shutdown` without plumbing.
+  """
+  global _GLOBAL_SHUTDOWN
+  if _GLOBAL_SHUTDOWN is None:
+    _GLOBAL_SHUTDOWN = GracefulShutdown()
+  # install() is idempotent, and re-installing matters: a caller that
+  # uninstalled the singleton (e.g. the trainer binary restoring signal
+  # dispositions on exit) can bring it back for a later run.
+  return _GLOBAL_SHUTDOWN.install()
+
+
+def active_shutdown() -> Optional[GracefulShutdown]:
+  return _GLOBAL_SHUTDOWN
+
+
+class NonFinitePolicy:
+  """Host-side accounting/decision for device-guarded non-finite steps.
+
+  ``mode``:
+    * ``'off'``   — no guard compiled into the step (bitwise status quo).
+    * ``'skip_update'`` — bad steps leave params/opt-state/``state.step``
+      untouched (the rng stream therefore replays the slot, exactly as
+      if the bad batch had never been drawn); skips are counted and a
+      run of ``halt_after`` consecutive bad dispatches raises
+      :class:`NonFiniteError` so an all-NaN stream cannot spin forever.
+    * ``'raise'`` — first bad dispatch raises. Enforcement lags one
+      dispatch (see module docstring) but the lagged dispatch ran on
+      clean params, so nothing is ever corrupted.
+  """
+
+  MODES = ('off', 'skip_update', 'raise')
+
+  def __init__(self, mode: str = 'skip_update', halt_after: int = 10):
+    if mode not in self.MODES:
+      raise ValueError(f'nonfinite mode must be one of {self.MODES}, '
+                       f'got {mode!r}')
+    self.mode = mode
+    self.halt_after = int(halt_after)
+    self.bad_steps = 0        # total non-finite steps skipped on device
+    self.consecutive_bad = 0  # consecutive dispatches containing any
+
+  @property
+  def enabled(self) -> bool:
+    return self.mode != 'off'
+
+  def observe(self, nonfinite_count: int, step: int) -> None:
+    """Accounts one dispatch's device-computed non-finite step count."""
+    if not self.enabled:
+      return
+    count = int(nonfinite_count)
+    if count == 0:
+      self.consecutive_bad = 0
+      return
+    self.bad_steps += count
+    self.consecutive_bad += 1
+    if self.mode == 'raise':
+      raise NonFiniteError(
+          f'non-finite loss/grads at dispatch ending step {step} '
+          f'(policy=raise); update was skipped on device, params remain '
+          f'finite ({self.bad_steps} bad step(s) total)')
+    logging.warning(
+        'Non-finite loss/grads: skipped %d update(s) at dispatch ending '
+        'step %d (%d total, %d consecutive bad dispatch(es), halt at %d).',
+        count, step, self.bad_steps, self.consecutive_bad, self.halt_after)
+    if self.halt_after and self.consecutive_bad >= self.halt_after:
+      raise NonFiniteError(
+          f'{self.consecutive_bad} consecutive dispatches with non-finite '
+          f'loss/grads (>= halt_after={self.halt_after}) at step {step}; '
+          f'{self.bad_steps} update(s) skipped in total — halting, the '
+          f'input stream looks systematically broken')

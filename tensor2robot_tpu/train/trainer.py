@@ -37,6 +37,7 @@ from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct, algebra
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
+from tensor2robot_tpu.train import resilience
 from tensor2robot_tpu.train.train_state import (TrainState, apply_ema,
                                                 create_train_state)
 
@@ -113,6 +114,25 @@ class TrainerConfig:
   # on for TPU backends, off elsewhere and for multi-host feeding
   # (the process-local assembly path has no layout control).
   auto_input_layouts: Optional[bool] = None
+  # Non-finite update guard (train/resilience.py). 'off' compiles the
+  # historical step (bitwise status quo). 'skip_update' / 'raise' fold a
+  # device-side all_finite(loss, grads) check into the jitted step and
+  # guard the whole state update with where(ok, new, old): a NaN/Inf
+  # batch can never corrupt params, opt state, EMA, or the rng stream
+  # (state.step only advances on applied updates, so the skipped slot's
+  # fold_in key is reused — training equals a run that never drew the
+  # bad batch). The host evaluates the flag one dispatch behind (no
+  # added sync) and either raises immediately ('raise') or counts skips
+  # and halts after nonfinite_halt_after consecutive bad dispatches.
+  nonfinite_mode: str = 'off'
+  nonfinite_halt_after: int = 10
+  # Honor SIGTERM/SIGINT at dispatch boundaries: finish the in-flight
+  # dispatch, force a checkpoint (+ input-state save via the normal
+  # after_checkpoint callbacks), and raise resilience.PreemptedError —
+  # the preemptible-fleet contract. False leaves signal handling alone
+  # (library embedders own their signals); an already-installed global
+  # handler (resilience.install_graceful_shutdown) is honored either way.
+  handle_preemption: bool = False
   # Train steps folded into ONE device dispatch (TPUEstimator's
   # iterations_per_loop, tpu_config.py in the reference's stack): the
   # loop stacks K host batches and a lax.scan runs K optimizer steps
@@ -189,6 +209,11 @@ class _DevicePrefetcher:
     return self
 
   def __next__(self) -> 'PlacedBatch':
+    if self._err is not None:
+      # Deliver worker failures PROMPTLY: staged batches behind the
+      # sentinel are not drained first — a dead pipeline must not feed
+      # up to `depth` more steps before the loop learns about it.
+      raise self._err
     item = self._q.get()
     if item is self._DONE:
       if self._err is not None:
@@ -269,6 +294,30 @@ def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
     yield stacked(group)
 
 
+def _layout_api():
+  """Adapters across jax's Layout→Format API rename.
+
+  Returns ``(make_auto, compiled_input_formats, leaf_format)``:
+  jax >= 0.5 spells compiler-chosen layouts ``Format(Layout.AUTO, s)``
+  with ``compiled.input_formats`` / ``array.format``; jax 0.4.x spells
+  them ``Layout(DeviceLocalLayout.AUTO, s)`` with
+  ``compiled.input_layouts`` / ``array.layout``. Everything downstream
+  (device_put placement, equality checks) is API-compatible.
+  """
+  try:
+    from jax.experimental.layout import Format, Layout
+
+    return (lambda s: Format(Layout.AUTO, s),
+            lambda c: c.input_formats,
+            lambda a: getattr(a, 'format', None))
+  except ImportError:
+    from jax.experimental.layout import DeviceLocalLayout, Layout
+
+    return (lambda s: Layout(DeviceLocalLayout.AUTO, s),
+            lambda c: c.input_layouts,
+            lambda a: getattr(a, 'layout', None))
+
+
 def _mean_metrics(metric_batches: List[MetricDict]) -> MetricDict:
   if not metric_batches:
     return {}
@@ -285,9 +334,17 @@ class Trainer:
                model,
                config: TrainerConfig,
                mesh: Optional[jax.sharding.Mesh] = None,
-               callbacks: Sequence[TrainerCallback] = ()):
+               callbacks: Sequence[TrainerCallback] = (),
+               shutdown: Optional[resilience.GracefulShutdown] = None):
     self._model = model
     self._config = config
+    self._nonfinite_policy = (
+        resilience.NonFinitePolicy(config.nonfinite_mode,
+                                   config.nonfinite_halt_after)
+        if config.nonfinite_mode != 'off' else None)
+    if shutdown is None and config.handle_preemption:
+      shutdown = resilience.install_graceful_shutdown()
+    self._shutdown = shutdown
     self._mesh = mesh if mesh is not None else mesh_lib.single_device_mesh()
     if hasattr(model, 'set_mesh'):
       # Mesh-aware models (e.g. sequence-parallel attention layouts) get
@@ -350,6 +407,11 @@ class Trainer:
     """The step the dispatch that just reported began from (callbacks)."""
     return self._dispatch_start_step
 
+  @property
+  def nonfinite_policy(self) -> Optional['resilience.NonFinitePolicy']:
+    """Host-side non-finite accounting (None when the guard is off)."""
+    return self._nonfinite_policy
+
   def crossed(self, interval: int, step: int) -> bool:
     """Whether the dispatch that just reported ``step`` crossed a multiple
     of ``interval`` — the interval test callbacks must use instead of
@@ -364,6 +426,7 @@ class Trainer:
     preprocessor = self._preprocessor
     optimizer = self._optimizer
     decay = model.avg_model_params_decay
+    guard_nonfinite = self._config.nonfinite_mode != 'off'
 
     def train_step(state: TrainState, features, labels):
       step_rng = jax.random.fold_in(state.rng, state.step)
@@ -396,6 +459,22 @@ class Trainer:
           ema_params=apply_ema(state, new_params, decay))
       scalars = dict(scalars)
       scalars['loss'] = loss
+      if guard_nonfinite:
+        # Device-side guard: ok == all_finite(loss, grads). The ENTIRE
+        # state transition is selected through where(ok, new, old), so a
+        # non-finite batch leaves params/opt-state/EMA/step untouched —
+        # no host sync, no extra dispatch; the host policy reads the
+        # count from the scalars one dispatch behind. Leaves the replace
+        # kept by reference (rng) skip the select via identity.
+        checks = [jnp.all(jnp.isfinite(loss))]
+        for g in jax.tree_util.tree_leaves(grads):
+          if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
+            checks.append(jnp.all(jnp.isfinite(g)))
+        ok = jnp.stack(checks).all()
+        new_state = jax.tree_util.tree_map(
+            lambda n, o: n if n is o else jnp.where(ok, n, o),
+            new_state, state)
+        scalars['nonfinite_count'] = jnp.where(ok, 0, 1).astype(jnp.int32)
       return new_state, scalars
 
     return train_step
@@ -416,7 +495,12 @@ class Trainer:
         return step(carry, batch[0], batch[1])
 
       state, scalars_k = jax.lax.scan(body, state, (features_k, labels_k))
-      return state, jax.tree_util.tree_map(lambda x: x[-1], scalars_k)
+      out = jax.tree_util.tree_map(lambda x: x[-1], scalars_k)
+      if 'nonfinite_count' in out:
+        # The guard flag aggregates over the WHOLE group (a bad step in
+        # the middle must not be masked by a clean last step).
+        out['nonfinite_count'] = jnp.sum(scalars_k['nonfinite_count'])
+      return state, out
 
     return multi_step
 
@@ -458,17 +542,17 @@ class Trainer:
       if self._auto_disabled:
         return False
       try:
-        from jax.experimental.layout import Format, Layout
+        make_auto, input_formats_of, leaf_format = _layout_api()
 
         state_sharding = self._state_sharding()
-        auto = Format(Layout.AUTO, self._loop_batch_sharding())
+        auto = make_auto(self._loop_batch_sharding())
         jitted = jax.jit(
             self._loop_step_body(),
             in_shardings=(state_sharding, auto, auto),
             out_shardings=(state_sharding, None),
             donate_argnums=(0,))
         compiled = jitted.lower(self._state, features, labels).compile()
-        (state_fmt, feat_fmt, label_fmt), _ = compiled.input_formats
+        (state_fmt, feat_fmt, label_fmt), _ = input_formats_of(compiled)
         leaves, treedef = jax.tree_util.tree_flatten((features, labels))
         self._auto_batch_avals = (
             treedef, [(tuple(np.shape(x)), np.result_type(x))
@@ -477,7 +561,7 @@ class Trainer:
         # state is actually placed (state keeps its concrete sharding;
         # only batches are AUTO) — a mismatch would error mid-train, so
         # verify statically and fall back instead.
-        placed = [getattr(leaf, 'format', None)
+        placed = [leaf_format(leaf)
                   for leaf in jax.tree_util.tree_leaves(self._state)]
         expected = list(jax.tree_util.tree_leaves(state_fmt))
         if len(placed) != len(expected) or any(
@@ -629,8 +713,27 @@ class Trainer:
       batches: Iterator[PlacedBatch] = iter(prefetcher)
     else:
       batches = (place(b) for b in host_iter)
+    # Previous dispatch's device-side non-finite count, evaluated one
+    # dispatch behind so policy enforcement adds no sync (the update was
+    # already guarded on device; the lagged dispatch ran on clean state).
+    pending_nonfinite: Optional[Tuple[Any, int]] = None
+    shutdown = (self._shutdown if self._shutdown is not None
+                else resilience.active_shutdown())
     try:
       while step < config.max_train_steps:
+        if shutdown is not None and shutdown.requested:
+          # Preemption: the in-flight dispatch finished (we are at a
+          # boundary); force a checkpoint + input-state save and exit
+          # with the distinct resumable status.
+          logging.warning(
+              'Graceful shutdown requested; checkpointing step %d and '
+              'raising PreemptedError (resumable).', self.step)
+          self.save_checkpoint(force=True)
+          if self._manager is not None:
+            self._manager.wait_until_finished()
+          for cb in self._callbacks:
+            cb.end(self)
+          raise resilience.PreemptedError(self.step)
         (features, labels), use_auto = next(batches)
         step_fn = (self._auto_step if use_auto and self._auto_step is not None
                    else self._train_step_fn)
@@ -643,6 +746,11 @@ class Trainer:
           step += jax.tree_util.tree_leaves(features)[0].shape[0]
         else:
           step += 1
+        if self._nonfinite_policy is not None:
+          prev, pending_nonfinite = pending_nonfinite, (
+              scalars.get('nonfinite_count'), step)
+          if prev is not None and prev[0] is not None:
+            self._nonfinite_policy.observe(prev[0], prev[1])
         if crossed_interval(config.log_interval_steps, before, step):
           scalars = {k: float(v) for k, v in scalars.items()}
           dt = time.time() - last_log
@@ -664,6 +772,10 @@ class Trainer:
     finally:
       if prefetcher is not None:
         prefetcher.close()
+    if (self._nonfinite_policy is not None and
+        pending_nonfinite is not None and pending_nonfinite[0] is not None):
+      # Flush the final dispatch's flag before declaring success.
+      self._nonfinite_policy.observe(*pending_nonfinite)
     self.save_checkpoint(force=True)
     if self._manager is not None:
       self._manager.wait_until_finished()
@@ -744,6 +856,9 @@ def train_eval_model(model=None,
                      eval_timeout_secs: Optional[float] = 30.0,
                      steps_per_dispatch: int = 1,
                      checkpoint_input_state: bool = False,
+                     nonfinite_mode: str = 'off',
+                     nonfinite_halt_after: int = 10,
+                     handle_preemption: bool = False,
                      ) -> MetricDict:
   """The reference's `train_eval_model` entry (utils/train_eval.py:394-587).
 
@@ -763,7 +878,10 @@ def train_eval_model(model=None,
       max_checkpoints_to_keep=max_checkpoints_to_keep,
       log_interval_steps=log_interval_steps,
       seed=seed,
-      steps_per_dispatch=steps_per_dispatch)
+      steps_per_dispatch=steps_per_dispatch,
+      nonfinite_mode=nonfinite_mode,
+      nonfinite_halt_after=nonfinite_halt_after,
+      handle_preemption=handle_preemption)
   callbacks = list(callbacks)
   exporters = []
   if create_exporters_fn is not None:

@@ -1,0 +1,186 @@
+"""Deterministic, seedable fault injectors for resilience testing.
+
+Every failure mode the resilience layer claims to survive has an
+injector here, so ``tests/test_resilience.py`` can drill the real code
+paths end-to-end on the CPU backend instead of trusting unit mocks:
+
+* :class:`FailingIterator` — raises scheduled exceptions from
+  ``next()`` but SURVIVES them (subsequent calls continue the stream),
+  modelling flaky-but-recoverable sources for ``ResilientIterator``'s
+  same-iterator retry path.
+* :class:`NaNInjector` — replaces scheduled batches' float leaves with
+  NaN, driving the trainer's device-side non-finite guard.
+* :class:`PreemptionCallback` — requests graceful shutdown (or delivers
+  a real OS signal) at a chosen training step.
+* :func:`corrupt_record_file` — flips payload bytes of one framed
+  TFRecord so CRC-verified readers hit a genuine wire-level error.
+* :func:`truncate_checkpoint` / :func:`vanish_checkpoint` — simulate a
+  write cut off mid-flight / a GC'd or lost checkpoint step.
+
+All schedules are explicit step/index sets or seeded draws — a failing
+test replays bit-identically.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+from typing import Callable, Collection, Iterator, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.train.trainer import TrainerCallback
+
+
+class FailingIterator:
+  """Wraps an iterator; ``next()`` raises at scheduled call indices.
+
+  ``fail_at`` holds 0-based indices of ``__next__`` CALLS that raise
+  (each consumes the call without consuming an element, like a read
+  that failed before producing). The iterator stays usable afterwards —
+  the element sequence is unchanged, only interleaved with failures.
+  """
+
+  def __init__(self,
+               it: Iterator,
+               fail_at: Collection[int],
+               exc_factory: Callable[[int], BaseException] = (
+                   lambda i: IOError(f'injected fault at call {i}'))):
+    self._it = iter(it)
+    self._fail_at = frozenset(int(i) for i in fail_at)
+    self._exc_factory = exc_factory
+    self._calls = 0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    i = self._calls
+    self._calls += 1
+    if i in self._fail_at:
+      raise self._exc_factory(i)
+    return next(self._it)
+
+
+def nanify(batch):
+  """Returns ``batch`` with every float array leaf replaced by all-NaN."""
+  import jax
+
+  def poison(x):
+    arr = np.asarray(x)
+    if np.issubdtype(arr.dtype, np.floating):
+      return np.full_like(arr, np.nan)
+    return x
+
+  return jax.tree_util.tree_map(poison, batch)
+
+
+class NaNInjector:
+  """Replaces scheduled batches (0-based index) with all-NaN floats."""
+
+  def __init__(self, it: Iterator, nan_at: Collection[int]):
+    self._it = iter(it)
+    self._nan_at = frozenset(int(i) for i in nan_at)
+    self._index = 0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    batch = next(self._it)
+    i = self._index
+    self._index += 1
+    return nanify(batch) if i in self._nan_at else batch
+
+
+class PreemptionCallback(TrainerCallback):
+  """Fires a (simulated or real) preemption once, at/after ``at_step``.
+
+  With ``signum`` set, delivers a real OS signal to this process —
+  exercising the installed :class:`~tensor2robot_tpu.train.resilience.
+  GracefulShutdown` handler exactly as a cluster manager would;
+  otherwise calls ``shutdown.request()`` directly.
+  """
+
+  def __init__(self, at_step: int, shutdown=None,
+               signum: Optional[int] = None):
+    if (shutdown is None) == (signum is None):
+      raise ValueError('provide exactly one of shutdown= or signum=')
+    self._at_step = int(at_step)
+    self._shutdown = shutdown
+    self._signum = signum
+    self.fired_at: Optional[int] = None
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if self.fired_at is not None or step < self._at_step:
+      return
+    self.fired_at = step
+    if self._signum is not None:
+      os.kill(os.getpid(), self._signum)
+    else:
+      self._shutdown.request()
+
+
+# ------------------------------------------------------- on-disk faults
+
+
+def _record_frames(data: bytes):
+  """Yields ``(payload_offset, payload_length)`` per TFRecord frame."""
+  off = 0
+  while off + 12 <= len(data):
+    (length,) = struct.unpack('<Q', data[off:off + 8])
+    payload = off + 12
+    if payload + length + 4 > len(data):
+      return
+    yield payload, length
+    off = payload + length + 4
+
+
+def corrupt_record_file(path: str, record_index: int, seed: int = 0) -> None:
+  """Flips payload bytes of record ``record_index`` in a TFRecord file.
+
+  The frame structure (length headers) is preserved, so readers fail the
+  record's CRC check — the realistic torn-write/bitrot signature — while
+  earlier records stay readable.
+  """
+  with open(path, 'rb') as f:
+    data = bytearray(f.read())
+  frames = list(_record_frames(bytes(data)))
+  if record_index >= len(frames):
+    raise ValueError(
+        f'{path!r} has {len(frames)} records; cannot corrupt '
+        f'#{record_index}')
+  payload, length = frames[record_index]
+  rng = np.random.RandomState(seed)
+  if length == 0:
+    data[payload] ^= 0xFF  # empty payload: corrupt the data-CRC itself
+  for i in range(min(4, length)):
+    # XOR with a nonzero byte always changes the value → CRC must fail.
+    data[payload + i] ^= int(rng.randint(1, 256))
+  with open(path, 'wb') as f:
+    f.write(bytes(data))
+
+
+def truncate_checkpoint(ckpt_dir: str, step: int) -> str:
+  """Truncates every file of checkpoint ``step`` to 0 bytes.
+
+  Simulates a save cut off mid-write (preemption during the async
+  commit): the step directory still LOOKS present to ``latest_step``,
+  but any restore of it must fail — the case the restore fallback
+  handles by stepping back to the previous checkpoint.
+  """
+  step_dir = os.path.join(ckpt_dir, f'ckpt_{int(step)}')
+  if not os.path.isdir(step_dir):
+    raise FileNotFoundError(step_dir)
+  for root, _, files in os.walk(step_dir):
+    for name in files:
+      with open(os.path.join(root, name), 'w'):
+        pass
+  return step_dir
+
+
+def vanish_checkpoint(ckpt_dir: str, step: int) -> None:
+  """Deletes checkpoint ``step`` outright (lost dir / GC race)."""
+  shutil.rmtree(os.path.join(ckpt_dir, f'ckpt_{int(step)}'),
+                ignore_errors=True)

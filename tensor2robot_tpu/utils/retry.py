@@ -1,0 +1,175 @@
+"""Shared retry/backoff + bounded data-error budgets.
+
+The survival half of the fault-tolerance story for the data layer
+(``train/input_state.py`` is the recovery half): long training jobs on
+preemptible fleets see transient filesystem errors (GCS 5xx, NFS
+hiccups) and the occasional corrupt record, and neither should kill a
+multi-day run — but unbounded skipping would silently train on a
+shrinking dataset, so every skip is counted against an explicit budget
+that raises LOUDLY with full accounting once exceeded.
+
+Three pieces, composed by ``data/native_io.py``, ``data/
+input_generators.py`` and the fault-injection tests:
+
+* :func:`retry_call` / :class:`RetryPolicy` — jittered exponential
+  backoff for transient, retryable operations (opens, reads).
+  Deterministic when given an ``rng``; sleep is injectable for tests.
+* :class:`ErrorBudget` — a counted allowance of tolerated data errors;
+  ``record`` raises :class:`DataErrorBudgetExceededError` (with the
+  count, the budget, and the last error) once spent.
+* :class:`ResilientIterator` — wraps a batch/record iterator, charging
+  retryable failures of ``next()`` to a budget and either retrying the
+  same iterator (sources that survive a failed ``next``) or rebuilding
+  it from a factory (generators die on the first raise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple, Type
+
+# Exceptions that mark a *data/IO* problem worth retrying or skipping.
+# ValueError covers record parse failures (``native_io.NativeExampleParser``
+# raises it on corrupt wire bytes); budget/interrupt errors are excluded
+# by construction (DataErrorBudgetExceededError is a RuntimeError raised
+# by the budget itself, never by the wrapped source).
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (IOError, OSError,
+                                                      ValueError)
+
+
+class DataErrorBudgetExceededError(RuntimeError):
+  """A data source spent its error budget; the run must stop loudly."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+  """Jittered exponential backoff: ``base_delay * 2^attempt * (1 + U*jitter)``.
+
+  ``max_attempts`` counts total tries (1 = no retry). Deterministic when
+  constructed with an ``rng`` (any object with ``uniform(a, b)``, e.g.
+  ``random.Random(seed)``); ``sleep`` is injectable so tests never wait.
+  """
+
+  max_attempts: int = 3
+  base_delay: float = 0.05
+  max_delay: float = 2.0
+  jitter: float = 0.5
+  retry_on: Tuple[Type[BaseException], ...] = (IOError, OSError)
+  rng: Any = None
+  sleep: Callable[[float], None] = time.sleep
+
+  def delay(self, attempt: int) -> float:
+    rng = self.rng if self.rng is not None else random
+    scale = 1.0 + rng.uniform(0.0, self.jitter)
+    return min(self.max_delay, self.base_delay * (2.0 ** attempt)) * scale
+
+
+def retry_call(fn: Callable[..., Any],
+               *args,
+               policy: Optional[RetryPolicy] = None,
+               describe: str = '',
+               **kwargs) -> Any:
+  """Calls ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+  The final attempt's exception propagates unwrapped, so callers see
+  the same error type a bare call would raise.
+  """
+  policy = policy or RetryPolicy()
+  attempts = max(1, int(policy.max_attempts))
+  for attempt in range(attempts):
+    try:
+      return fn(*args, **kwargs)
+    except policy.retry_on as e:
+      if attempt + 1 >= attempts:
+        raise
+      delay = policy.delay(attempt)
+      logging.warning(
+          'Retryable failure%s (attempt %d/%d, retrying in %.2fs): %r',
+          f' in {describe}' if describe else '', attempt + 1, attempts,
+          delay, e)
+      policy.sleep(delay)
+
+
+class ErrorBudget:
+  """A bounded allowance of tolerated data errors.
+
+  ``max_errors`` is the number of errors that may be *absorbed*; the
+  ``max_errors + 1``-th ``record`` raises with full accounting. A budget
+  of 0 tolerates nothing (every error raises), which is also the
+  behavior of passing no budget at the call sites — the budget only
+  ever *adds* tolerance, never silences the over-budget case.
+  """
+
+  def __init__(self, max_errors: int = 10, name: str = 'data'):
+    self.max_errors = int(max_errors)
+    self.name = name
+    self.errors = 0
+    self.last_error: Optional[BaseException] = None
+
+  @property
+  def remaining(self) -> int:
+    return max(0, self.max_errors - self.errors)
+
+  def record(self, exc: BaseException) -> None:
+    """Charges one error; raises once the budget is exceeded."""
+    self.errors += 1
+    self.last_error = exc
+    if self.errors > self.max_errors:
+      raise DataErrorBudgetExceededError(
+          f'{self.name} error budget exceeded: {self.errors} error(s) > '
+          f'budget of {self.max_errors}; last error: {exc!r}') from exc
+    logging.warning(
+        '%s error %d/%d absorbed (budget remaining: %d): %r', self.name,
+        self.errors, self.max_errors, self.remaining, exc)
+
+
+class ResilientIterator:
+  """Iterator wrapper that skips failed ``next()`` calls within a budget.
+
+  ``source`` may be an iterator (failures retry the SAME iterator —
+  correct for sources that can continue past a failed ``next``, like the
+  native readers and fault injectors) or a zero-arg factory returning a
+  fresh iterator (failures REBUILD — required for python generators,
+  which are closed by the first exception they raise; note a rebuilt
+  stream restarts from its beginning, so budget data sources that
+  reshuffle or run infinitely). ``StopIteration`` always propagates:
+  exhaustion is not an error.
+  """
+
+  def __init__(self,
+               source,
+               budget: ErrorBudget,
+               retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+               backoff: Optional[RetryPolicy] = None):
+    if callable(source):
+      self._factory: Optional[Callable[[], Iterator]] = source
+      self._it = source()
+    else:
+      self._factory = None
+      self._it = iter(source)
+    self._budget = budget
+    self._retry_on = retry_on
+    self._backoff = backoff
+
+  @property
+  def budget(self) -> ErrorBudget:
+    return self._budget
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    while True:
+      try:
+        return next(self._it)
+      except StopIteration:
+        raise
+      except self._retry_on as e:
+        self._budget.record(e)  # raises DataErrorBudgetExceededError when spent
+        if self._backoff is not None:
+          self._backoff.sleep(self._backoff.delay(self._budget.errors - 1))
+        if self._factory is not None:
+          self._it = self._factory()

@@ -128,6 +128,8 @@ class TestGrasp2VecModel:
     leaf = jax.tree_util.tree_leaves(variables['params'])[0]
     assert leaf.dtype == jnp.float32
 
+  @pytest.mark.slow  # two full ResNet-18 training runs per loss family:
+  # ~3 CPU-minutes each, >60% of tier-1 wall time for three soak tests.
   @pytest.mark.parametrize('loss_name', ['npairs', 'triplet', 'l2'])
   def test_bf16_losses_converge_to_f32_parity(self, loss_name):
     """bf16 towers converge like f32 towers on all three loss families.

@@ -1,0 +1,561 @@
+"""Fault-injection drills for the resilience layer, on the CPU backend.
+
+Every claim the fault-tolerance subsystem makes is exercised end-to-end
+with the deterministic injectors from ``utils/faults.py``:
+
+  (a) a simulated preemption mid-run forces a checkpoint from which a
+      fresh trainer resumes to the same final step;
+  (b) a NaN batch under ``skip_update`` leaves params finite and EQUAL
+      to a run that never drew that batch;
+  (c) a corrupt/flaky stream within its error budget completes
+      training, and one over budget raises with the budget accounting;
+  (d) a truncated latest checkpoint falls back to the previous step.
+"""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.models import optimizers as opt_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct
+from tensor2robot_tpu.train import (CheckpointManager, GracefulShutdown,
+                                    NonFiniteError, PreemptedError, Trainer,
+                                    TrainerConfig, latest_checkpoint_step,
+                                    resilience, train_eval_model)
+from tensor2robot_tpu.utils import faults
+from tensor2robot_tpu.utils import retry as retry_lib
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+pytestmark = pytest.mark.faults
+
+
+def fast_adam():
+  return opt_lib.create_adam_optimizer(1e-2)
+
+
+def make_batches(n, batch_size=8, seed=0):
+  """Fixed, replayable (features, labels) batches of mock data."""
+  rng = np.random.RandomState(seed)
+  batches = []
+  for _ in range(n):
+    points = rng.uniform(-1.0, 1.0, (batch_size, 2)).astype(np.float32)
+    labels = (points.sum(axis=1) > 0).astype(np.float32)
+    features = SpecStruct()
+    features['measured_position'] = points
+    packed = SpecStruct()
+    packed['valid_position'] = labels
+    batches.append((features, packed))
+  return batches
+
+
+def make_trainer(model_dir='', callbacks=(), shutdown=None, **cfg):
+  model = MockT2RModel(device_type='tpu', create_optimizer_fn=fast_adam)
+  cfg.setdefault('prefetch_batches', 0)
+  config = TrainerConfig(
+      model_dir=model_dir, eval_interval_steps=0, log_interval_steps=0, **cfg)
+  return Trainer(model, config, callbacks=list(callbacks), shutdown=shutdown)
+
+
+def params_leaves(trainer):
+  return [np.asarray(x)
+          for x in jax.tree_util.tree_leaves(
+              jax.device_get(trainer.state.params))]
+
+
+# --------------------------------------------------- (a) preemption safety
+
+
+def test_preemption_checkpoints_and_resumes(tmp_path):
+  model_dir = str(tmp_path / 'm')
+  shutdown = GracefulShutdown()  # not installed: driven programmatically
+  cb = faults.PreemptionCallback(at_step=5, shutdown=shutdown)
+  trainer = make_trainer(model_dir=model_dir, callbacks=[cb],
+                         shutdown=shutdown, max_train_steps=12,
+                         save_interval_steps=1000)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  with pytest.raises(PreemptedError) as excinfo:
+    trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+  assert excinfo.value.step == 5
+  assert excinfo.value.exit_code == resilience.PREEMPTED_EXIT_CODE
+  # The forced checkpoint exists even though no save interval fired.
+  ckpt_dir = os.path.join(model_dir, 'checkpoints')
+  assert latest_checkpoint_step(ckpt_dir) == 5
+
+  # A fresh trainer restores the preemption checkpoint and finishes.
+  resumed = make_trainer(model_dir=model_dir, max_train_steps=12,
+                         save_interval_steps=1000)
+  gen2 = MockInputGenerator(batch_size=8)
+  gen2.set_specification_from_model(resumed.model, ModeKeys.TRAIN)
+  resumed.train(gen2.create_iterator(ModeKeys.TRAIN), None)
+  assert resumed.step == 12
+  assert latest_checkpoint_step(ckpt_dir) == 12
+
+
+def test_preemption_via_real_sigterm(tmp_path):
+  """The installed handler converts a real OS SIGTERM into the same
+  checkpoint-and-raise path a cluster preemption takes."""
+  model_dir = str(tmp_path / 'm')
+  # Whatever the suite left installed (e.g. pytest's own handlers) is
+  # the disposition the consumed handler must restore — not SIG_DFL.
+  prev = signal.getsignal(signal.SIGTERM)
+  shutdown = GracefulShutdown(signals=(signal.SIGTERM,)).install()
+  try:
+    cb = faults.PreemptionCallback(at_step=3, signum=signal.SIGTERM)
+    trainer = make_trainer(model_dir=model_dir, callbacks=[cb],
+                           shutdown=shutdown, max_train_steps=10,
+                           save_interval_steps=1000)
+    gen = MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+    with pytest.raises(PreemptedError):
+      trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    assert latest_checkpoint_step(os.path.join(model_dir, 'checkpoints')) == 3
+    # First signal consumed the handler: the previous disposition is back.
+    assert signal.getsignal(signal.SIGTERM) == prev
+  finally:
+    shutdown.uninstall()
+    signal.signal(signal.SIGTERM, prev)
+
+
+def test_graceful_shutdown_install_uninstall_roundtrip():
+  prev = signal.getsignal(signal.SIGTERM)
+  shutdown = GracefulShutdown(signals=(signal.SIGTERM,))
+  assert not shutdown.requested
+  with shutdown:
+    assert signal.getsignal(signal.SIGTERM) != prev
+  assert signal.getsignal(signal.SIGTERM) == prev
+  shutdown.request()
+  assert shutdown.requested
+
+
+# ------------------------------------------------ (b) non-finite guarding
+
+
+def train_on_batches(batches, **cfg):
+  trainer = make_trainer(max_train_steps=len(batches), **cfg)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  trainer.train(iter(batches), None)
+  return trainer
+
+
+def test_nan_batch_skip_update_equals_run_without_it():
+  b = make_batches(3)
+  poisoned = [b[0], faults.nanify(b[1]), b[2]]
+  run_a = train_on_batches(poisoned, nonfinite_mode='skip_update')
+  # state.step counts APPLIED updates; the skipped slot reuses its rng
+  # key, so training equals a run that never drew the bad batch.
+  assert run_a.step == 2
+  assert run_a.nonfinite_policy.bad_steps == 1
+  for leaf in params_leaves(run_a):
+    assert np.isfinite(leaf).all()
+
+  run_b = train_on_batches([b[0], b[2]], nonfinite_mode='skip_update')
+  for got, want in zip(params_leaves(run_a), params_leaves(run_b)):
+    np.testing.assert_array_equal(got, want)
+
+
+def test_guard_off_is_bitwise_status_quo():
+  """With clean data, the guarded step computes the identical params."""
+  b = make_batches(4)
+  guarded = train_on_batches(b, nonfinite_mode='skip_update')
+  plain = train_on_batches(b, nonfinite_mode='off')
+  assert guarded.nonfinite_policy.bad_steps == 0
+  for got, want in zip(params_leaves(guarded), params_leaves(plain)):
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nan_batch_skip_update_in_multi_step_dispatch():
+  """The guard composes with steps_per_dispatch: a bad step inside a
+  scanned group is skipped and counted without poisoning the group."""
+  b = make_batches(4)
+  poisoned = [b[0], b[1], faults.nanify(b[2]), b[3]]
+  run_a = train_on_batches(poisoned, nonfinite_mode='skip_update',
+                           steps_per_dispatch=2)
+  assert run_a.step == 3
+  assert run_a.nonfinite_policy.bad_steps == 1
+  run_b = train_on_batches([b[0], b[1], b[3]],
+                           nonfinite_mode='skip_update',
+                           steps_per_dispatch=2)
+  for got, want in zip(params_leaves(run_a), params_leaves(run_b)):
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nan_batch_raise_policy():
+  b = make_batches(4)
+  poisoned = [b[0], faults.nanify(b[1]), b[2], b[3]]
+  with pytest.raises(NonFiniteError, match='policy=raise'):
+    train_on_batches(poisoned, nonfinite_mode='raise')
+
+
+def test_nan_final_batch_raise_policy_flushes():
+  """The one-dispatch enforcement lag still catches a bad FINAL step."""
+  b = make_batches(2)
+  with pytest.raises(NonFiniteError, match='policy=raise'):
+    train_on_batches([b[0], faults.nanify(b[1])], nonfinite_mode='raise')
+
+
+def test_all_nan_stream_halts_after_consecutive_budget():
+  b = make_batches(8)
+  poisoned = [faults.nanify(x) for x in b]
+  with pytest.raises(NonFiniteError, match='consecutive'):
+    train_on_batches(poisoned, nonfinite_mode='skip_update',
+                     nonfinite_halt_after=3)
+
+
+def test_nonfinite_policy_accounting():
+  policy = resilience.NonFinitePolicy('skip_update', halt_after=3)
+  policy.observe(1, step=1)
+  policy.observe(0, step=2)
+  policy.observe(2, step=3)
+  assert policy.bad_steps == 3
+  assert policy.consecutive_bad == 1
+  policy.observe(1, step=4)
+  with pytest.raises(NonFiniteError, match='3 consecutive'):
+    policy.observe(1, step=5)
+  with pytest.raises(ValueError):
+    resilience.NonFinitePolicy('explode')
+
+
+# -------------------------------------------------- (c) data error budgets
+
+
+def test_resilient_iterator_within_budget():
+  inner = faults.FailingIterator(iter(range(5)), fail_at={1, 3})
+  budget = retry_lib.ErrorBudget(max_errors=5, name='test')
+  out = list(retry_lib.ResilientIterator(inner, budget=budget))
+  assert out == [0, 1, 2, 3, 4]
+  assert budget.errors == 2
+
+
+def test_resilient_iterator_over_budget_accounting():
+  inner = faults.FailingIterator(iter(range(5)), fail_at={1, 2})
+  budget = retry_lib.ErrorBudget(max_errors=1, name='test-stream')
+  it = retry_lib.ResilientIterator(inner, budget=budget)
+  with pytest.raises(retry_lib.DataErrorBudgetExceededError,
+                     match=r'test-stream error budget exceeded: 2 error\(s\) '
+                           r'> budget of 1'):
+    list(it)
+
+
+def test_training_completes_on_flaky_stream_within_budget():
+  b = make_batches(6)
+  flaky = faults.FailingIterator(iter(b), fail_at={2, 4})
+  budget = retry_lib.ErrorBudget(max_errors=4, name='train batches')
+  trainer = make_trainer(max_train_steps=6)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  trainer.train(retry_lib.ResilientIterator(flaky, budget=budget), None)
+  assert trainer.step == 6
+  assert budget.errors == 2
+
+
+def test_training_raises_over_budget_with_accounting():
+  b = make_batches(8)
+  flaky = faults.FailingIterator(iter(b), fail_at={1, 2, 3})
+  budget = retry_lib.ErrorBudget(max_errors=2, name='train batches')
+  trainer = make_trainer(max_train_steps=8)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  with pytest.raises(retry_lib.DataErrorBudgetExceededError,
+                     match=r'3 error\(s\) > budget of 2'):
+    trainer.train(retry_lib.ResilientIterator(flaky, budget=budget), None)
+
+
+def test_budget_error_surfaces_promptly_through_prefetcher():
+  """The budget blow-up must cross the prefetch thread at the NEXT
+  __next__, not after `depth` staged batches."""
+  b = make_batches(8)
+  flaky = faults.FailingIterator(iter(b), fail_at={1, 2})
+  budget = retry_lib.ErrorBudget(max_errors=1, name='train batches')
+  trainer = make_trainer(max_train_steps=8, prefetch_batches=3)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  with pytest.raises(retry_lib.DataErrorBudgetExceededError):
+    trainer.train(retry_lib.ResilientIterator(flaky, budget=budget), None)
+
+
+class _FlakyMockGenerator(MockInputGenerator):
+  """Fails the first ``fail_times`` iterator builds (transient source)."""
+
+  def __init__(self, fail_times: int, **kwargs):
+    super().__init__(**kwargs)
+    self._remaining_fails = fail_times
+
+  def _create_iterator(self, mode, batch_size):
+    if self._remaining_fails > 0:
+      self._remaining_fails -= 1
+
+      def dead():
+        raise IOError('flaky source (injected)')
+        yield  # pylint: disable=unreachable
+
+      return dead()
+    return super()._create_iterator(mode, batch_size)
+
+
+def test_input_generator_error_budget_wiring():
+  """`error_budget` on the generator wraps its iterator in a
+  ResilientIterator that rebuilds the stream within budget."""
+  gen = _FlakyMockGenerator(fail_times=2, batch_size=4, error_budget=3)
+  model = MockT2RModel(device_type='tpu')
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  it = gen.create_iterator(ModeKeys.TRAIN)
+  features, labels = next(it)  # two rebuilds happen silently
+  assert features['measured_position'].shape == (4, 2)
+  assert it.budget.errors == 2
+
+  over = _FlakyMockGenerator(fail_times=3, batch_size=4, error_budget=1)
+  over.set_specification_from_model(model, ModeKeys.TRAIN)
+  with pytest.raises(retry_lib.DataErrorBudgetExceededError,
+                     match='budget of 1'):
+    next(over.create_iterator(ModeKeys.TRAIN))
+
+
+def test_retry_call_backoff_deterministic():
+  import random
+
+  calls = []
+  sleeps = []
+
+  def flaky():
+    calls.append(1)
+    if len(calls) < 3:
+      raise IOError('transient')
+    return 'ok'
+
+  policy = retry_lib.RetryPolicy(
+      max_attempts=4, base_delay=0.1, jitter=0.5,
+      rng=random.Random(0), sleep=sleeps.append)
+  assert retry_lib.retry_call(flaky, policy=policy) == 'ok'
+  assert len(calls) == 3 and len(sleeps) == 2
+  # Jittered exponential: delay in [base*2^i, base*2^i*1.5].
+  assert 0.1 <= sleeps[0] <= 0.15
+  assert 0.2 <= sleeps[1] <= 0.3
+
+  def always_fails():
+    raise IOError('permanent')
+
+  with pytest.raises(IOError, match='permanent'):
+    retry_lib.retry_call(
+        always_fails,
+        policy=retry_lib.RetryPolicy(max_attempts=2, sleep=lambda s: None))
+
+
+# ---------------------------------------- (c, native) corrupt record files
+
+
+def _native_available():
+  from tensor2robot_tpu.data import native_io
+  return native_io.available()
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason='native record_io unavailable')
+def test_native_reader_corrupt_record_budget(tmp_path):
+  from tensor2robot_tpu.data import native_io
+
+  path = str(tmp_path / 'data.tfrecord')
+  records = [bytes([i]) * 32 for i in range(6)]
+  with native_io.NativeRecordWriter(path) as w:
+    for r in records:
+      w.write(r)
+  faults.corrupt_record_file(path, record_index=3)
+
+  # No budget: historical behavior, the read error raises outright.
+  with pytest.raises(IOError, match='record read failed'):
+    with native_io.NativeRecordReader(path) as r:
+      list(r)
+
+  # Within budget: the records before the corruption survive, the file
+  # is treated as truncated, and the error is charged.
+  budget = retry_lib.ErrorBudget(max_errors=1, name='records')
+  with native_io.NativeRecordReader(path, error_budget=budget) as r:
+    assert list(r) == records[:3]
+  assert budget.errors == 1
+
+  # Over budget (0 tolerated): the budget raises with accounting.
+  empty = retry_lib.ErrorBudget(max_errors=0, name='records')
+  with pytest.raises(retry_lib.DataErrorBudgetExceededError,
+                     match=r'1 error\(s\) > budget of 0'):
+    with native_io.NativeRecordReader(path, error_budget=empty) as r:
+      list(r)
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason='native record_io unavailable')
+def test_native_interleave_corrupt_record_budget(tmp_path):
+  from tensor2robot_tpu.data import native_io
+
+  good = str(tmp_path / 'good.tfrecord')
+  bad = str(tmp_path / 'bad.tfrecord')
+  for path in (good, bad):
+    with native_io.NativeRecordWriter(path) as w:
+      for i in range(4):
+        w.write(f'{os.path.basename(path)}:{i}'.encode() * 4)
+  faults.corrupt_record_file(bad, record_index=1)
+
+  budget = retry_lib.ErrorBudget(max_errors=2, name='interleave')
+  with native_io.NativeInterleaveReader([good, bad],
+                                        error_budget=budget) as r:
+    out = list(r)  # pass ends early after the bad record, budget charged
+  assert budget.errors == 1
+  assert any(o.startswith(b'good.tfrecord') for o in out)
+
+
+# ------------------------------------------- (d) checkpoint integrity
+
+
+def test_restore_falls_back_to_older_step_on_truncation(tmp_path):
+  ckpt_dir = str(tmp_path / 'ckpts')
+  state = {'x': np.arange(8, dtype=np.float32),
+           'step': np.zeros((), np.int32)}
+  with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    mgr.save(1, {'x': state['x'] + 1, 'step': np.full((), 1, np.int32)},
+             force=True)
+    mgr.save(2, {'x': state['x'] + 2, 'step': np.full((), 2, np.int32)},
+             force=True)
+  faults.truncate_checkpoint(ckpt_dir, 2)
+
+  with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    restored = mgr.restore(state)
+  assert int(restored['step']) == 1
+  np.testing.assert_array_equal(restored['x'], state['x'] + 1)
+
+
+def test_restore_raises_when_all_checkpoints_corrupt(tmp_path):
+  ckpt_dir = str(tmp_path / 'ckpts')
+  state = {'x': np.arange(4, dtype=np.float32)}
+  with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    mgr.save(1, state, force=True)
+  faults.truncate_checkpoint(ckpt_dir, 1)
+  with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    with pytest.raises(RuntimeError, match='failed to restore'):
+      mgr.restore(state)
+
+
+def test_trainer_resumes_from_older_step_when_latest_truncated(tmp_path,
+                                                               caplog):
+  model_dir = str(tmp_path / 'm')
+  ckpt_dir = os.path.join(model_dir, 'checkpoints')
+
+  def run(max_steps):
+    return train_eval_model(
+        model=MockT2RModel(device_type='tpu'),
+        model_dir=model_dir,
+        train_input_generator=MockInputGenerator(batch_size=8),
+        max_train_steps=max_steps,
+        save_interval_steps=10,
+        eval_interval_steps=0,
+        log_interval_steps=0)
+
+  run(20)
+  assert latest_checkpoint_step(ckpt_dir) == 20
+  faults.truncate_checkpoint(ckpt_dir, 20)
+  import logging as logging_mod
+
+  with caplog.at_level(logging_mod.WARNING):
+    run(30)
+  assert latest_checkpoint_step(ckpt_dir) == 30
+  assert any('falling back' in r.message for r in caplog.records)
+
+
+def test_vanished_checkpoint_resumes_from_survivor(tmp_path):
+  model_dir = str(tmp_path / 'm')
+  ckpt_dir = os.path.join(model_dir, 'checkpoints')
+
+  def run(max_steps):
+    return train_eval_model(
+        model=MockT2RModel(device_type='tpu'),
+        model_dir=model_dir,
+        train_input_generator=MockInputGenerator(batch_size=8),
+        max_train_steps=max_steps,
+        save_interval_steps=10,
+        eval_interval_steps=0,
+        log_interval_steps=0)
+
+  run(20)
+  faults.vanish_checkpoint(ckpt_dir, 20)
+  assert latest_checkpoint_step(ckpt_dir) == 10
+  run(30)
+  assert latest_checkpoint_step(ckpt_dir) == 30
+
+
+def test_latest_checkpoint_step_skips_unparsable_entries(tmp_path):
+  d = str(tmp_path)
+  for name in ('ckpt_5', 'ckpt_backup', 'ckpt_', 'ckpt_7.tmpfoo',
+               'ckpt_9.orbax-checkpoint-tmp'):
+    os.makedirs(os.path.join(d, name))
+  assert latest_checkpoint_step(d) == 5
+  assert latest_checkpoint_step(str(tmp_path / 'missing')) is None
+
+
+def test_async_save_accepts_device_arrays(tmp_path):
+  """Orbax owns the device→host copy: device (even sharded) arrays go
+  straight in, and the round trip is exact."""
+  import jax.numpy as jnp
+
+  ckpt_dir = str(tmp_path / 'ckpts')
+  state = {'x': jnp.arange(16, dtype=jnp.float32) * 2.0,
+           'step': jnp.zeros((), jnp.int32) + 7}
+  with CheckpointManager(ckpt_dir, async_save=True) as mgr:
+    assert mgr.save(7, state, force=True)
+    mgr.wait_until_finished()
+  with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+    restored = mgr.restore({'x': np.zeros(16, np.float32),
+                            'step': np.zeros((), np.int32)})
+  np.testing.assert_array_equal(restored['x'], np.arange(16) * 2.0)
+  assert int(restored['step']) == 7
+
+
+# ------------------------------------------------------ fault injectors
+
+
+def test_failing_iterator_is_deterministic_and_survives():
+  it = faults.FailingIterator(iter('abcde'), fail_at={0, 2})
+  out, errors = [], 0
+  for _ in range(7):
+    try:
+      out.append(next(it))
+    except IOError:
+      errors += 1
+  assert out == list('abcde')
+  assert errors == 2
+
+
+def test_nanify_poisons_only_float_leaves():
+  batch = ({'f': np.ones((2, 2), np.float32), 'i': np.arange(3)},
+           np.ones(4, np.float64))
+  poisoned = faults.nanify(batch)
+  assert np.isnan(poisoned[0]['f']).all()
+  assert np.isnan(poisoned[1]).all()
+  np.testing.assert_array_equal(poisoned[0]['i'], np.arange(3))
+
+
+def test_nan_injector_schedule():
+  batches = [np.full((2,), float(i), np.float32) for i in range(4)]
+  out = list(faults.NaNInjector(iter(batches), nan_at={1, 3}))
+  assert not np.isnan(out[0]).any() and not np.isnan(out[2]).any()
+  assert np.isnan(out[1]).all() and np.isnan(out[3]).all()
+
+
+def test_resilience_logger_callback_surfaces_skips(caplog):
+  import logging as logging_mod
+
+  from tensor2robot_tpu.train.callbacks import ResilienceLoggerCallback
+
+  b = make_batches(3)
+  poisoned = [b[0], faults.nanify(b[1]), b[2]]
+  trainer = make_trainer(max_train_steps=3, nonfinite_mode='skip_update',
+                         callbacks=[ResilienceLoggerCallback(
+                             log_interval_steps=1)])
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(trainer.model, ModeKeys.TRAIN)
+  with caplog.at_level(logging_mod.INFO):
+    trainer.train(iter(poisoned), None)
+  assert any('non-finite update(s) skipped' in r.message
+             for r in caplog.records)

@@ -162,6 +162,36 @@ class TestSpecStruct:
     assert isinstance(doubled, SpecStruct)
     np.testing.assert_allclose(np.asarray(doubled['a/x']), 2.0)
 
+  def test_pytree_unflatten_accepts_arbitrary_leaves(self):
+    """The pytree contract: unflatten must NOT validate leaves — jax
+    internals rebuild trees around sentinel objects (pjit's in_shardings
+    prefix matching, tracers), and a validating unflatten broke every
+    sharded-SpecStruct jit call."""
+    import jax
+
+    s = SpecStruct({'a/x': np.ones(2, np.float32),
+                    'b': np.zeros(3, np.float32)})
+    leaves, treedef = jax.tree_util.tree_flatten(s)
+    sentinel = object()
+    rebuilt = jax.tree_util.tree_unflatten(treedef, [sentinel] * len(leaves))
+    assert isinstance(rebuilt, SpecStruct)
+    assert all(leaf is sentinel
+               for leaf in jax.tree_util.tree_leaves(rebuilt))
+
+  def test_pytree_prefix_sharding_through_jit(self):
+    """A single NamedSharding must broadcast as a pytree prefix over a
+    SpecStruct argument (the trainer's batch in_shardings pattern)."""
+    import jax
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ('data',))
+    sharding = jax.sharding.NamedSharding(mesh,
+                                          jax.sharding.PartitionSpec())
+    s = SpecStruct({'a/x': np.ones((8, 2), np.float32)})
+    fn = jax.jit(lambda t: jax.tree_util.tree_map(lambda v: v * 2, t),
+                 in_shardings=(sharding,))
+    out = fn(s)
+    np.testing.assert_allclose(np.asarray(out['a/x']), 2.0)
+
   def test_pickle_roundtrip_and_views(self):
     import pickle
 

@@ -736,3 +736,119 @@ def test_input_state_missing_falls_back_to_fresh_stream(tmp_path, caplog):
   assert int(trainer.step) == 4
   assert any('no' in r.message.lower() and 'input state' in r.message.lower()
              for r in caplog.records), [r.message for r in caplog.records]
+
+class TestCrossedInterval:
+  """`crossed_interval` is the ONE interval authority for logging, eval,
+  and checkpoint cadence — its edge cases gate all three."""
+
+  def test_zero_interval_disables(self):
+    from tensor2robot_tpu.train.trainer import crossed_interval
+    assert not crossed_interval(0, 0, 1)
+    assert not crossed_interval(0, 99, 100)
+
+  def test_k1_reduces_to_modulo(self):
+    from tensor2robot_tpu.train.trainer import crossed_interval
+    for step in range(1, 50):
+      assert crossed_interval(10, step - 1, step) == (step % 10 == 0)
+
+  def test_fires_once_per_multiple_when_jumping(self):
+    """With steps_per_dispatch > 1 the counter may jump over a multiple;
+    the interval fires at the first boundary ON OR AFTER the multiple."""
+    from tensor2robot_tpu.train.trainer import crossed_interval
+    # Stride 7, interval 10: boundaries 7, 14, 21, 28, ...
+    fired = [after for after in range(7, 71, 7)
+             if crossed_interval(10, after - 7, after)]
+    assert fired == [14, 21, 35, 42, 56, 63, 70]
+
+  def test_jump_across_many_multiples_fires_once(self):
+    from tensor2robot_tpu.train.trainer import crossed_interval
+    # One dispatch crossing 3 multiples still reports a single crossing.
+    assert crossed_interval(10, 0, 35)
+    assert not crossed_interval(10, 35, 39)
+
+  def test_exact_landing_does_not_refire_next_dispatch(self):
+    from tensor2robot_tpu.train.trainer import crossed_interval
+    assert crossed_interval(10, 5, 10)
+    assert not crossed_interval(10, 10, 15)
+
+
+class TestGroupedBatches:
+  """`_grouped_batches` stacks K host batches per dispatch; its clipping
+  and ragged-tail behavior decide how many steps actually train."""
+
+  @staticmethod
+  def _batches(shapes):
+    for i, shape in enumerate(shapes):
+      features = np.full(shape, float(i), np.float32)
+      labels = np.full((shape[0],), float(i), np.float32)
+      yield features, labels
+
+  def test_groups_of_k(self):
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2)] * 6), k=3, start_step=0, max_steps=6))
+    assert [g[0].shape for g in groups] == [(3, 4, 2), (3, 4, 2)]
+
+  def test_max_steps_clips_final_group(self):
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2)] * 10), k=4, start_step=0, max_steps=6))
+    # 4 + 2 (clipped), never overshooting max_steps.
+    assert [g[0].shape[0] for g in groups] == [4, 2]
+
+  def test_start_step_offsets_budget(self):
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2)] * 10), k=4, start_step=4, max_steps=6))
+    assert [g[0].shape[0] for g in groups] == [2]
+
+  def test_ragged_tail_closes_group_early(self):
+    """A batch with different shapes (ragged tail) must not be stacked
+    into the open group — it starts its own group."""
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2), (4, 2), (3, 2)]), k=3, start_step=0,
+        max_steps=10))
+    assert [g[0].shape for g in groups] == [(2, 4, 2), (1, 3, 2)]
+
+  def test_early_close_respects_max_steps(self):
+    """An early close that reaches max_steps stops consuming entirely."""
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2), (4, 2), (3, 2), (3, 2)]), k=4, start_step=0,
+        max_steps=2))
+    assert [g[0].shape for g in groups] == [(2, 4, 2)]
+
+  def test_exhausted_input_flushes_partial_group(self):
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(4, 2)] * 2), k=5, start_step=0, max_steps=100))
+    assert [g[0].shape for g in groups] == [(2, 4, 2)]
+
+  def test_values_preserved_in_order(self):
+    from tensor2robot_tpu.train.trainer import _grouped_batches
+    groups = list(_grouped_batches(
+        self._batches([(2, 2)] * 4), k=2, start_step=0, max_steps=4))
+    flat = [g[0][i, 0, 0] for g in groups for i in range(g[0].shape[0])]
+    assert flat == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_prefetcher_delivers_worker_error_promptly():
+  """A worker exception must surface at the NEXT __next__, not after the
+  consumer drains all already-staged batches — the loop must not train
+  `depth` extra steps on a dead pipeline."""
+  from tensor2robot_tpu.train.trainer import _DevicePrefetcher
+
+  def source():
+    yield ('b0', 'l0')
+    yield ('b1', 'l1')
+    raise IOError('pipeline died')
+
+  prefetcher = _DevicePrefetcher(
+      source(), place=lambda b: (b, False), depth=4)
+  prefetcher._thread.join(timeout=5)  # pylint: disable=protected-access
+  assert not prefetcher._thread.is_alive()  # pylint: disable=protected-access
+  # Both good batches are staged, but the error beats them out.
+  with pytest.raises(IOError, match='pipeline died'):
+    next(iter(prefetcher))
+  prefetcher.close()
