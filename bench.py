@@ -611,6 +611,21 @@ def main():
       print(json.dumps({'metric': 'cem_action_device_ms',
                         'error': repr(e)[:200]}))
 
+  # Observability snapshot: the registry accumulated the whole bench's
+  # data/trainer/checkpoint instrumentation (record-fed reader counts,
+  # step-time breakdown gauges, prefetch starvation, ...), so future
+  # BENCH rounds record the breakdown alongside throughput — an
+  # input-bound record-fed number arrives pre-diagnosed. Best-effort and
+  # BEFORE the headline line, which must stay last.
+  try:
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+
+    print(json.dumps({'metric': 'observability_report',
+                      **metrics_lib.report()}))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'observability_report',
+                      'error': repr(e)[:200]}))
+
   print(json.dumps({
       'metric': metric,
       'value': round(steps_per_sec, 3),

@@ -132,10 +132,27 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         seed=self._seed)
 
   def _create_iterator(self, mode, batch_size):
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+    from tensor2robot_tpu.observability import tracing
+
     dataset = self._make_dataset(mode, batch_size)
     has_labels = self._label_spec is not None
-    return (pipeline.pack_numpy_element(element, has_labels)
-            for element in dataset.as_numpy_iterator())
+
+    def iterate():
+      batches = metrics_lib.counter('data/tf_batches')
+      it = iter(dataset.as_numpy_iterator())
+      while True:
+        # The train loop's cost of surfacing one tf.data batch (the
+        # pipeline's own parse/decode threads run behind this call).
+        with tracing.span('data/tf_next', annotate=False):
+          try:
+            element = next(it)
+          except StopIteration:
+            return
+        batches.inc()
+        yield pipeline.pack_numpy_element(element, has_labels)
+
+    return iterate()
 
   def create_checkpointable_iterator(
       self, mode: str, batch_size: Optional[int] = None

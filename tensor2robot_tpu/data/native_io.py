@@ -20,7 +20,21 @@ import threading
 from typing import Iterator, List, Optional, Sequence
 
 from tensor2robot_tpu import native
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.utils import retry as retry_lib
+
+# Per-record counters batch locally and flush every N records: one lock
+# acquire per record would tax the multi-GB/s interleave reader; one per
+# 256 is noise. (Registry counts lag the live stream by <N records.)
+_COUNTER_FLUSH_EVERY = 256
+
+
+def _charge_read_error(err: str) -> None:
+  """Registry accounting for one reader-level error."""
+  metrics_lib.counter('data/read_errors').inc()
+  if 'crc mismatch' in err:
+    metrics_lib.counter('data/crc_errors').inc()
 
 
 def available() -> bool:
@@ -90,21 +104,37 @@ class NativeRecordReader:
 
   def __iter__(self) -> Iterator[bytes]:
     buf = ctypes.POINTER(ctypes.c_uint8)()
-    while True:
-      n = self._lib.t2r_reader_next(self._h, ctypes.byref(buf))
-      if n == -1:
-        return
-      if n == -2:
-        err = self._lib.t2r_reader_error(self._h).decode()
-        exc = IOError(f'record read failed: {err}')
-        if self._error_budget is None:
-          raise exc
-        self._error_budget.record(exc)  # raises once the budget is spent
-        logging.warning(
-            'Treating %r as truncated after a framing-breaking read '
-            'error.', self._path)
-        return
-      yield ctypes.string_at(buf, n)
+    m_records = metrics_lib.counter('data/records_read')
+    m_bytes = metrics_lib.counter('data/bytes_read')
+    pending_records = pending_bytes = 0
+    try:
+      while True:
+        n = self._lib.t2r_reader_next(self._h, ctypes.byref(buf))
+        if n == -1:
+          return
+        if n == -2:
+          err = self._lib.t2r_reader_error(self._h).decode()
+          _charge_read_error(err)
+          exc = IOError(f'record read failed: {err}')
+          if self._error_budget is None:
+            raise exc
+          # This reader KNOWS its file — charge the budget per source.
+          self._error_budget.record(exc, source=self._path)
+          logging.warning(
+              'Treating %r as truncated after a framing-breaking read '
+              'error.', self._path)
+          return
+        pending_records += 1
+        pending_bytes += n
+        if pending_records >= _COUNTER_FLUSH_EVERY:
+          m_records.inc(pending_records)
+          m_bytes.inc(pending_bytes)
+          pending_records = pending_bytes = 0
+        yield ctypes.string_at(buf, n)
+    finally:
+      if pending_records:
+        m_records.inc(pending_records)
+        m_bytes.inc(pending_bytes)
 
   def close(self) -> None:
     if self._h:
@@ -142,25 +172,42 @@ class NativeInterleaveReader:
 
   def __iter__(self) -> Iterator[bytes]:
     buf = ctypes.POINTER(ctypes.c_uint8)()
-    while True:
-      n = self._lib.t2r_interleave_next(self._h, ctypes.byref(buf))
-      if n == -1:
-        return
-      if n == -2:
-        err = self._lib.t2r_interleave_error(self._h).decode()
-        exc = IOError(f'interleave read failed: {err}')
-        if self._error_budget is None:
-          raise exc
-        # A read error poisons the whole interleave (the failing slot
-        # cannot resync mid-file): charge the budget and end this pass;
-        # callers that loop epochs (train) reopen and continue on the
-        # surviving bytes, bounded by the shared budget.
-        self._error_budget.record(exc)  # raises once the budget is spent
-        logging.warning(
-            'Ending interleave pass early after a read error (budget '
-            'remaining: %d).', self._error_budget.remaining)
-        return
-      yield ctypes.string_at(buf, n)
+    m_records = metrics_lib.counter('data/records_read')
+    m_bytes = metrics_lib.counter('data/bytes_read')
+    pending_records = pending_bytes = 0
+    try:
+      while True:
+        n = self._lib.t2r_interleave_next(self._h, ctypes.byref(buf))
+        if n == -1:
+          return
+        if n == -2:
+          err = self._lib.t2r_interleave_error(self._h).decode()
+          _charge_read_error(err)
+          exc = IOError(f'interleave read failed: {err}')
+          if self._error_budget is None:
+            raise exc
+          # A read error poisons the whole interleave (the failing slot
+          # cannot resync mid-file): charge the budget and end this pass;
+          # callers that loop epochs (train) reopen and continue on the
+          # surviving bytes, bounded by the shared budget. The failing
+          # FILE rides in the native error text ("<path>: <reason>"), so
+          # the budget's source attribution resolves it from the message.
+          self._error_budget.record(exc)  # raises once the budget is spent
+          logging.warning(
+              'Ending interleave pass early after a read error (budget '
+              'remaining: %d).', self._error_budget.remaining)
+          return
+        pending_records += 1
+        pending_bytes += n
+        if pending_records >= _COUNTER_FLUSH_EVERY:
+          m_records.inc(pending_records)
+          m_bytes.inc(pending_bytes)
+          pending_records = pending_bytes = 0
+        yield ctypes.string_at(buf, n)
+    finally:
+      if pending_records:
+        m_records.inc(pending_records)
+        m_bytes.inc(pending_bytes)
 
   def close(self) -> None:
     if self._h:
@@ -453,16 +500,22 @@ def make_native_parse_fn(feature_spec, label_spec=None,
   def parse_fn(records):
     from tensor2robot_tpu.specs import SpecStruct
 
-    parsed = parser.parse_batch(list(records))
+    with tracing.span('data/parse'):
+      parsed = parser.parse_batch(list(records))
+    metrics_lib.counter('data/examples_parsed').inc(len(records))
     feats, labels = SpecStruct(), SpecStruct()
     for out_key, _, spec in named:
       value = parsed[out_key]
       if isinstance(value, list):  # bytes feature
         if getattr(spec, 'is_encoded_image', False):
-          batch = _native_jpeg_batch(value, spec, decode_workers,
-                                     key=out_key[2:])
-          if batch is None:
-            batch = np.stack(decode_all(value, spec, out_key[2:]))
+          # Image decode dominates host cost on vision workloads —
+          # data/decode_ms is the first histogram to read when the
+          # trainer breakdown says a run is input-bound.
+          with tracing.span('data/decode'):
+            batch = _native_jpeg_batch(value, spec, decode_workers,
+                                       key=out_key[2:])
+            if batch is None:
+              batch = np.stack(decode_all(value, spec, out_key[2:]))
           value = batch
           if len(spec.shape) > 3:  # singleton leading image dims
             value = value.reshape(value.shape[:1] + tuple(spec.shape))

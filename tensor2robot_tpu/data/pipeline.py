@@ -17,6 +17,8 @@ import numpy as np
 
 from tensor2robot_tpu import modes
 from tensor2robot_tpu.data import example_codec, records
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.specs import SpecStruct
 
 
@@ -261,7 +263,15 @@ def as_numpy_iterator(dataset, has_labels: bool = True) -> Iterator:
   (``numpy_batches`` callers rely on it); input generators use
   :func:`pack_numpy_element` for the trainer's Batch shape instead.
   """
-  for element in dataset.as_numpy_iterator():
+  batches = metrics_lib.counter('data/tf_batches')
+  it = iter(dataset.as_numpy_iterator())
+  while True:
+    with tracing.span('data/tf_next', annotate=False):
+      try:
+        element = next(it)
+      except StopIteration:
+        return
+    batches.inc()
     if has_labels:
       yield pack_numpy_element(element, has_labels=True)
     else:
@@ -301,9 +311,14 @@ class CheckpointableNumpyIterator:
     return self
 
   def __next__(self):
-    with self._lock:
-      element = next(self._iterator)
-    element = _tf().nest.map_structure(lambda t: t.numpy(), element)
+    # data/tf_next_ms: host time to surface one parsed batch from the
+    # tf.data pipeline (parse/decode runs inside tf.data's own threads;
+    # this measures what the TRAIN LOOP pays — the input-bound signal).
+    with tracing.span('data/tf_next', annotate=False):
+      with self._lock:
+        element = next(self._iterator)
+      element = _tf().nest.map_structure(lambda t: t.numpy(), element)
+    metrics_lib.counter('data/tf_batches').inc()
     return pack_numpy_element(element, has_labels=self._has_labels)
 
   def save(self, path_prefix: str) -> str:

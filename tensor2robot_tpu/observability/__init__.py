@@ -1,0 +1,27 @@
+"""Unified telemetry: metrics registry, host-side tracing, breakdowns.
+
+* :mod:`~tensor2robot_tpu.observability.metrics` — process-global,
+  thread-safe, dependency-free counters/gauges/histograms with
+  ``snapshot()``/``delta()`` and an end-of-run ``report()`` JSON dump.
+* :mod:`~tensor2robot_tpu.observability.tracing` — ``with span(...)``
+  host spans that accumulate into the registry, export Chrome-trace
+  JSON, and wrap ``jax.profiler.TraceAnnotation`` so host and XLA
+  timelines line up.
+
+The trainer's per-dispatch step-time breakdown (host wait / H2D
+placement / device step / callbacks, ``examples_per_sec``,
+``input_bound_fraction``, goodput) is built on these — see
+``train/trainer.py`` and the README "Observability" section.
+"""
+
+from tensor2robot_tpu.observability import metrics, tracing
+from tensor2robot_tpu.observability.metrics import (Counter, Gauge,
+                                                    Histogram, Registry)
+from tensor2robot_tpu.observability.tracing import (capture,
+                                                    dump_chrome_trace, span,
+                                                    step_annotation)
+
+__all__ = [
+    'metrics', 'tracing', 'Counter', 'Gauge', 'Histogram', 'Registry',
+    'capture', 'dump_chrome_trace', 'span', 'step_annotation',
+]

@@ -1,0 +1,342 @@
+"""Process-global, thread-safe, dependency-free metrics registry.
+
+The counting half of the observability subsystem (``tracing.py`` is the
+timeline half): every layer of the framework — data readers, the device
+prefetcher, the trainer hot loop, checkpointing, the resilience policies
+— accumulates counters, gauges, and histograms here, and any consumer
+(the trainer's scalar merge, ``ResilienceLoggerCallback``, ``bench.py``,
+``metrics.report()`` at end of run) reads one coherent snapshot. The
+reference delegated all of this to TF summaries/TensorBoard (SURVEY §5);
+this registry is the TF-free equivalent that also works in the serving
+host and the native data path, where TensorFlow never loads.
+
+Design constraints, in order:
+
+* **No dependencies.** Pure stdlib — the robot/serving host story
+  (README "Serving contract") must not grow a jax/TF import for
+  counting. ``tracing.py`` holds everything that touches jax.
+* **Cheap enough for hot paths.** One uncontended lock acquire per
+  update (~100 ns); per-RECORD paths batch locally and flush via
+  ``Counter.inc(n)`` (see ``data/native_io.py``) so reader throughput
+  is unaffected.
+* **Process-global.** Like a Prometheus client registry: the data
+  layer's reader threads, the prefetch worker, and the train loop all
+  hit the same instance without plumbing. Per-RUN reporting is done by
+  consumers via :func:`snapshot` at run start and :func:`delta` later —
+  the registry itself never resets mid-process (except in tests).
+
+Naming: flat slash-scoped strings (``'data/records_read'``,
+``'trainer/step_wall_ms'``). :func:`scope` returns a view that prefixes
+a path segment, so a subsystem can write ``scope('data').counter(
+'records_read')`` and compose.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'Registry', 'Scope', 'counter',
+    'gauge', 'histogram', 'scope', 'snapshot', 'delta', 'report',
+    'dump_report', 'reset', 'registry',
+]
+
+
+class Counter:
+  """Monotonically increasing integer count."""
+
+  kind = 'counter'
+
+  def __init__(self, name: str):
+    self.name = name
+    self._lock = threading.Lock()
+    self._value = 0
+
+  def inc(self, n: int = 1) -> None:
+    with self._lock:
+      self._value += n
+
+  @property
+  def value(self) -> int:
+    with self._lock:
+      return self._value
+
+  def snapshot(self):
+    return self.value
+
+
+class Gauge:
+  """Last-written float value (queue depth, fraction, config knob)."""
+
+  kind = 'gauge'
+
+  def __init__(self, name: str):
+    self.name = name
+    self._lock = threading.Lock()
+    self._value = 0.0
+
+  def set(self, value: float) -> None:
+    with self._lock:
+      self._value = float(value)
+
+  def add(self, value: float) -> None:
+    with self._lock:
+      self._value += float(value)
+
+  @property
+  def value(self) -> float:
+    with self._lock:
+      return self._value
+
+  def snapshot(self):
+    return self.value
+
+
+class Histogram:
+  """Streaming distribution: exact count/sum/min/max, approx percentiles.
+
+  Percentiles come from power-of-two buckets (``math.frexp`` exponent →
+  bucket), so ``observe`` is O(1) with no allocation and the p50/p90/p99
+  estimates are upper bucket edges — within 2× of truth at any scale,
+  which is the resolution that matters for "where did the time go"
+  questions (a 2× bucket cannot hide an order-of-magnitude regression).
+  """
+
+  kind = 'histogram'
+
+  def __init__(self, name: str):
+    self.name = name
+    self._lock = threading.Lock()
+    self._count = 0
+    self._sum = 0.0
+    self._min = math.inf
+    self._max = -math.inf
+    self._buckets: Dict[int, int] = {}
+
+  def observe(self, value: float) -> None:
+    value = float(value)
+    with self._lock:
+      self._count += 1
+      self._sum += value
+      if value < self._min:
+        self._min = value
+      if value > self._max:
+        self._max = value
+      # frexp(v) = (m, e) with v = m * 2**e, 0.5 <= |m| < 1; bucket e
+      # covers (2**(e-1), 2**e]. Zero and negatives share bucket -inf→0.
+      e = math.frexp(value)[1] if value > 0.0 else -1075
+      self._buckets[e] = self._buckets.get(e, 0) + 1
+
+  def _percentile_locked(self, fraction: float) -> float:
+    if self._count == 0:
+      return 0.0
+    target = fraction * self._count
+    seen = 0
+    for e in sorted(self._buckets):
+      seen += self._buckets[e]
+      if seen >= target:
+        upper = 0.0 if e == -1075 else math.ldexp(1.0, e)
+        # Clamp the bucket edge into the observed range so tiny samples
+        # don't report a p99 beyond the true max.
+        return min(max(upper, self._min), self._max)
+    return self._max
+
+  @property
+  def count(self) -> int:
+    with self._lock:
+      return self._count
+
+  @property
+  def mean(self) -> float:
+    with self._lock:
+      return self._sum / self._count if self._count else 0.0
+
+  def snapshot(self):
+    with self._lock:
+      if self._count == 0:
+        return {'count': 0, 'sum': 0.0, 'min': 0.0, 'max': 0.0,
+                'mean': 0.0, 'p50': 0.0, 'p90': 0.0, 'p99': 0.0}
+      return {
+          'count': self._count,
+          'sum': self._sum,
+          'min': self._min,
+          'max': self._max,
+          'mean': self._sum / self._count,
+          'p50': self._percentile_locked(0.50),
+          'p90': self._percentile_locked(0.90),
+          'p99': self._percentile_locked(0.99),
+      }
+
+
+class Registry:
+  """Name → metric map with typed create-or-get accessors.
+
+  Creation takes the registry lock; updates take only the metric's own
+  lock. Asking for an existing name with a different type raises — a
+  name collision across subsystems is a bug worth failing loudly on.
+  """
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._metrics: Dict[str, object] = {}
+    self._start_time = time.time()
+
+  def _get(self, name: str, cls):
+    with self._lock:
+      metric = self._metrics.get(name)
+      if metric is None:
+        metric = cls(name)
+        self._metrics[name] = metric
+      elif not isinstance(metric, cls):
+        raise TypeError(
+            f'metric {name!r} already registered as '
+            f'{type(metric).__name__}, requested {cls.__name__}')
+      return metric
+
+  def counter(self, name: str) -> Counter:
+    return self._get(name, Counter)
+
+  def gauge(self, name: str) -> Gauge:
+    return self._get(name, Gauge)
+
+  def histogram(self, name: str) -> Histogram:
+    return self._get(name, Histogram)
+
+  def scope(self, prefix: str) -> 'Scope':
+    return Scope(self, prefix)
+
+  def names(self, prefix: str = '') -> List[str]:
+    with self._lock:
+      return sorted(n for n in self._metrics if n.startswith(prefix))
+
+  def snapshot(self, prefix: str = '') -> Dict[str, object]:
+    """Point-in-time copy: counters → int, gauges → float, histograms →
+    stats dict. Safe to hold across later updates."""
+    with self._lock:
+      metrics = [(n, m) for n, m in self._metrics.items()
+                 if n.startswith(prefix)]
+    return {name: metric.snapshot() for name, metric in sorted(metrics)}
+
+  def delta(self, previous: Dict[str, object],
+            prefix: str = '') -> Dict[str, object]:
+    """Change since ``previous`` (an earlier :meth:`snapshot`).
+
+    Counters and histogram count/sum difference (mean recomputed over
+    the window); gauges report their CURRENT value (a gauge has no
+    meaningful difference). Metrics born after ``previous`` diff
+    against zero. min/max/percentiles are lifetime values — the bucket
+    scheme cannot subtract them — so windowed consumers should lean on
+    count/sum/mean.
+    """
+    current = self.snapshot(prefix)
+    out: Dict[str, object] = {}
+    for name, value in current.items():
+      prev = previous.get(name)
+      if isinstance(value, dict):  # histogram
+        pcount = prev.get('count', 0) if isinstance(prev, dict) else 0
+        psum = prev.get('sum', 0.0) if isinstance(prev, dict) else 0.0
+        dcount = value['count'] - pcount
+        dsum = value['sum'] - psum
+        out[name] = {'count': dcount, 'sum': dsum,
+                     'mean': dsum / dcount if dcount else 0.0}
+      elif isinstance(value, int):  # counter
+        out[name] = value - (prev if isinstance(prev, int) else 0)
+      else:  # gauge
+        out[name] = value
+    return out
+
+  def report(self) -> Dict[str, object]:
+    """End-of-run JSON-ready dump: all metrics + process metadata."""
+    return {
+        'kind': 'metrics_report',
+        'pid': os.getpid(),
+        'uptime_sec': round(time.time() - self._start_time, 3),
+        'metrics': self.snapshot(),
+    }
+
+  def dump_report(self, path: str) -> str:
+    """Writes :meth:`report` as JSON to ``path`` (dirs created)."""
+    dirname = os.path.dirname(path)
+    if dirname:
+      os.makedirs(dirname, exist_ok=True)
+    with open(path, 'w') as f:
+      json.dump(self.report(), f, indent=2, sort_keys=True)
+      f.write('\n')
+    return path
+
+  def reset(self) -> None:
+    """Drops every metric. Tests only — live code holds metric handles
+    that a reset silently orphans."""
+    with self._lock:
+      self._metrics.clear()
+      self._start_time = time.time()
+
+
+class Scope:
+  """A prefixing view of a registry (``scope('data').counter('x')`` →
+  ``'data/x'``). Composable via :meth:`scope`."""
+
+  def __init__(self, registry: Registry, prefix: str):
+    self._registry = registry
+    self._prefix = prefix.rstrip('/') + '/'
+
+  def counter(self, name: str) -> Counter:
+    return self._registry.counter(self._prefix + name)
+
+  def gauge(self, name: str) -> Gauge:
+    return self._registry.gauge(self._prefix + name)
+
+  def histogram(self, name: str) -> Histogram:
+    return self._registry.histogram(self._prefix + name)
+
+  def scope(self, prefix: str) -> 'Scope':
+    return Scope(self._registry, self._prefix + prefix)
+
+  def snapshot(self) -> Dict[str, object]:
+    return self._registry.snapshot(self._prefix)
+
+
+# The process-global instance (Prometheus-default-registry style); the
+# module-level functions below are the canonical call sites.
+registry = Registry()
+
+
+def counter(name: str) -> Counter:
+  return registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+  return registry.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+  return registry.histogram(name)
+
+
+def scope(prefix: str) -> Scope:
+  return registry.scope(prefix)
+
+
+def snapshot(prefix: str = '') -> Dict[str, object]:
+  return registry.snapshot(prefix)
+
+
+def delta(previous: Dict[str, object], prefix: str = '') -> Dict[str, object]:
+  return registry.delta(previous, prefix)
+
+
+def report() -> Dict[str, object]:
+  return registry.report()
+
+
+def dump_report(path: str) -> str:
+  return registry.dump_report(path)
+
+
+def reset() -> None:
+  registry.reset()

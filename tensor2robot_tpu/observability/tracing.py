@@ -1,0 +1,219 @@
+"""Host-side span tracing that lines up with XLA device traces.
+
+``with span('data/decode'):`` does three things at once:
+
+1. accumulates the span's wall time into the metrics registry
+   (histogram ``'<name>_ms'``), so per-scope totals are queryable
+   without any trace viewer;
+2. when a capture is active (:func:`start_capture` /
+   :func:`capture`), appends a Chrome-trace ``X`` (complete) event to a
+   bounded in-memory buffer, exportable with :func:`dump_chrome_trace`
+   and viewable in ``chrome://tracing`` / Perfetto — or summarized by
+   ``tools/trace_summary.py``;
+3. enters a ``jax.profiler.TraceAnnotation`` so that when a
+   ``jax.profiler`` trace is running, the host span appears on the host
+   threads of the SAME xplane timeline as the XLA device ops — host
+   wait-for-batch and device step line up in one view.
+
+(1) is always on and costs two ``perf_counter`` calls plus one lock'd
+histogram update (~1 µs); (2) and (3) are no-ops unless their capture
+is active. jax itself is imported lazily so the metrics/tracing pair
+stays importable on hosts without jax (the serving-host contract);
+everything degrades gracefully to host-only timing.
+
+Spans nest lexically (the Chrome trace nests ``X`` events per thread by
+ts/dur containment). :func:`step_annotation` wraps
+``jax.profiler.StepTraceAnnotation`` so trainer dispatches carry step
+markers in captured traces (TensorBoard's step-time view keys off
+them).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from tensor2robot_tpu.observability import metrics
+
+__all__ = [
+    'span', 'step_annotation', 'start_capture', 'stop_capture', 'capture',
+    'capturing', 'chrome_trace', 'dump_chrome_trace',
+]
+
+# perf_counter epoch for event timestamps: Chrome trace wants µs from an
+# arbitrary-but-consistent origin.
+_EPOCH = time.perf_counter()
+
+_lock = threading.Lock()
+_events: Optional[List[dict]] = None  # None = capture off
+_events_cap = 0
+_dropped = 0
+
+
+_ANNOTATION_CLS = None  # lazily resolved; False = unavailable
+
+
+def _annotation_class():
+  """``jax.profiler.TraceAnnotation`` once jax is ALREADY loaded, else
+  None — tracing must never be the thing that imports jax on a
+  jax-less serving host."""
+  global _ANNOTATION_CLS
+  if _ANNOTATION_CLS is None:
+    import sys
+
+    if 'jax' not in sys.modules:
+      return None  # don't cache: jax may load later in the process
+    try:
+      import jax
+
+      _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+    except Exception:  # pylint: disable=broad-except
+      _ANNOTATION_CLS = False
+  return _ANNOTATION_CLS or None
+
+
+class span:  # noqa: N801 - context manager used as a function
+  """Times a host-side region under ``name`` (slash-scoped).
+
+  A slotted class rather than a ``@contextmanager`` generator: this
+  sits in the trainer's per-dispatch hot path, and the generator
+  protocol alone costs ~3 µs per use (measured) — the class form runs
+  in ~1 µs, keeping full instrumentation inside the hot loop's <2%
+  overhead budget.
+
+  ``annotate=False`` skips the jax TraceAnnotation — for regions inside
+  tight per-record loops where even a no-op TraceMe is measurable; the
+  registry histogram and capture buffer still record.
+  """
+
+  __slots__ = ('_name', '_annotate', '_ann', '_t0')
+
+  def __init__(self, name: str, annotate: bool = True):
+    self._name = name
+    self._annotate = annotate
+    self._ann = None
+    self._t0 = 0.0
+
+  def __enter__(self) -> 'span':
+    if self._annotate:
+      # The annotation is a TraceMe no-op (~100 ns) outside an active
+      # jax profiler session; we cannot cheaply query session state, so
+      # err on 'annotate' whenever jax is loaded.
+      cls = _annotation_class()
+      if cls is not None:
+        self._ann = cls(self._name)
+        self._ann.__enter__()
+    self._t0 = time.perf_counter()
+    return self
+
+  def __exit__(self, *exc) -> bool:
+    t1 = time.perf_counter()
+    if self._ann is not None:
+      self._ann.__exit__(None, None, None)
+      self._ann = None
+    metrics.histogram(self._name + '_ms').observe((t1 - self._t0) * 1e3)
+    if _events is not None:
+      _record_event(self._name, self._t0, t1)
+    return False
+
+
+def _record_event(name: str, t0: float, t1: float) -> None:
+  global _dropped
+  event = {
+      'name': name,
+      'ph': 'X',
+      'ts': (t0 - _EPOCH) * 1e6,
+      'dur': (t1 - t0) * 1e6,
+      'pid': os.getpid(),
+      'tid': threading.get_ident(),
+  }
+  with _lock:
+    if _events is None:
+      return
+    if len(_events) >= _events_cap:
+      _dropped += 1
+      return
+    _events.append(event)
+
+
+def start_capture(max_events: int = 200_000) -> None:
+  """Begins buffering span events (bounded; overflow counts as dropped)."""
+  global _events, _events_cap, _dropped
+  with _lock:
+    _events = []
+    _events_cap = int(max_events)
+    _dropped = 0
+
+
+def stop_capture() -> List[dict]:
+  """Stops buffering and returns the captured events."""
+  global _events
+  with _lock:
+    events = _events or []
+    _events = None
+  return events
+
+
+def capturing() -> bool:
+  return _events is not None
+
+
+@contextlib.contextmanager
+def capture(max_events: int = 200_000) -> Iterator[List[dict]]:
+  """``with capture() as events:`` — events is filled on exit."""
+  start_capture(max_events)
+  events: List[dict] = []
+  try:
+    yield events
+  finally:
+    events.extend(stop_capture())
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> Dict[str, object]:
+  """Wraps events as a Chrome-trace JSON object (Perfetto-loadable)."""
+  if events is None:
+    with _lock:
+      events = list(_events) if _events is not None else []
+  return {
+      'traceEvents': events,
+      'displayTimeUnit': 'ms',
+      'metadata': {
+          'producer': 'tensor2robot_tpu.observability.tracing',
+          'dropped_events': _dropped,
+      },
+  }
+
+
+def dump_chrome_trace(path: str,
+                      events: Optional[List[dict]] = None) -> str:
+  """Writes a Chrome-trace JSON (``.gz`` suffix → gzipped) to ``path``."""
+  trace = chrome_trace(events)
+  dirname = os.path.dirname(path)
+  if dirname:
+    os.makedirs(dirname, exist_ok=True)
+  if path.endswith('.gz'):
+    with gzip.open(path, 'wt') as f:
+      json.dump(trace, f)
+  else:
+    with open(path, 'w') as f:
+      json.dump(trace, f)
+  return path
+
+
+def step_annotation(step: int, name: str = 'train'):
+  """A ``jax.profiler.StepTraceAnnotation`` context for one dispatch.
+
+  Captured traces then carry per-step markers (TensorBoard's step-time
+  breakdown keys off them). Falls back to a null context without jax.
+  """
+  try:
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=int(step))
+  except Exception:  # pylint: disable=broad-except
+    return contextlib.nullcontext()

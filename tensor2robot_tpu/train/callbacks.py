@@ -15,6 +15,8 @@ from typing import Optional
 import jax
 import numpy as np
 
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.train.trainer import TrainerCallback
 
 
@@ -74,16 +76,29 @@ class ResilienceLoggerCallback(TrainerCallback):
   """Surfaces fault-tolerance counters in the normal log stream.
 
   At each crossed log interval, reports the non-finite guard's skipped-
-  update totals (``train/resilience.py``) and any batch error budget the
-  train iterator carries (``utils/retry.ResilientIterator``), so a run
-  quietly absorbing faults is VISIBLY absorbing them — silent resilience
-  ages into silent data loss.
+  update totals and the data error budget charges absorbed so far, so a
+  run quietly absorbing faults is VISIBLY absorbing them — silent
+  resilience ages into silent data loss. Reads the observability
+  registry (``resilience/*`` deltas against its ``begin()`` snapshot)
+  rather than poking trainer or iterator internals: every layer's
+  budget — reader-level, batch-level, per SOURCE file — flows through
+  the same counters, whichever object absorbed the fault.
   """
 
   def __init__(self, log_interval_steps: Optional[int] = None,
                iterator=None):
     self._log_interval_steps = log_interval_steps
+    # Legacy parameter: budgets now reach this callback through the
+    # registry, so the iterator handle is only kept as a fallback for
+    # budget metadata (max_errors) in the absorbed-errors line.
     self._iterator = iterator
+    self._start = {}
+
+  def begin(self, trainer) -> None:
+    self._start = metrics_lib.snapshot('resilience/')
+
+  def _deltas(self):
+    return metrics_lib.delta(self._start, 'resilience/')
 
   def after_step(self, trainer, step: int, scalars) -> None:
     interval = (self._log_interval_steps
@@ -91,24 +106,32 @@ class ResilienceLoggerCallback(TrainerCallback):
                 else trainer.config.log_interval_steps)
     if not trainer.crossed(interval, step):
       return
-    policy = trainer.nonfinite_policy
-    if policy is not None and policy.bad_steps:
+    deltas = self._deltas()
+    skipped = deltas.get('resilience/nonfinite_skipped_steps', 0)
+    if skipped:
+      policy = trainer.nonfinite_policy
       logging.info(
           'resilience: %d non-finite update(s) skipped so far '
-          '(%d consecutive bad dispatch(es), mode=%s).',
-          policy.bad_steps, policy.consecutive_bad, policy.mode)
-    budget = getattr(self._iterator, 'budget', None)
-    if budget is not None and budget.errors:
-      logging.info(
-          'resilience: %s absorbed %d/%d error(s); last: %r.',
-          budget.name, budget.errors, budget.max_errors, budget.last_error)
+          '(%d consecutive bad dispatch(es)%s).', skipped,
+          int(deltas.get('resilience/consecutive_bad_dispatches', 0)),
+          f', mode={policy.mode}' if policy is not None else '')
+    errors = deltas.get('resilience/data_errors', 0)
+    if errors:
+      by_source = ', '.join(
+          f'{name[len("resilience/data_errors/"):]}: {count}'
+          for name, count in sorted(deltas.items())
+          if name.startswith('resilience/data_errors/') and count)
+      budget = getattr(self._iterator, 'budget', None)
+      limit = (f'/{budget.max_errors}' if budget is not None else '')
+      logging.info('resilience: %d%s data error(s) absorbed (%s).',
+                   errors, limit, by_source or 'unattributed')
 
   def end(self, trainer) -> None:
-    policy = trainer.nonfinite_policy
-    if policy is not None and policy.bad_steps:
+    skipped = self._deltas().get('resilience/nonfinite_skipped_steps', 0)
+    if skipped:
       logging.warning(
           'resilience: run finished with %d non-finite update(s) skipped.',
-          policy.bad_steps)
+          skipped)
 
 
 class ProfilerCallback(TrainerCallback):
@@ -127,6 +150,12 @@ class ProfilerCallback(TrainerCallback):
     self._logdir = logdir
     self._active = False
     self._done = False
+    self._step_annotation = None
+
+  def _close_step_annotation(self) -> None:
+    if self._step_annotation is not None:
+      self._step_annotation.__exit__(None, None, None)
+      self._step_annotation = None
 
   def after_step(self, trainer, step: int, scalars) -> None:
     # >= not ==: with steps_per_dispatch > 1 the loop reports only
@@ -149,11 +178,22 @@ class ProfilerCallback(TrainerCallback):
       jax.profiler.start_trace(logdir)
       self._active = True
     elif step >= self._stop_step and self._active:
+      self._close_step_annotation()
       jax.profiler.stop_trace()
       self._active = False
       self._done = True
+    if self._active:
+      # Step markers: while the trace runs, bracket everything from this
+      # dispatch boundary to the next (host feed + the next dispatch)
+      # in a StepTraceAnnotation, so captured traces carry per-step
+      # boundaries and TensorBoard/Perfetto can compute a step-time
+      # breakdown instead of one undifferentiated span.
+      self._close_step_annotation()
+      self._step_annotation = tracing.step_annotation(step)
+      self._step_annotation.__enter__()
 
   def end(self, trainer) -> None:
+    self._close_step_annotation()
     if self._active:
       jax.profiler.stop_trace()
       self._active = False

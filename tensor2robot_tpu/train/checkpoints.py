@@ -22,6 +22,9 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
+
 
 class CheckpointManager:
   """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
@@ -57,8 +60,15 @@ class CheckpointManager:
     step = int(step)
     if step in self._manager.all_steps():
       return False  # already saved (e.g. final forced save after an in-loop one)
-    return self._manager.save(
-        step, args=ocp.args.StandardSave(state), force=force)
+    # checkpoint/save_ms is what the TRAIN LOOP pays (with async_save it
+    # covers only the blocking D2H copy; the disk write happens in the
+    # background and is accounted by checkpoint/wait_ms at barriers).
+    with tracing.span('checkpoint/save'):
+      saved = self._manager.save(
+          step, args=ocp.args.StandardSave(state), force=force)
+    if saved:
+      metrics_lib.counter('checkpoint/saves').inc()
+    return saved
 
   def restore(self, state, step: Optional[int] = None,
               fallback_to_older: bool = True):
@@ -72,8 +82,11 @@ class CheckpointManager:
     restores exactly that step or raises.
     """
     if step is not None:
-      return self._manager.restore(
-          int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+      with tracing.span('checkpoint/restore'):
+        restored = self._manager.restore(
+            int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+      metrics_lib.counter('checkpoint/restores').inc()
+      return restored
     steps = sorted(self._manager.all_steps(), reverse=True)
     if not steps:
       return None
@@ -81,9 +94,12 @@ class CheckpointManager:
     last_exc: Optional[BaseException] = None
     for i, s in enumerate(steps):
       try:
-        restored = self._manager.restore(
-            int(s), args=ocp.args.StandardRestore(target))
+        with tracing.span('checkpoint/restore'):
+          restored = self._manager.restore(
+              int(s), args=ocp.args.StandardRestore(target))
+        metrics_lib.counter('checkpoint/restores').inc()
         if i > 0:
+          metrics_lib.counter('checkpoint/restore_fallbacks').inc(i)
           logging.warning(
               'Restored checkpoint step %d after %d newer step(s) failed '
               'to load (latest was likely truncated by a preemption).', s, i)
@@ -106,7 +122,9 @@ class CheckpointManager:
     return sorted(self._manager.all_steps())
 
   def wait_until_finished(self) -> None:
-    self._manager.wait_until_finished()
+    # Time the train loop spends barriered on in-flight async writes.
+    with tracing.span('checkpoint/wait'):
+      self._manager.wait_until_finished()
 
   def close(self) -> None:
     self._manager.close()

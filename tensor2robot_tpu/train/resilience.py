@@ -32,6 +32,8 @@ import signal
 import threading
 from typing import Optional, Tuple
 
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
 # The distinct, resumable exit status the trainer binary uses for
 # preemption: schedulers/wrappers restart the job, and the restarted run
 # restores the forced checkpoint + input state.
@@ -158,6 +160,14 @@ class NonFinitePolicy:
     self.halt_after = int(halt_after)
     self.bad_steps = 0        # total non-finite steps skipped on device
     self.consecutive_bad = 0  # consecutive dispatches containing any
+    # Registry mirror (observability/): the trainer merges these into
+    # the train scalars at log intervals and ResilienceLoggerCallback
+    # reads them — created here (even if never incremented) so the
+    # series exists from step one whenever the guard is on.
+    self._m_bad_steps = metrics_lib.counter(
+        'resilience/nonfinite_skipped_steps')
+    self._m_consecutive = metrics_lib.gauge(
+        'resilience/consecutive_bad_dispatches')
 
   @property
   def enabled(self) -> bool:
@@ -170,9 +180,14 @@ class NonFinitePolicy:
     count = int(nonfinite_count)
     if count == 0:
       self.consecutive_bad = 0
+      self._m_consecutive.set(0)
       return
     self.bad_steps += count
     self.consecutive_bad += 1
+    # Mirror to the registry BEFORE any raise below: a halting run's
+    # final scalars/report must carry the full skip accounting.
+    self._m_bad_steps.inc(count)
+    self._m_consecutive.set(self.consecutive_bad)
     if self.mode == 'raise':
       raise NonFiniteError(
           f'non-finite loss/grads at dispatch ending step {step} '

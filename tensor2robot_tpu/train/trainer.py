@@ -34,6 +34,8 @@ import numpy as np
 import optax
 
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct, algebra
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
@@ -144,6 +146,19 @@ class TrainerConfig:
   # first boundary ON OR AFTER each multiple, exactly like
   # iterations_per_loop; callbacks see only boundary steps.
   steps_per_dispatch: int = 1
+  # Per-dispatch step-time breakdown (observability/): decomposes each
+  # dispatch's wall time into host wait-for-batch, H2D placement,
+  # dispatch/enqueue, device step, and callback overhead, and merges
+  # examples_per_sec / input_bound_fraction / goodput into the scalars
+  # dict at log intervals (so MetricsLogger/TensorBoard publish them
+  # with zero API change). The device-step measurement blocks on the
+  # PREVIOUS dispatch's outputs only after enqueueing the current one —
+  # one dispatch behind, so the device pipeline never drains and no
+  # sync is added to the in-flight dispatch (host run-ahead caps at one
+  # dispatch, which the bounded prefetch queue effectively imposed
+  # already). Costs a handful of perf_counter reads + registry updates
+  # per dispatch; False restores the uninstrumented loop exactly.
+  step_breakdown: bool = True
 
   def resolved_auto_input_layouts(self) -> bool:
     if jax.process_count() > 1:
@@ -187,6 +202,15 @@ class _DevicePrefetcher:
     self._q: 'queue.Queue' = queue.Queue(maxsize=depth)
     self._err: Optional[BaseException] = None
     self._stop = threading.Event()
+    # Queue telemetry: a depth gauge pinned near 0 plus a climbing
+    # starvation counter is the registry's signature of an input-bound
+    # run (the breakdown's host_wait_ms says the same from the loop
+    # side); starved_wait_ms is how long each starvation stalled.
+    prefetch_metrics = metrics_lib.scope('trainer/prefetch')
+    self._m_depth = prefetch_metrics.gauge('queue_depth')
+    self._m_starved = prefetch_metrics.counter('starvation')
+    self._m_starve_ms = prefetch_metrics.histogram('starved_wait_ms')
+    self._m_batches = prefetch_metrics.counter('batches')
     place_in_worker = jax.default_backend() == 'tpu'
     self._consumer_place = None if place_in_worker else place
 
@@ -209,18 +233,29 @@ class _DevicePrefetcher:
     return self
 
   def __next__(self) -> 'PlacedBatch':
+    import queue
+
     if self._err is not None:
       # Deliver worker failures PROMPTLY: staged batches behind the
       # sentinel are not drained first — a dead pipeline must not feed
       # up to `depth` more steps before the loop learns about it.
       raise self._err
-    item = self._q.get()
+    try:
+      item = self._q.get_nowait()
+    except queue.Empty:
+      # Starvation: the consumer outran the staging worker.
+      self._m_starved.inc()
+      t0 = time.perf_counter()
+      item = self._q.get()
+      self._m_starve_ms.observe((time.perf_counter() - t0) * 1e3)
+    self._m_depth.set(self._q.qsize())
     if item is self._DONE:
       if self._err is not None:
         raise self._err
       raise StopIteration
     if self._consumer_place is not None:
       item = self._consumer_place(item)
+    self._m_batches.inc()
     return item
 
   def close(self, timeout: float = 10.0) -> None:
@@ -325,6 +360,144 @@ def _mean_metrics(metric_batches: List[MetricDict]) -> MetricDict:
   return {
       k: float(np.mean([float(m[k]) for m in metric_batches])) for k in keys
   }
+
+
+class _DispatchBreakdown:
+  """Per-dispatch wall-time decomposition for the train loop.
+
+  A *boundary* is the instant right after a dispatch's one-behind
+  device block. ``wall(i) = boundary(i) - boundary(i-1)`` then
+  decomposes EXACTLY (no untracked residue — every interval between
+  the five timestamps is attributed):
+
+    callback_ms   boundary(i-1) → start of wait: callbacks, logging,
+                  checkpoint saves, interleaved eval — everything the
+                  host does between dispatches besides feeding.
+    host_wait_ms  blocked in ``next(batches)`` (minus consumer-thread
+                  placement, carved out below) — input-bound time.
+    placement_ms  ``shard_batch`` H2D placement on the LOOP thread
+                  (worker-thread placement overlaps the device step and
+                  is recorded separately as placement_overlapped_ms).
+    dispatch_ms   the async ``step_fn`` enqueue call.
+    device_step_ms  blocked on the PREVIOUS dispatch's outputs after
+                  enqueueing this one: the device compute not hidden by
+                  host work. Compute-bound runs see the true step time
+                  here; input-bound runs see ~0 — which is the answer.
+
+  The first dispatch is excluded from windows (it pays jit compile).
+  ``window_scalars`` drains the accumulation into the scalar dict the
+  existing logging callbacks already publish.
+  """
+
+  _WINDOW_KEYS = ('callback', 'wait', 'place', 'dispatch', 'device')
+
+  def __init__(self, enabled: bool):
+    self.enabled = enabled
+    # Written by place() when it runs on the loop thread; drained by
+    # record(). A plain list cell: single producer+consumer (the loop).
+    self.place_ms = [0.0]
+    self._boundary: Optional[float] = None
+    self._dispatches = metrics_lib.counter('trainer/dispatches')
+    self._steps = metrics_lib.counter('trainer/steps')
+    self._examples = metrics_lib.counter('trainer/examples')
+    self._wall_hist = metrics_lib.histogram('trainer/step_wall_ms')
+    self._place_hist = metrics_lib.histogram('trainer/placement_ms')
+    self._callback_hist = metrics_lib.histogram('trainer/callback_ms')
+    self._skipped_counter = metrics_lib.counter(
+        'resilience/nonfinite_skipped_steps')
+    self._reset_window()
+
+  def _reset_window(self) -> None:
+    self._win = {k: 0.0 for k in self._WINDOW_KEYS}
+    self._win_wall = 0.0
+    self._win_dispatches = 0
+    self._win_steps = 0
+    self._win_examples = 0
+    self._win_skipped0 = self._skipped_counter.value
+
+  def record(self, t_wait0: float, t_wait1: float, t_disp: float,
+             t_boundary: float, steps: int, examples: int) -> None:
+    """Closes one dispatch given its four loop timestamps: start-of-wait,
+    batch-in-hand, dispatch-enqueued, after-device-block."""
+    self._dispatches.inc()
+    self._steps.inc(steps)
+    self._examples.inc(examples)
+    place_ms, self.place_ms[0] = self.place_ms[0], 0.0
+    prev_boundary, self._boundary = self._boundary, t_boundary
+    if not self.enabled:
+      return  # counters only: without the device block the timestamps
+              # measure dispatch enqueues, not where the time went
+    self._place_hist.observe(place_ms)
+    if prev_boundary is None:
+      return  # first dispatch: jit compile dominates; not a steady-state sample
+    callback_ms = (t_wait0 - prev_boundary) * 1e3
+    wall_ms = (t_boundary - prev_boundary) * 1e3
+    self._callback_hist.observe(callback_ms)
+    self._wall_hist.observe(wall_ms)
+    self._win['callback'] += callback_ms
+    self._win['wait'] += max(0.0, (t_wait1 - t_wait0) * 1e3 - place_ms)
+    self._win['place'] += place_ms
+    self._win['dispatch'] += (t_disp - t_wait1) * 1e3
+    self._win['device'] += (t_boundary - t_disp) * 1e3
+    self._win_wall += wall_ms
+    self._win_dispatches += 1
+    self._win_steps += steps
+    self._win_examples += examples
+
+  def window_scalars(self) -> MetricDict:
+    """Drains the current log window into publishable scalars.
+
+    ``goodput_examples_per_sec`` discounts examples whose updates the
+    non-finite guard skipped on device — throughput that moved bytes
+    but trained nothing.
+    """
+    if not self.enabled or self._win_dispatches == 0:
+      return {}
+    n = self._win_dispatches
+    wall_ms = self._win_wall
+    wall_s = wall_ms / 1e3
+    skipped = self._skipped_counter.value - self._win_skipped0
+    eps = self._win_examples / wall_s if wall_s > 0 else 0.0
+    out = {
+        'examples_per_sec': eps,
+        'input_bound_fraction':
+            (self._win['wait'] + self._win['place']) / wall_ms
+            if wall_ms > 0 else 0.0,
+        'goodput_examples_per_sec':
+            eps * max(0.0, 1.0 - skipped / max(1, self._win_steps)),
+        'breakdown/wall_ms': wall_ms / n,
+        'breakdown/host_wait_ms': self._win['wait'] / n,
+        'breakdown/placement_ms': self._win['place'] / n,
+        'breakdown/dispatch_ms': self._win['dispatch'] / n,
+        'breakdown/device_step_ms': self._win['device'] / n,
+        'breakdown/callback_ms': self._win['callback'] / n,
+    }
+    for key, value in out.items():
+      metrics_lib.gauge(f'trainer/{key}').set(value)
+    self._reset_window()
+    return out
+
+
+def _resilience_scalars(start_snapshot, policy) -> MetricDict:
+  """Train-scalar view of the resilience registry counters.
+
+  Deltas against the run-start snapshot (the registry is process-global;
+  a second trainer in the same process must not inherit the first one's
+  counts). Zero-valued entries are elided except the two non-finite
+  counters, which stay in the schema whenever the guard is on so their
+  TensorBoard series exist from step one.
+  """
+  always = ()
+  if policy is not None:
+    always = ('resilience/nonfinite_skipped_steps',
+              'resilience/consecutive_bad_dispatches')
+  out: MetricDict = {}
+  for name, value in metrics_lib.delta(start_snapshot, 'resilience/').items():
+    if isinstance(value, dict):  # histogram: not a publishable scalar
+      continue
+    if value or name in always:
+      out[name] = float(value)
+  return out
 
 
 class Trainer:
@@ -682,6 +855,13 @@ class Trainer:
     # (int(state.step)) after every dispatch, serializing the pipeline.
     step = self.step
     last_log_step = step
+    breakdown = _DispatchBreakdown(config.step_breakdown)
+    # Resilience counters are published as deltas against this run's
+    # starting point (the registry is process-global).
+    resilience_snap = metrics_lib.snapshot('resilience/')
+    loop_ident = threading.get_ident()
+    overlap_place_hist = metrics_lib.histogram(
+        'trainer/placement_overlapped_ms')
 
     def place(batch: Batch):
       # First placement builds the auto-layout executable from this
@@ -692,11 +872,22 @@ class Trainer:
       # WITH the placed batch: dispatching a default-layout batch into
       # the layout-specialized executable would be a runtime error, so
       # the choice is made exactly once, here.
+      t0 = time.perf_counter()
       use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
                   self._batch_matches_auto(batch))
       placed = mesh_lib.shard_batch(
           batch, self._mesh, self._batch_formats if use_auto else None,
           stacked=self._loop_k > 1)
+      place_ms = (time.perf_counter() - t0) * 1e3
+      if threading.get_ident() == loop_ident:
+        # Critical-path placement: carved out of host_wait in the
+        # breakdown (the no-prefetch path and the CPU consumer-place
+        # path both run here, inside the loop's next(batches)).
+        breakdown.place_ms[0] += place_ms
+      else:
+        # Prefetch-worker placement overlaps the device step: real H2D
+        # cost, but not on the dispatch critical path.
+        overlap_place_hist.observe(place_ms)
       return placed, use_auto
 
     if first_batch is not None:
@@ -717,6 +908,9 @@ class Trainer:
     # dispatch behind so policy enforcement adds no sync (the update was
     # already guarded on device; the lagged dispatch ran on clean state).
     pending_nonfinite: Optional[Tuple[Any, int]] = None
+    # The previous dispatch's device outputs: the one-behind readiness
+    # probe the breakdown blocks on AFTER enqueueing the next dispatch.
+    prev_out: Optional[MetricDict] = None
     shutdown = (self._shutdown if self._shutdown is not None
                 else resilience.active_shutdown())
     try:
@@ -734,18 +928,37 @@ class Trainer:
           for cb in self._callbacks:
             cb.end(self)
           raise resilience.PreemptedError(self.step)
-        (features, labels), use_auto = next(batches)
+        t_wait0 = time.perf_counter()
+        with tracing.span('trainer/wait_batch'):
+          (features, labels), use_auto = next(batches)
+        t_wait1 = time.perf_counter()
         step_fn = (self._auto_step if use_auto and self._auto_step is not None
                    else self._train_step_fn)
-        self._state, scalars = step_fn(self._state, features, labels)
+        with tracing.span('trainer/dispatch'):
+          self._state, scalars = step_fn(self._state, features, labels)
+        t_disp = time.perf_counter()
+        if breakdown.enabled and prev_out is not None:
+          # One dispatch behind: the current dispatch is already on
+          # device, so this block never drains the pipeline — it
+          # measures the device compute not hidden by host work.
+          with tracing.span('trainer/device_wait'):
+            jax.block_until_ready(prev_out)
+        prev_out = scalars
+        t_boundary = time.perf_counter()
         before = step
         self._dispatch_start_step = before
+        batch_leaves = jax.tree_util.tree_leaves(features)
         if self._loop_k > 1:
           # Group size travels as the leading (scan) dim; the final
           # group may be short (max_train_steps or an exhausted input).
-          step += jax.tree_util.tree_leaves(features)[0].shape[0]
+          step += batch_leaves[0].shape[0]
         else:
           step += 1
+        breakdown.record(
+            t_wait0, t_wait1, t_disp, t_boundary, steps=step - before,
+            examples=int(np.prod(batch_leaves[0].shape[:2]))
+            if self._loop_k > 1 and batch_leaves
+            else (batch_leaves[0].shape[0] if batch_leaves else 0))
         if self._nonfinite_policy is not None:
           prev, pending_nonfinite = pending_nonfinite, (
               scalars.get('nonfinite_count'), step)
@@ -757,6 +970,12 @@ class Trainer:
           last_log = time.time()
           scalars['steps_per_sec'] = (step - last_log_step) / max(dt, 1e-9)
           last_log_step = step
+          # Step-time breakdown + resilience counters ride the normal
+          # scalars dict, so MetricsLogger/TensorBoard publish them with
+          # zero call-site changes.
+          scalars.update(breakdown.window_scalars())
+          scalars.update(
+              _resilience_scalars(resilience_snap, self._nonfinite_policy))
         for cb in self._callbacks:
           cb.after_step(self, step, scalars)
         if (self._manager is not None and

@@ -28,8 +28,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import random
+import re
 import time
-from typing import Any, Callable, Iterator, Optional, Tuple, Type
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Type
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
 
 # Exceptions that mark a *data/IO* problem worth retrying or skipping.
 # ValueError covers record parse failures (``native_io.NativeExampleParser``
@@ -85,12 +88,25 @@ def retry_call(fn: Callable[..., Any],
     except policy.retry_on as e:
       if attempt + 1 >= attempts:
         raise
+      metrics_lib.counter('data/retries').inc()
       delay = policy.delay(attempt)
       logging.warning(
           'Retryable failure%s (attempt %d/%d, retrying in %.2fs): %r',
           f' in {describe}' if describe else '', attempt + 1, attempts,
           delay, e)
       policy.sleep(delay)
+
+
+# A filesystem-path-looking token inside an error message: the native
+# readers and tf.data both name the failing file in their errors, so a
+# budget can attribute charges per SOURCE without every call site
+# plumbing a path.
+_PATH_IN_ERROR = re.compile(r'(/[\w.+-]+(?:/[\w.+-]+)+)')
+
+# Per-source registry counters are capped to keep cardinality bounded
+# on jobs reading tens of thousands of shards; overflow aggregates.
+_MAX_SOURCES = 32
+_OVERFLOW_SOURCE = '<other>'
 
 
 class ErrorBudget:
@@ -101,29 +117,62 @@ class ErrorBudget:
   of 0 tolerates nothing (every error raises), which is also the
   behavior of passing no budget at the call sites — the budget only
   ever *adds* tolerance, never silences the over-budget case.
+
+  Every charge carries a *source* label (``record(exc, source=...)``,
+  else the constructor's ``source``, else a file path parsed out of the
+  error message): ``by_source`` accounts where a stream's budget went —
+  one rotting shard vs. diffuse corruption are different operational
+  problems — and the counts mirror into the metrics registry
+  (``resilience/data_errors`` + ``resilience/data_errors/<name>/<source>``)
+  so error-budget burn shows up in train scalars and ``metrics.report()``.
   """
 
-  def __init__(self, max_errors: int = 10, name: str = 'data'):
+  def __init__(self, max_errors: int = 10, name: str = 'data',
+               source: Optional[str] = None):
     self.max_errors = int(max_errors)
     self.name = name
+    self.source = source
     self.errors = 0
     self.last_error: Optional[BaseException] = None
+    self.by_source: Dict[str, int] = {}
 
   @property
   def remaining(self) -> int:
     return max(0, self.max_errors - self.errors)
 
-  def record(self, exc: BaseException) -> None:
-    """Charges one error; raises once the budget is exceeded."""
+  def _resolve_source(self, exc: BaseException,
+                      source: Optional[str]) -> str:
+    if source:
+      return source
+    if self.source:
+      return self.source
+    match = _PATH_IN_ERROR.search(str(exc))
+    return match.group(1) if match else '<unattributed>'
+
+  def record(self, exc: BaseException, source: Optional[str] = None) -> None:
+    """Charges one error against ``source``; raises once over budget."""
     self.errors += 1
     self.last_error = exc
+    src = self._resolve_source(exc, source)
+    self.by_source[src] = self.by_source.get(src, 0) + 1
+    metrics_lib.counter('resilience/data_errors').inc()
+    # A source keeps its dedicated registry counter if it appeared while
+    # under the cardinality cap; later-arriving sources aggregate.
+    reg_src = (src if self.by_source[src] > 1 or
+               len(self.by_source) <= _MAX_SOURCES else _OVERFLOW_SOURCE)
+    metrics_lib.counter(
+        f'resilience/data_errors/{self.name}/{reg_src}').inc()
     if self.errors > self.max_errors:
+      per_source = ', '.join(
+          f'{s}: {n}' for s, n in sorted(
+              self.by_source.items(), key=lambda kv: -kv[1]))
       raise DataErrorBudgetExceededError(
           f'{self.name} error budget exceeded: {self.errors} error(s) > '
-          f'budget of {self.max_errors}; last error: {exc!r}') from exc
+          f'budget of {self.max_errors}; by source: [{per_source}]; '
+          f'last error: {exc!r}') from exc
     logging.warning(
-        '%s error %d/%d absorbed (budget remaining: %d): %r', self.name,
-        self.errors, self.max_errors, self.remaining, exc)
+        '%s error %d/%d absorbed (source: %s, budget remaining: %d): %r',
+        self.name, self.errors, self.max_errors, src, self.remaining, exc)
 
 
 class ResilientIterator:

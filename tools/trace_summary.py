@@ -1,0 +1,125 @@
+"""Per-scope time table from a dumped Chrome-trace JSON.
+
+Summarizes the host-span traces that
+``tensor2robot_tpu.observability.tracing.dump_chrome_trace`` writes (any
+Chrome-trace JSON with ``X``/``B``+``E`` events works, including
+TensorBoard's ``trace.json.gz`` exports):
+
+    python tools/trace_summary.py /tmp/run/trace.json
+    python tools/trace_summary.py --by-scope trace.json.gz
+
+Default: one row per span NAME (count, total ms, mean, max, % of the
+busiest row). ``--by-scope`` rolls rows up by the first slash segment
+(``data/decode`` + ``data/parse`` → ``data``) for a layer-level view.
+Self time subtracts child spans nested inside the same thread, so a
+parent enclosing instrumented children is not double-counted in totals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+  opener = gzip.open if path.endswith('.gz') else open
+  with opener(path, 'rt') as f:
+    data = json.load(f)
+  events = data.get('traceEvents', data) if isinstance(data, dict) else data
+  if not isinstance(events, list):
+    raise ValueError(f'{path!r} is not a Chrome-trace JSON')
+  # Normalize B/E pairs (per tid, stack discipline) into X events.
+  out, stacks = [], {}
+  for e in events:
+    ph = e.get('ph')
+    if ph == 'X' and 'dur' in e:
+      out.append(e)
+    elif ph == 'B':
+      stacks.setdefault(e.get('tid'), []).append(e)
+    elif ph == 'E':
+      stack = stacks.get(e.get('tid'))
+      if stack:
+        b = stack.pop()
+        out.append({'name': b.get('name', '?'), 'ts': b['ts'],
+                    'dur': e['ts'] - b['ts'], 'tid': b.get('tid')})
+  return out
+
+
+def self_times(events: List[dict]) -> List[dict]:
+  """Attaches ``self_dur`` (dur minus nested same-thread child spans)."""
+  by_tid: Dict[object, List[dict]] = {}
+  for e in events:
+    e['self_dur'] = e['dur']
+    by_tid.setdefault(e.get('tid'), []).append(e)
+  for tid_events in by_tid.values():
+    tid_events.sort(key=lambda e: (e['ts'], -e['dur']))
+    stack: List[dict] = []
+    for e in tid_events:
+      while stack and e['ts'] >= stack[-1]['ts'] + stack[-1]['dur']:
+        stack.pop()
+      if stack:  # e nests inside stack[-1]
+        stack[-1]['self_dur'] -= e['dur']
+      stack.append(e)
+  return events
+
+
+def summarize(events: List[dict], by_scope: bool = False) -> List[dict]:
+  rows: Dict[str, dict] = {}
+  for e in self_times(events):
+    name = e.get('name', '?')
+    if by_scope:
+      name = name.split('/', 1)[0]
+    row = rows.setdefault(
+        name, {'name': name, 'count': 0, 'total_ms': 0.0,
+               'self_ms': 0.0, 'max_ms': 0.0})
+    dur_ms = e['dur'] / 1e3
+    row['count'] += 1
+    row['total_ms'] += dur_ms
+    row['self_ms'] += max(0.0, e['self_dur'] / 1e3)
+    row['max_ms'] = max(row['max_ms'], dur_ms)
+  for row in rows.values():
+    row['mean_ms'] = row['total_ms'] / row['count']
+  return sorted(rows.values(), key=lambda r: -r['self_ms'])
+
+
+def print_table(rows: List[dict], out=sys.stdout) -> None:
+  if not rows:
+    print('no duration events found', file=out)
+    return
+  top_self = max(row['self_ms'] for row in rows) or 1.0
+  width = max(len(row['name']) for row in rows)
+  header = (f'{"span":<{width}}  {"count":>7}  {"total ms":>10}  '
+            f'{"self ms":>10}  {"mean ms":>9}  {"max ms":>9}  {"rel":>5}')
+  print(header, file=out)
+  print('-' * len(header), file=out)
+  for row in rows:
+    print(f'{row["name"]:<{width}}  {row["count"]:>7}  '
+          f'{row["total_ms"]:>10.2f}  {row["self_ms"]:>10.2f}  '
+          f'{row["mean_ms"]:>9.3f}  {row["max_ms"]:>9.2f}  '
+          f'{row["self_ms"] / top_self:>5.0%}', file=out)
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description='Per-scope time table from a Chrome-trace JSON '
+                  '(observability.tracing.dump_chrome_trace output).')
+  parser.add_argument('trace', help='trace JSON path (.gz ok)')
+  parser.add_argument('--by-scope', action='store_true',
+                      help='roll up by first slash segment '
+                           '(data/decode + data/parse -> data)')
+  parser.add_argument('--json', action='store_true',
+                      help='emit the summary rows as one JSON line')
+  args = parser.parse_args(argv)
+  rows = summarize(load_events(args.trace), by_scope=args.by_scope)
+  if args.json:
+    print(json.dumps(rows))
+  else:
+    print_table(rows)
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
