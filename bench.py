@@ -255,6 +255,12 @@ def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
     median_ms = samples[len(samples) // 2]
     wall_sps = 1000.0 / median_ms if median_ms else 0.0
     floor_sps = 1000.0 / device_ms if device_ms else 0.0
+    # The input engine's autotune outcome (workers / ring depth) rides
+    # beside the throughput it produced, so a BENCH round's record-fed
+    # number arrives with its pipeline shape attached.
+    from tensor2robot_tpu.data import engine as engine_lib
+
+    decision = engine_lib.last_decision()
     print(json.dumps({
         'metric': 'qtopt_record_train_steps_per_sec',
         'value': round(wall_sps, 3),
@@ -266,6 +272,7 @@ def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
         if floor_sps else None,
         'steps': trainer.step - start,
         'batch_size': batch_size,
+        'engine_autotune': decision.as_dict() if decision else None,
     }))
   finally:
     shutil.rmtree(data_dir, ignore_errors=True)

@@ -87,7 +87,21 @@ class AbstractInputGenerator(abc.ABC):
     return retry_lib.ResilientIterator(
         lambda: self._create_iterator(mode, batch_size),
         budget=retry_lib.ErrorBudget(
-            self._error_budget, name=f'{type(self).__name__} batch'))
+            self._error_budget, name=f'{type(self).__name__} batch'),
+        retry_on=self._budget_retry_on(),
+        source_fn=self._budget_source)
+
+  def _budget_retry_on(self):
+    """Exception types the error budget absorbs (subclasses extend)."""
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    return retry_lib.DEFAULT_RETRYABLE
+
+  def _budget_source(self, exc: BaseException) -> Optional[str]:
+    """Maps a caught data error to a source label (None = let the
+    budget's path-in-message fallback attribute it)."""
+    del exc
+    return None
 
   @abc.abstractmethod
   def _create_iterator(self, mode: str, batch_size: int) -> Iterator[Batch]:
@@ -118,6 +132,83 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     self._shuffle_buffer_size = shuffle_buffer_size
     self._parallel_shards = parallel_shards
     self._seed = seed
+    # Lazy (filenames, format) cache + per-file probe results for
+    # _budget_source; resolved on the first budget charge, not in the
+    # constructor (subclasses may still be rewriting _file_patterns).
+    self._budget_filenames: Optional[tuple] = None
+    self._budget_file_ok: dict = {}
+
+  def _budget_retry_on(self):
+    """tf.data surfaces corrupt records/files as ``tf.errors.OpError``
+    subclasses (DataLossError et al.), which are NOT OSErrors — without
+    this, the tf-codec route's error budget never engaged at all."""
+    base = super()._budget_retry_on()
+    try:
+      import tensorflow as tf  # the parse path imports it anyway
+
+      return base + (tf.errors.OpError,)
+    except ImportError:
+      return base
+
+  def _budget_source(self, exc: BaseException) -> Optional[str]:
+    """Per-file budget attribution for the tf-codec parse path, matching
+    what the native reader does by construction.
+
+    Two mechanisms, cheapest first: (a) match the failing file out of
+    the error text when tf names it (open/NotFound errors do); (b) for
+    record-corruption errors that only say "corrupted record at
+    <offset>" (``DataLossError``), probe this generator's tfrecord files
+    once each with a framing/CRC walk (``records.verify_tfrecord_file``)
+    and charge the rotten shard. Probe results are cached per generator
+    — each file is scanned at most once, and repeat errors re-charge the
+    known-corrupt shards without re-reading anything.
+    """
+    filenames, fmt = self._resolved_budget_filenames()
+    match = pipeline.match_filename_in_error(exc, filenames)
+    if match is not None:
+      return match
+    if fmt != 'tfrecord' or not self._is_corruption_error(exc):
+      return None
+    from tensor2robot_tpu.data import records
+
+    for path in filenames:
+      if path in self._budget_file_ok:
+        continue
+      if '://' in path:  # remote probe cost is an operator decision
+        self._budget_file_ok[path] = True
+        continue
+      self._budget_file_ok[path] = records.verify_tfrecord_file(path)
+    corrupt = [p for p in filenames if not self._budget_file_ok.get(p, True)]
+    return corrupt[0] if corrupt else None
+
+  def _resolved_budget_filenames(self):
+    if self._budget_filenames is None:
+      from tensor2robot_tpu.data import records
+
+      filenames, fmt = [], None
+      patterns = self._file_patterns
+      for pattern in (patterns.values() if isinstance(patterns, dict)
+                      else [patterns]):
+        try:
+          fmt, resolved = records.get_data_format_and_filenames(pattern)
+          filenames.extend(resolved)
+        except ValueError:
+          pass
+      self._budget_filenames = (filenames, fmt)
+      self._budget_file_ok = {}
+    return self._budget_filenames
+
+  @staticmethod
+  def _is_corruption_error(exc: BaseException) -> bool:
+    try:
+      import tensorflow as tf
+
+      if isinstance(exc, tf.errors.DataLossError):
+        return True
+    except ImportError:
+      pass
+    text = str(exc).lower()
+    return 'corrupt' in text or 'truncated' in text
 
   def _make_dataset(self, mode, batch_size):
     """The ONE dataset definition both iterator flavors build from."""
@@ -225,11 +316,6 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
 class NativeRecordInputGenerator(AbstractInputGenerator):
   """TF-free record input on the native C++ runtime.
 
-  No ``create_checkpointable_iterator``: the threaded interleave reader's
-  record order is scheduler-dependent, so there is no deterministic
-  stream position to checkpoint — use :class:`DefaultRecordInputGenerator`
-  when resumable streams (``train/input_state.py``) matter.
-
   Reads TFRecord files with the native interleaved prefetch reader
   (``native/record_io.cpp``), parses tf.Examples with the native
   wire-format parser, and decodes images with PIL — no TensorFlow in the
@@ -239,6 +325,20 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
   (``native_io.NativeExampleParser.supports``); use
   :class:`DefaultRecordInputGenerator` for SequenceExample or
   multi-dataset specs.
+
+  Batches are produced by the parallel host input engine
+  (``data/engine.py``): ``engine_workers`` pipeline workers run
+  parse+decode for DIFFERENT batches concurrently, with a reorder stage
+  guaranteeing the delivered stream is byte-identical to the serial
+  path for any worker count. ``engine_workers=None`` autotunes
+  (core-aware; collapses to the serial inline path on 1-core hosts),
+  ``0`` forces serial.
+
+  The stream is fully deterministic (strict round-robin interleave +
+  seeded shuffle), so — with a seed — its position is well-defined and
+  :meth:`create_checkpointable_iterator` supports mid-epoch resume:
+  restore replays the record stream to the saved batch count (read-only
+  fast-forward, no parse/decode) and continues bit-exactly.
   """
 
   def __init__(self,
@@ -250,7 +350,10 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                decode_workers: int = 8,
                seed: Optional[int] = None,
                error_budget: Optional[int] = None,
-               open_retries: int = 3):
+               open_retries: int = 3,
+               engine_workers: Optional[int] = None,
+               engine_ring_depth: Optional[int] = None,
+               reuse_batch_buffers: bool = False):
     super().__init__(batch_size, error_budget=error_budget)
     if not file_patterns:
       raise ValueError('Provide file_patterns.')
@@ -261,6 +364,12 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     self._decode_workers = decode_workers
     self._seed = seed
     self._open_retries = open_retries
+    self._engine_workers = engine_workers
+    self._engine_ring_depth = engine_ring_depth
+    # Ring-slot reuse: delivered image arrays are views of recycled
+    # buffers and the CONSUMER must call engine.release() per batch —
+    # only for callers that honor that contract (data/engine.py).
+    self._reuse_batch_buffers = reuse_batch_buffers
 
   def _records(self, mode: str):
     """Yields raw serialized examples forever (train) or one epoch.
@@ -308,6 +417,18 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
         return
 
   def _create_iterator(self, mode, batch_size):
+    return self._build_batches(mode, batch_size)
+
+  def _build_batches(self, mode, batch_size, skip_batches: int = 0):
+    """The ONE batch pipeline both iterator flavors build from:
+    interleaved read → seeded shuffle → engine (ticket-parallel
+    parse/decode, order-preserving). ``skip_batches`` fast-forwards the
+    deterministic stream by consuming (without parsing) the records the
+    first N batches would have used — the checkpointable iterator's
+    restore path."""
+    import itertools
+
+    from tensor2robot_tpu.data import engine as engine_lib
     from tensor2robot_tpu.data import native_io
 
     parse_fn = native_io.make_native_parse_fn(
@@ -336,17 +457,105 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
       while buf:  # unreachable for train (infinite), kept for safety
         yield buf.pop(rng.randint(len(buf)))
 
-    def batches():
-      pending = []
-      for record in stream():
-        pending.append(record)
-        if len(pending) < batch_size:
-          continue
-        yield parse_fn(pending)
-        pending = []
-      # eval: drop the final short batch (drop_remainder parity)
+    records = stream()
+    if skip_batches:
+      # Post-shuffle skip: exactly the records batches [0, N) consumed,
+      # so the next delivered batch is bit-identical to batch N of an
+      # uninterrupted run. Read + shuffle replay only — no parse/decode.
+      records = itertools.islice(records, skip_batches * batch_size, None)
+    decision = engine_lib.autotune(self._engine_workers,
+                                   self._engine_ring_depth)
+    return engine_lib.ParallelBatchEngine(
+        records, parse_fn, batch_size,
+        num_workers=decision.num_workers,
+        ring_depth=decision.ring_depth,
+        reuse_buffers=self._reuse_batch_buffers)
 
-    return batches()
+  def create_checkpointable_iterator(
+      self, mode: str, batch_size: Optional[int] = None
+  ) -> '_CheckpointableEngineIterator':
+    """Engine-fed iterator whose STREAM POSITION checkpoints.
+
+    The native stream is a deterministic function of (files, seed,
+    batch size), so its position is the delivered-batch count; restore
+    rebuilds the pipeline and fast-forwards the raw record stream to
+    that count (read-only replay — the skipped batches are never parsed
+    or decoded). Requires a ``seed`` when shuffling, or the replay would
+    diverge. Same prefetch caveat as the tf.data flavor
+    (``train/input_state.py``): run ``prefetch_batches=0`` when bit-
+    exact resume matters.
+    """
+    if self._feature_spec is None:
+      raise ValueError(
+          'Input generator has no specs; call set_specification(_from_model) '
+          'first.')
+    if (modes.is_training(mode) and self._shuffle_buffer_size > 1 and
+        self._seed is None):
+      raise ValueError(
+          'create_checkpointable_iterator needs a seed when shuffling: '
+          'an unseeded shuffle cannot be replayed bit-exactly on resume.')
+    return _CheckpointableEngineIterator(
+        self, mode, batch_size or self._batch_size)
+
+
+class _CheckpointableEngineIterator:
+  """Resumable position tracking over the native engine pipeline.
+
+  Same save/restore surface as ``pipeline.CheckpointableNumpyIterator``
+  (``train/input_state.py`` drives both): ``save`` writes a tiny JSON
+  position next to the model checkpoint; ``restore`` rebuilds the engine
+  with a deterministic fast-forward. The lock makes position capture
+  atomic against a prefetch worker's concurrent ``next()``.
+  """
+
+  def __init__(self, generator: NativeRecordInputGenerator, mode: str,
+               batch_size: int):
+    import threading
+
+    self._generator = generator
+    self._mode = mode
+    self._batch_size = batch_size
+    self._delivered = 0
+    self._lock = threading.Lock()
+    self._engine = generator._build_batches(mode, batch_size)  # pylint: disable=protected-access
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> Batch:
+    with self._lock:
+      batch = next(self._engine)
+      self._delivered += 1
+      return batch
+
+  def save(self, path_prefix: str) -> str:
+    path = path_prefix + '.json'
+    dirname = os.path.dirname(path)
+    if dirname:
+      os.makedirs(dirname, exist_ok=True)
+    with self._lock:
+      state = {'batches_delivered': self._delivered,
+               'batch_size': self._batch_size, 'mode': self._mode}
+    with open(path, 'w') as f:
+      json.dump(state, f)
+    return path
+
+  def restore(self, path_prefix: str) -> None:
+    with open(path_prefix + '.json') as f:
+      state = json.load(f)
+    if state.get('batch_size') != self._batch_size:
+      raise ValueError(
+          f'Input state was saved with batch_size='
+          f'{state.get("batch_size")}, but this iterator uses '
+          f'{self._batch_size}; the stream positions are incompatible.')
+    with self._lock:
+      self._engine.close()
+      self._delivered = int(state['batches_delivered'])
+      self._engine = self._generator._build_batches(  # pylint: disable=protected-access
+          self._mode, self._batch_size, skip_batches=self._delivered)
+
+  def close(self) -> None:
+    self._engine.close()
 
 
 class TaskGroupedRecordInputGenerator(AbstractInputGenerator):

@@ -380,14 +380,16 @@ def _decode_image(raw: bytes, spec, key=None):
   return arr.astype(spec.dtype)
 
 
-def _native_jpeg_batch(raws, spec, workers: int, key=None):
+def _native_jpeg_batch(raws, spec, workers: int, key=None, out=None):
   """Batch JPEG decode through the native C++ decoder, or ``None``.
 
   Decodes straight into one contiguous [N, H, W, C] uint8 array (no
-  per-image numpy intermediates, no np.stack copy). Images the native
-  decoder declines (non-JPEG bytes, shape mismatch, decode errors) fall
-  back to :func:`_decode_image` individually — shape mismatches then
-  raise the same descriptive error the PIL path raises.
+  per-image numpy intermediates, no np.stack copy) — the caller's
+  preallocated ``out`` (a ring-buffer slot, see ``data/engine.py``) when
+  given, else a fresh allocation. Images the native decoder declines
+  (non-JPEG bytes, shape mismatch, decode errors) fall back to
+  :func:`_decode_image` individually — shape mismatches then raise the
+  same descriptive error the PIL path raises.
   """
   import numpy as np
 
@@ -402,7 +404,14 @@ def _native_jpeg_batch(raws, spec, workers: int, key=None):
     return None
   n = len(raws)
   h, w, c = shape
-  out = np.empty((n, h, w, c), np.uint8)
+  if out is not None and (out.shape != (n, h, w, c) or
+                          out.dtype != np.uint8 or
+                          not out.flags['C_CONTIGUOUS']):
+    raise ValueError(
+        f'decode buffer for {key or spec.name!r} must be contiguous '
+        f'uint8 {(n, h, w, c)}, got {out.dtype} {out.shape}')
+  if out is None:
+    out = np.empty((n, h, w, c), np.uint8)
   status = np.zeros(n, np.int32)
   bufs = (ctypes.c_char_p * n)(*raws)
   lens = (ctypes.c_uint64 * n)(*[len(r) for r in raws])
@@ -425,6 +434,40 @@ def _native_jpeg_batch(raws, spec, workers: int, key=None):
   else:
     for i in declined:
       out[i] = _decode_image(raws[i], spec, key=key)
+  return out
+
+
+def _decode_image_batch(raws, spec, workers: int, key=None, out=None):
+  """Contiguous [N, H, W, C] image-batch decode, any encoding.
+
+  The zero-copy batch-assembly discipline for EVERY decode route: the
+  native JPEG fast path already fills one contiguous buffer; the PIL
+  fallback now writes each decoded image straight into its row of the
+  same batch buffer — the whole-batch ``np.stack`` copy is gone from
+  both. ``out`` (optional) is a caller-preallocated buffer (an engine
+  ring slot); without it one fresh buffer is allocated per batch.
+  """
+  import numpy as np
+
+  batch = _native_jpeg_batch(raws, spec, workers, key=key, out=out)
+  if batch is not None:
+    return batch
+  n = len(raws)
+  shape = tuple(spec.shape[-3:])
+  if out is None:
+    out = np.empty((n,) + shape, spec.dtype)
+
+  def decode_into(i):
+    out[i] = _decode_image(raws[i], spec, key=key)
+
+  if workers and workers > 1 and n > 1:
+    # Exhausts the map so any decode error (e.g. a descriptive shape
+    # mismatch) raises here, exactly like the serial loop.
+    for _ in _decode_pool(workers).map(decode_into, range(n)):
+      pass
+  else:
+    for i in range(n):
+      decode_into(i)
   return out
 
 
@@ -469,6 +512,15 @@ def make_native_parse_fn(feature_spec, label_spec=None,
   thread pool (PIL releases the GIL in its C decoder, so this scales) —
   the tf.data ``num_parallel_calls`` analog for the dominant host cost
   of image workloads. 0 decodes inline.
+
+  The returned ``parse_fn`` is safe to call concurrently on DIFFERENT
+  record batches (the engine's workers do): the native parser handle's
+  only cross-call state is its error string, so each calling thread
+  lazily gets its own parser. It also exposes the batch-buffer protocol
+  ``data/engine.py`` ring slots use: ``parse_fn.make_image_buffers(
+  batch_size)`` preallocates the contiguous per-image-feature decode
+  buffers, and ``parse_fn(records, image_out=buffers)`` decodes into
+  them instead of allocating.
   """
   import numpy as np
 
@@ -487,21 +539,22 @@ def make_native_parse_fn(feature_spec, label_spec=None,
       if spec.dataset_key or not NativeExampleParser.supports(spec):
         return None
       named.append((prefix + key, spec.name or key.split('/')[-1], spec))
-  parser = NativeExampleParser(named)
-  use_pool = decode_workers and any(
-      getattr(spec, 'is_encoded_image', False) for _, _, spec in named)
+  parser0 = NativeExampleParser(named)  # eager: validates the specs once
+  tls = threading.local()
+  tls.parser = parser0
 
-  def decode_all(raws, spec, key):
-    if not use_pool:
-      return [_decode_image(raw, spec, key=key) for raw in raws]
-    return list(_decode_pool(decode_workers).map(
-        lambda raw: _decode_image(raw, spec, key=key), raws))
+  def _thread_parser() -> NativeExampleParser:
+    parser = getattr(tls, 'parser', None)
+    if parser is None:
+      parser = NativeExampleParser(named)
+      tls.parser = parser
+    return parser
 
-  def parse_fn(records):
+  def parse_fn(records, image_out=None):
     from tensor2robot_tpu.specs import SpecStruct
 
     with tracing.span('data/parse'):
-      parsed = parser.parse_batch(list(records))
+      parsed = _thread_parser().parse_batch(list(records))
     metrics_lib.counter('data/examples_parsed').inc(len(records))
     feats, labels = SpecStruct(), SpecStruct()
     for out_key, _, spec in named:
@@ -512,11 +565,9 @@ def make_native_parse_fn(feature_spec, label_spec=None,
           # data/decode_ms is the first histogram to read when the
           # trainer breakdown says a run is input-bound.
           with tracing.span('data/decode'):
-            batch = _native_jpeg_batch(value, spec, decode_workers,
-                                       key=out_key[2:])
-            if batch is None:
-              batch = np.stack(decode_all(value, spec, out_key[2:]))
-          value = batch
+            value = _decode_image_batch(
+                value, spec, decode_workers, key=out_key[2:],
+                out=None if image_out is None else image_out.get(out_key))
           if len(spec.shape) > 3:  # singleton leading image dims
             value = value.reshape(value.shape[:1] + tuple(spec.shape))
         else:  # plain string: pass through undecoded (TF-codec parity)
@@ -529,4 +580,14 @@ def make_native_parse_fn(feature_spec, label_spec=None,
     return features, algebra.pack_flat_sequence_to_spec_structure(
         flat_l, labels)
 
+  def make_image_buffers(batch_size: int):
+    """One ring slot: a contiguous decode buffer per image feature."""
+    return {
+        out_key: np.empty((batch_size,) + tuple(spec.shape[-3:]),
+                          spec.dtype)
+        for out_key, _, spec in named
+        if getattr(spec, 'is_encoded_image', False)
+    }
+
+  parse_fn.make_image_buffers = make_image_buffers
   return parse_fn

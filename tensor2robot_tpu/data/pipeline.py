@@ -27,6 +27,31 @@ def _tf():
   return tf
 
 
+def match_filename_in_error(exc: BaseException, filenames) -> Optional[str]:
+  """The filename (from a KNOWN file set) an error message names, or None.
+
+  Budget-source attribution for parse paths whose errors carry the
+  failing file only in prose (tf.data's DataLossError does): a full-path
+  substring match wins; a unique basename match covers messages that
+  abbreviate paths. Ambiguity returns None — the budget's generic
+  path-regex fallback is better than a wrong attribution.
+  """
+  import os as os_lib
+
+  text = str(exc)
+  if not text:
+    return None
+  for name in filenames:
+    if name and name in text:
+      return name
+  by_base = [name for name in filenames
+             if os_lib.path.basename(name) and
+             os_lib.path.basename(name) in text]
+  if len(by_base) == 1:
+    return by_base[0]
+  return None
+
+
 def shard_filenames_for_process(filenames):
   """Per-host file sharding: each jax process reads a distinct slice.
 

@@ -7,6 +7,9 @@
   host spans that accumulate into the registry, export Chrome-trace
   JSON, and wrap ``jax.profiler.TraceAnnotation`` so host and XLA
   timelines line up.
+* :mod:`~tensor2robot_tpu.observability.metricsz` — opt-in
+  ``GET /metricsz`` HTTP endpoint serving the live ``report()`` JSON for
+  fleet scraping (``TrainerConfig.metricsz_port`` / ``T2R_METRICSZ_PORT``).
 
 The trainer's per-dispatch step-time breakdown (host wait / H2D
 placement / device step / callbacks, ``examples_per_sec``,
@@ -14,7 +17,7 @@ placement / device step / callbacks, ``examples_per_sec``,
 ``train/trainer.py`` and the README "Observability" section.
 """
 
-from tensor2robot_tpu.observability import metrics, tracing
+from tensor2robot_tpu.observability import metrics, metricsz, tracing
 from tensor2robot_tpu.observability.metrics import (Counter, Gauge,
                                                     Histogram, Registry)
 from tensor2robot_tpu.observability.tracing import (capture,
@@ -22,6 +25,6 @@ from tensor2robot_tpu.observability.tracing import (capture,
                                                     step_annotation)
 
 __all__ = [
-    'metrics', 'tracing', 'Counter', 'Gauge', 'Histogram', 'Registry',
-    'capture', 'dump_chrome_trace', 'span', 'step_annotation',
+    'metrics', 'metricsz', 'tracing', 'Counter', 'Gauge', 'Histogram',
+    'Registry', 'capture', 'dump_chrome_trace', 'span', 'step_annotation',
 ]

@@ -1,0 +1,166 @@
+"""Live metrics endpoint: ``GET /metricsz`` serves the registry as JSON.
+
+The fleet-scraping half of the observability story (ROADMAP open item):
+``metrics.report()`` was only reachable at end of run (``dump_report``)
+or from inside the process; this module exposes the SAME report over a
+tiny stdlib ``http.server`` running on a daemon thread, so a scraper (or
+an operator's ``curl``) can watch a live training job's counters, step-
+time breakdown gauges and queue depths without touching the process.
+
+Dependency-free like the rest of the registry (the serving-host
+contract): pure stdlib, no jax/TF import. Opt-in only — nothing listens
+unless ``TrainerConfig.metricsz_port`` is set or the
+``T2R_METRICSZ_PORT`` env var is present; the bind is loopback by
+default (metrics can reveal data paths — exposing them beyond the host
+is an operator decision via ``host=``).
+
+Endpoints:
+  ``/metricsz``  the full ``metrics.report()`` JSON document
+  ``/healthz``   ``{"status": "ok"}`` — liveness for fleet probes
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import threading
+from typing import Optional
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+ENV_VAR = 'T2R_METRICSZ_PORT'
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+  """Serves the registry snapshot; everything else 404s."""
+
+  # Silence the default per-request stderr line (a scraper would spam
+  # the training logs); failures still log through `logging`.
+  def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+    del format, args
+
+  def _reply(self, code: int, payload: dict) -> None:
+    body = json.dumps(payload, sort_keys=True).encode()
+    self.send_response(code)
+    self.send_header('Content-Type', 'application/json')
+    self.send_header('Content-Length', str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+  def do_GET(self):  # noqa: N802 - stdlib naming
+    path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    if path == '/metricsz':
+      self._reply(200, metrics_lib.report())
+    elif path == '/healthz':
+      self._reply(200, {'status': 'ok'})
+    else:
+      self._reply(404, {'error': f'unknown path {path!r}',
+                        'endpoints': ['/metricsz', '/healthz']})
+
+
+class MetricsServer:
+  """A ``/metricsz`` HTTP server on a daemon thread.
+
+  ``port=0`` binds an ephemeral port; read the resolved one from
+  ``.port`` after :meth:`start`. ``close`` is idempotent and releases
+  the socket.
+  """
+
+  def __init__(self, port: int = 0, host: str = '127.0.0.1'):
+    self._requested = (host, int(port))
+    self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+    self._thread: Optional[threading.Thread] = None
+
+  @property
+  def port(self) -> Optional[int]:
+    return None if self._httpd is None else self._httpd.server_address[1]
+
+  @property
+  def url(self) -> Optional[str]:
+    if self._httpd is None:
+      return None
+    host, port = self._httpd.server_address[:2]
+    return f'http://{host}:{port}/metricsz'
+
+  def start(self) -> 'MetricsServer':
+    if self._httpd is not None:
+      return self
+    self._httpd = http.server.ThreadingHTTPServer(self._requested, _Handler)
+    self._httpd.daemon_threads = True
+    self._thread = threading.Thread(
+        target=self._httpd.serve_forever, kwargs={'poll_interval': 0.5},
+        daemon=True, name='t2r-metricsz')
+    self._thread.start()
+    logging.info('Serving metrics at %s', self.url)
+    return self
+
+  def close(self) -> None:
+    if self._httpd is None:
+      return
+    self._httpd.shutdown()
+    self._httpd.server_close()
+    if self._thread is not None:
+      self._thread.join(timeout=5.0)
+    self._httpd = None
+    self._thread = None
+
+  def __enter__(self) -> 'MetricsServer':
+    return self.start()
+
+  def __exit__(self, *exc) -> None:
+    self.close()
+
+
+_GLOBAL: Optional[MetricsServer] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_server() -> Optional[MetricsServer]:
+  """The process-wide server started by :func:`maybe_start`, if any."""
+  return _GLOBAL
+
+
+def maybe_start(port: Optional[int] = None) -> Optional[MetricsServer]:
+  """Starts the process-wide ``/metricsz`` server if configured.
+
+  ``port=None`` consults the ``T2R_METRICSZ_PORT`` env var; still-None
+  means the endpoint stays off (the default). Idempotent: a second call
+  returns the already-running server (a differing port logs a warning
+  rather than binding a second socket — one registry, one endpoint).
+  Never raises: an unbindable port degrades to a warning, because a
+  metrics endpoint must not kill a training job.
+  """
+  global _GLOBAL
+  if port is None:
+    env = os.environ.get(ENV_VAR, '').strip()
+    if not env:
+      return None
+    try:
+      port = int(env)
+    except ValueError:
+      logging.warning('Ignoring non-integer %s=%r', ENV_VAR, env)
+      return None
+  with _GLOBAL_LOCK:
+    if _GLOBAL is not None:
+      if port not in (0, _GLOBAL.port):
+        logging.warning(
+            '/metricsz already serving on port %s; ignoring request for '
+            'port %d.', _GLOBAL.port, port)
+      return _GLOBAL
+    try:
+      _GLOBAL = MetricsServer(port=port).start()
+    except OSError as e:
+      logging.warning('Could not start /metricsz on port %d: %s', port, e)
+      _GLOBAL = None
+    return _GLOBAL
+
+
+def stop_global() -> None:
+  """Stops the process-wide server (tests, orderly shutdown)."""
+  global _GLOBAL
+  with _GLOBAL_LOCK:
+    if _GLOBAL is not None:
+      _GLOBAL.close()
+      _GLOBAL = None

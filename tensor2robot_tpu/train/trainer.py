@@ -159,6 +159,13 @@ class TrainerConfig:
   # already). Costs a handful of perf_counter reads + registry updates
   # per dispatch; False restores the uninstrumented loop exactly.
   step_breakdown: bool = True
+  # Live metrics endpoint (observability/metricsz.py): serve
+  # ``registry.report()`` JSON at http://127.0.0.1:<port>/metricsz from a
+  # stdlib http.server daemon thread, for fleet scraping without touching
+  # the training process. None = off (the default; the T2R_METRICSZ_PORT
+  # env var also opts in); 0 = an ephemeral port (logged, and readable
+  # from ``observability.metricsz.global_server().port``).
+  metricsz_port: Optional[int] = None
 
   def resolved_auto_input_layouts(self) -> bool:
     if jax.process_count() > 1:
@@ -170,36 +177,50 @@ class TrainerConfig:
   def resolved_prefetch_batches(self) -> int:
     if self.prefetch_batches is not None:
       return self.prefetch_batches
-    try:  # CPUs AVAILABLE to this process (affinity/cgroup-aware) —
-      cpus = len(os.sched_getaffinity(0))  # host core count lies under
-    except (AttributeError, OSError):      # taskset/containers.
-      cpus = os.cpu_count() or 1
-    return 2 if cpus > 1 else 0
+    # The data layer's autotuner owns the core heuristic (it also sizes
+    # the input engine's workers off the same affinity-aware count and
+    # breakdown signals): 2 on multi-core hosts, 0 on single-core ones,
+    # where the worker thread CONTENDS with dispatch instead of
+    # overlapping it (record-fed grasp2vec: 297 → 663 ms/step median).
+    from tensor2robot_tpu.data import engine as engine_lib
+
+    return engine_lib.autotune_prefetch()
 
 
 class _DevicePrefetcher:
-  """Background thread staging upcoming batches ahead of the step.
+  """Background pipeline staging upcoming batches ahead of the step.
 
   Pulls ``(features, labels)`` from ``it`` and keeps up to ``depth``
   staged batches in a bounded queue, so host parse/decode overlaps the
-  device step instead of serializing with it. On a real TPU backend the
-  worker also applies ``place`` (the shard_batch h2d placement) so the
-  transfer overlaps too; on the forced-host CPU platform the placement
-  happens on the consumer thread instead — XLA CPU runs an N-device
-  mesh's collectives as N in-process threads, and a concurrent
-  device_put can starve one participant into a rendezvous deadlock
-  (observed as an all-reduce termination timeout → SIGABRT). FIFO:
-  batch order — and therefore training — is unchanged either way.
+  device step instead of serializing with it. Two shapes, by backend:
+
+  * Real TPU backends run a THREE-stage pipeline: a fetch worker pulls
+    host batches from ``it`` (with the parallel input engine upstream
+    this is mostly dequeueing — the engine's own workers do the decode),
+    and a DEDICATED placement worker applies ``place`` (the auto-layout
+    H2D shard_batch), so the decode of batch N+2, the placement of N+1
+    and the device step of N all overlap across batches instead of
+    serializing behind one thread.
+  * On the forced-host CPU platform placement happens on the consumer
+    thread and a single fetch worker is the only stage — XLA CPU runs an
+    N-device mesh's collectives as N in-process threads, and a
+    concurrent device_put can starve one participant into a rendezvous
+    deadlock (observed as an all-reduce termination timeout → SIGABRT).
+
+  FIFO through every stage: batch order — and therefore training — is
+  unchanged in either shape.
   """
 
   _DONE = object()
 
   def __init__(self, it: Iterator[Batch],
-               place: Callable[[Batch], 'PlacedBatch'], depth: int):
+               place: Callable[[Batch], 'PlacedBatch'], depth: int,
+               place_stage: Optional[bool] = None):
     import queue
     import threading
 
     self._q: 'queue.Queue' = queue.Queue(maxsize=depth)
+    self._host_q: Optional['queue.Queue'] = None
     self._err: Optional[BaseException] = None
     self._stop = threading.Event()
     # Queue telemetry: a depth gauge pinned near 0 plus a climbing
@@ -211,23 +232,69 @@ class _DevicePrefetcher:
     self._m_starved = prefetch_metrics.counter('starvation')
     self._m_starve_ms = prefetch_metrics.histogram('starved_wait_ms')
     self._m_batches = prefetch_metrics.counter('batches')
-    place_in_worker = jax.default_backend() == 'tpu'
-    self._consumer_place = None if place_in_worker else place
+    if place_stage is None:
+      place_stage = jax.default_backend() == 'tpu'
+    self._consumer_place = None if place_stage else place
+    self._threads = []
 
-    def worker():
-      try:
-        for batch in it:
-          if self._stop.is_set():
-            return
-          self._q.put(place(batch) if place_in_worker else batch)
-      except BaseException as e:  # surfaced on the consumer side
-        self._err = e
-      finally:
-        self._q.put(self._DONE)
+    if place_stage:
+      host_q: 'queue.Queue' = queue.Queue(maxsize=depth)
+      self._host_q = host_q
+      m_host_depth = prefetch_metrics.gauge('host_queue_depth')
 
-    self._thread = threading.Thread(
-        target=worker, daemon=True, name='t2r-prefetch')
-    self._thread.start()
+      def fetch():
+        try:
+          for batch in it:
+            if self._stop.is_set():
+              return
+            host_q.put(batch)
+            m_host_depth.set(host_q.qsize())
+        except BaseException as e:  # surfaced on the consumer side
+          self._err = e
+        finally:
+          host_q.put(self._DONE)
+
+      def placer():
+        try:
+          while not self._stop.is_set():
+            item = host_q.get()
+            if item is self._DONE:
+              return
+            # Placement overlaps the device step and the upstream
+            # decode; its time shows up as placement_overlapped_ms in
+            # the breakdown (off the dispatch critical path).
+            with tracing.span('trainer/place_stage', annotate=False):
+              placed = place(item)
+            self._q.put(placed)
+        except BaseException as e:
+          if self._err is None:
+            self._err = e
+        finally:
+          self._q.put(self._DONE)
+
+      self._threads = [
+          threading.Thread(target=fetch, daemon=True,
+                           name='t2r-prefetch-fetch'),
+          threading.Thread(target=placer, daemon=True,
+                           name='t2r-prefetch-place'),
+      ]
+    else:
+      def worker():
+        try:
+          for batch in it:
+            if self._stop.is_set():
+              return
+            self._q.put(batch)
+        except BaseException as e:  # surfaced on the consumer side
+          self._err = e
+        finally:
+          self._q.put(self._DONE)
+
+      self._threads = [
+          threading.Thread(target=worker, daemon=True, name='t2r-prefetch')
+      ]
+    for thread in self._threads:
+      thread.start()
 
   def __iter__(self):
     return self
@@ -263,28 +330,36 @@ class _DevicePrefetcher:
     import time
 
     self._stop.set()
-    # Keep draining until the worker exits: a single drain is not enough
-    # (the worker's blocked put() refills the slot, and its final
-    # put(_DONE) could block forever on a depth-1 queue). Bounded: if the
-    # worker is stuck inside the input iterator's next() (stalled
-    # producer), it can never observe the stop event — abandon the daemon
-    # thread rather than hang end-of-training shutdown.
+    # Keep draining until the workers exit: a single drain is not enough
+    # (a worker's blocked put() refills the slot, and its final
+    # put(_DONE) could block forever on a depth-1 queue). Both queues
+    # drain — the fetch stage can be blocked on the host queue just as
+    # the placement stage can be on the placed queue. Bounded: a worker
+    # stuck inside the input iterator's next() (stalled producer) can
+    # never observe the stop event — abandon the daemon thread rather
+    # than hang end-of-training shutdown.
     deadline = time.monotonic() + timeout
-    while self._thread.is_alive():
+    while any(t.is_alive() for t in self._threads):
       if time.monotonic() > deadline:
         logging.warning(
             'Prefetch worker did not exit within %.1fs (input iterator '
-            'blocked?); abandoning the daemon thread.', timeout)
+            'blocked?); abandoning the daemon thread(s).', timeout)
         break
+      for q in (self._q, self._host_q):
+        if q is None:
+          continue
+        try:
+          q.get(timeout=0.025)
+        except queue.Empty:
+          pass
+    for q in (self._q, self._host_q):
+      if q is None:
+        continue
       try:
-        self._q.get(timeout=0.05)
+        while True:
+          q.get_nowait()
       except queue.Empty:
         pass
-    try:
-      while True:
-        self._q.get_nowait()
-    except queue.Empty:
-      pass
 
 
 def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
@@ -548,6 +623,12 @@ class Trainer:
           keep_period=config.keep_checkpoint_period,
           save_interval_steps=config.save_interval_steps,
           async_save=config.async_checkpoints)
+    # Opt-in live metrics endpoint (config port or T2R_METRICSZ_PORT
+    # env); process-global and idempotent, so a second Trainer in the
+    # same process reuses the running server.
+    from tensor2robot_tpu.observability import metricsz
+
+    metricsz.maybe_start(config.metricsz_port)
 
   # ------------------------------------------------------------- properties
 
@@ -1050,6 +1131,35 @@ class Trainer:
 # ------------------------------------------------------------ driver entry
 
 
+EVAL_STATE_FILENAME = 'eval_state.json'
+
+
+def _read_continuous_eval_state(model_dir: str) -> Optional[int]:
+  """Last step the continuous evaluator finished, or None."""
+  if not model_dir:
+    return None
+  import json
+
+  try:
+    with open(os.path.join(model_dir, EVAL_STATE_FILENAME)) as f:
+      return int(json.load(f)['last_evaluated_step'])
+  except (OSError, ValueError, KeyError, TypeError):
+    return None
+
+
+def _write_continuous_eval_state(model_dir: str, step: int) -> None:
+  """Atomically persists the evaluator's position (crash/preempt-safe)."""
+  if not model_dir:
+    return
+  import json
+
+  path = os.path.join(model_dir, EVAL_STATE_FILENAME)
+  tmp = path + f'.tmp{os.getpid()}'
+  with open(tmp, 'w') as f:
+    json.dump({'last_evaluated_step': int(step)}, f)
+  os.replace(tmp, path)
+
+
 def provide_input_generator_with_model_information(input_generator, model,
                                                    mode: str):
   """Spec handshake (utils/train_eval.py:101-129)."""
@@ -1170,13 +1280,43 @@ def train_eval_model(model=None,
     # (utils/train_eval.py:550-585). Each step is BACKED UP into the
     # evaluator's own directory before restore so the trainer's retention
     # GC cannot delete it mid-eval (utils/train_eval.py:590-707).
+    #
+    # Preemption-aware (PR-1 follow-up): the loop persists its last
+    # evaluated step to <model_dir>/eval_state.json after every eval, and
+    # a graceful-shutdown request (SIGTERM on a preemptible evaluator —
+    # installed by the Trainer when handle_preemption is on) raises
+    # PreemptedError BETWEEN checkpoints, which the trainer binary
+    # converts to the resumable exit status 42. The restarted evaluator
+    # reads the state and skips already-evaluated checkpoints instead of
+    # re-running (or worse, re-exporting) them.
     metrics = {}
     ckpt_dir = os.path.join(model_dir, 'checkpoints')
     backup_dir = os.path.join(model_dir, ckpt_lib.EVAL_BACKUP_DIRNAME)
+    last_evaluated: Optional[int] = None
+    if use_continuous_eval:
+      last_evaluated = _read_continuous_eval_state(model_dir)
+      if last_evaluated is not None:
+        logging.info(
+            'Continuous eval resuming: checkpoints up to step %d were '
+            'already evaluated.', last_evaluated)
+    shutdown = (trainer._shutdown if trainer._shutdown is not None  # pylint: disable=protected-access
+                else resilience.active_shutdown())
     for step in ckpt_lib.checkpoints_iterator(
         ckpt_dir,
         timeout=eval_timeout_secs,
         stop_after_step=max_train_steps if use_continuous_eval else None):
+      if last_evaluated is not None and step <= last_evaluated:
+        logging.info(
+            'Continuous eval: skipping step %d (already evaluated before '
+            'the restart).', step)
+        continue
+      if shutdown is not None and shutdown.requested:
+        logging.warning(
+            'Graceful shutdown requested; continuous eval exiting '
+            'resumable after step %s.', last_evaluated)
+        if use_continuous_eval and last_evaluated is not None:
+          _write_continuous_eval_state(model_dir, last_evaluated)
+        raise resilience.PreemptedError(last_evaluated or 0)
       backup = ckpt_lib.create_backup_checkpoint_for_eval(
           ckpt_dir, step, backup_dir)
       if backup is None:
@@ -1202,6 +1342,9 @@ def train_eval_model(model=None,
       metrics = trainer.evaluate(eval_iter)
       if exporters:
         run_exporters(metrics)
+      last_evaluated = step
+      if use_continuous_eval:
+        _write_continuous_eval_state(model_dir, step)
       if not use_continuous_eval:
         break
     return metrics

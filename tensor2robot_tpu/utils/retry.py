@@ -192,7 +192,14 @@ class ResilientIterator:
                source,
                budget: ErrorBudget,
                retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
-               backoff: Optional[RetryPolicy] = None):
+               backoff: Optional[RetryPolicy] = None,
+               source_fn: Optional[Callable[[BaseException],
+                                            Optional[str]]] = None):
+    """``source_fn`` (optional) attributes a caught error to a data
+    source label (a file path) for the budget's per-source accounting —
+    callers that KNOW their file set resolve sources more reliably than
+    the budget's generic path-in-message regex, which stays the
+    fallback when ``source_fn`` returns None."""
     if callable(source):
       self._factory: Optional[Callable[[], Iterator]] = source
       self._it = source()
@@ -202,6 +209,7 @@ class ResilientIterator:
     self._budget = budget
     self._retry_on = retry_on
     self._backoff = backoff
+    self._source_fn = source_fn
 
   @property
   def budget(self) -> ErrorBudget:
@@ -217,7 +225,9 @@ class ResilientIterator:
       except StopIteration:
         raise
       except self._retry_on as e:
-        self._budget.record(e)  # raises DataErrorBudgetExceededError when spent
+        source = self._source_fn(e) if self._source_fn is not None else None
+        # record raises DataErrorBudgetExceededError when spent
+        self._budget.record(e, source=source)
         if self._backoff is not None:
           self._backoff.sleep(self._backoff.delay(self._budget.errors - 1))
         if self._factory is not None:

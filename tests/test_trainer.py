@@ -190,8 +190,9 @@ def test_prefetch_depth1_close_terminates_worker():
   prefetcher = _DevicePrefetcher(src, lambda b: b, depth=1)
   next(iter(prefetcher))  # consume one so the worker is mid-stream
   prefetcher.close()
-  prefetcher._thread.join(timeout=5)  # pylint: disable=protected-access
-  assert not prefetcher._thread.is_alive()  # pylint: disable=protected-access
+  for thread in prefetcher._threads:  # pylint: disable=protected-access
+    thread.join(timeout=5)
+    assert not thread.is_alive()
   assert threading.active_count() < 50
 
 
@@ -846,8 +847,9 @@ def test_prefetcher_delivers_worker_error_promptly():
 
   prefetcher = _DevicePrefetcher(
       source(), place=lambda b: (b, False), depth=4)
-  prefetcher._thread.join(timeout=5)  # pylint: disable=protected-access
-  assert not prefetcher._thread.is_alive()  # pylint: disable=protected-access
+  for thread in prefetcher._threads:  # pylint: disable=protected-access
+    thread.join(timeout=5)
+    assert not thread.is_alive()
   # Both good batches are staged, but the error beats them out.
   with pytest.raises(IOError, match='pipeline died'):
     next(iter(prefetcher))

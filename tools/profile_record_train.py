@@ -213,8 +213,11 @@ def main():
   if args.json:
     import json
 
+    from tensor2robot_tpu.data import engine as engine_lib
+
     best_prefetch = min(results, key=lambda p: results[p]['median'])
     best = results[best_prefetch]
+    decision = engine_lib.last_decision()
     print(json.dumps({
         'workload': args.workload,
         'batch_size': args.batch,
@@ -224,6 +227,10 @@ def main():
         'device_ms_per_step': round(device_ms, 1),
         'fraction_of_device_floor': round(device_ms / best['median'], 3),
         'prefetch': best_prefetch,
+        # The input engine's autotune outcome for this run (workers /
+        # ring depth), so BENCH artifacts record the pipeline shape
+        # beside the throughput it produced.
+        'engine_autotune': decision.as_dict() if decision else None,
     }))
     return
   print(f'device-resident step: {device_ms:.1f} ms')

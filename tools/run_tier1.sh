@@ -2,5 +2,11 @@
 # Tier-1 verify: the ROADMAP.md invocation, verbatim. Run from the repo
 # root (or anywhere: the script cd's there first). Exit status is
 # pytest's; DOTS_PASSED echoes the passed-test count the driver tracks.
+#
+# Extra arguments pass straight through to pytest, so a subset runs in
+# isolation with the same harness, e.g.:
+#   tools/run_tier1.sh -k engine            # expression filter
+#   tools/run_tier1.sh -m engine            # marker filter
+#   tools/run_tier1.sh tests/test_input_engine.py
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
