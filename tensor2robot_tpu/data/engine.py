@@ -210,9 +210,21 @@ class ParallelBatchEngine:
   per ``batch_size`` records, final short batch dropped
   (``drop_remainder`` parity). ``delivered`` counts yielded batches —
   the engine's checkpointable stream position.
+
+  ``reautotune=True`` re-evaluates the worker count MID-RUN: at every
+  trainer log-window crossing (``trainer/breakdown_windows``) the engine
+  re-reads the live breakdown signals — ``trainer/input_bound_fraction``
+  and the prefetch-starvation delta over the window — and grows/shrinks
+  its worker pool, at most one change per window. The stream stays
+  byte-identical through any resize (ticket order is the only ordering
+  authority); bounds are [1, ring_depth - 1], so a running pipeline
+  never collapses to the thread-less serial path nor outgrows its ring.
+  Decision history is published as ``data/engine/reautotune/*`` and kept
+  on :attr:`decision_history`.
   """
 
   _DONE = object()
+  _RETIRE = object()  # poison pill retiring exactly one worker (resize)
 
   def __init__(self,
                records: Iterable[bytes],
@@ -220,7 +232,10 @@ class ParallelBatchEngine:
                batch_size: int,
                num_workers: int,
                ring_depth: Optional[int] = None,
-               reuse_buffers: bool = False):
+               reuse_buffers: bool = False,
+               reautotune: bool = False,
+               cpus: Optional[int] = None,
+               lease_timeout: float = 5.0):
     if batch_size <= 0:
       raise ValueError(f'batch_size must be positive, got {batch_size}')
     self._records = iter(records)
@@ -241,6 +256,27 @@ class ParallelBatchEngine:
     if ring_depth is None:
       ring_depth = 2 * self._num_workers
     self._ring_depth = max(int(ring_depth), self._num_workers + 1)
+    # Mid-run re-autotune state: one evaluation per closed breakdown
+    # window, keyed off the trainer's window counter; starvation is read
+    # as a per-window delta (the counter is cumulative — an incident an
+    # hour ago must not pin the pool grown forever).
+    self._cpus = cpus
+    self._reautotune_enabled = bool(reautotune)
+    self._max_workers = self._ring_depth - 1
+    self._worker_seq = self._num_workers
+    self._workers_lock = threading.Lock()
+    self._lease_lock = threading.Lock()
+    self._lease_cond = threading.Condition(self._lease_lock)
+    self._lease_timeout = float(lease_timeout)
+    self._m_windows = metrics_lib.counter('trainer/breakdown_windows')
+    self._last_window = self._m_windows.value
+    self._starve_counter = metrics_lib.counter('trainer/prefetch/starvation')
+    self._last_starvation = self._starve_counter.value
+    self._m_workers = self._metrics.gauge('workers')
+    self._m_reauto_windows = self._metrics.counter('reautotune/windows')
+    self._m_reauto_changes = self._metrics.counter('reautotune/changes')
+    self._m_reauto_target = self._metrics.gauge('reautotune/target_workers')
+    self.decision_history: List[dict] = []
     # Outstanding-ticket bound: acquired per issued ticket, released when
     # the consumer is done with the batch (delivery, or — in ring mode —
     # the explicit release that frees the slot for reuse).
@@ -314,13 +350,22 @@ class ParallelBatchEngine:
         self._end_seq = seq
         self._cond.notify_all()
     finally:
-      for _ in range(self._num_workers):
-        self._ticket_q.put(self._DONE)
+      # One sentinel; workers re-put it as they exit (the pool may have
+      # been resized since these tickets were issued).
+      self._ticket_q.put(self._DONE)
 
   def _worker(self) -> None:
     while True:
       item = self._ticket_q.get()
-      if item is self._DONE or self._stop.is_set():
+      if item is self._RETIRE:
+        return  # mid-run shrink: exactly one worker exits
+      if item is self._DONE:
+        # Propagate end-of-stream to sibling workers: the issuer puts
+        # ONE sentinel, so shutdown is correct for any worker count the
+        # pool was resized to since tickets started.
+        self._ticket_q.put(self._DONE)
+        return
+      if self._stop.is_set():
         return
       seq, records = item
       slot = None
@@ -346,6 +391,68 @@ class ParallelBatchEngine:
         self._m_reorder_depth.set(len(self._results))
         self._cond.notify_all()
 
+  # ----------------------------------------------------- mid-run autotune
+
+  def _maybe_reautotune(self) -> None:
+    """One worker-count re-evaluation per closed breakdown window."""
+    if not self._reautotune_enabled:
+      return
+    windows = self._m_windows.value
+    if windows == self._last_window:
+      return
+    self._last_window = windows
+    self._m_reauto_windows.inc()
+    starvation = self._starve_counter.value
+    starve_delta = starvation - self._last_starvation
+    self._last_starvation = starvation
+    if (metrics_lib.counter('trainer/dispatches').value <
+        _MIN_DISPATCHES_FOR_SIGNALS):
+      return
+    input_bound = metrics_lib.gauge('trainer/input_bound_fraction').value
+    cpus = available_cpus() if self._cpus is None else int(self._cpus)
+    if input_bound < _COMPUTE_BOUND_FRACTION and starve_delta == 0:
+      target = 1  # compute-bound: extra pipeline threads only contend
+    elif input_bound >= _INPUT_BOUND_FRACTION or starve_delta > 0:
+      target = min(max(cpus - 1, 1), _INPUT_BOUND_MAX_WORKERS)
+    else:
+      target = self._num_workers
+    target = max(1, min(target, self._max_workers))
+    self._m_reauto_target.set(target)
+    if target != self._num_workers:
+      self._set_num_workers(target, input_bound, starve_delta)
+
+  def _set_num_workers(self, target: int, input_bound: float,
+                       starvation: int) -> None:
+    """Grows (spawn) or shrinks (retire pills) the worker pool in place.
+
+    Safe mid-stream: tickets/reorder carry all ordering state, so the
+    delivered stream is byte-identical across any resize. Retire pills
+    queue FIFO behind outstanding tickets — a shrinking pool finishes
+    the work it already accepted.
+    """
+    with self._workers_lock:
+      old = self._num_workers
+      if target == old:
+        return
+      if target > old:
+        for _ in range(target - old):
+          t = threading.Thread(target=self._worker, daemon=True,
+                               name=f't2r-engine-worker-{self._worker_seq}')
+          self._worker_seq += 1
+          self._threads.append(t)
+          t.start()
+      else:
+        for _ in range(old - target):
+          self._ticket_q.put(self._RETIRE)
+      self._num_workers = target
+    self._m_workers.set(target)
+    self._m_reauto_changes.inc()
+    decision = {'window': self._last_window, 'from': old, 'to': target,
+                'input_bound_fraction': round(float(input_bound), 4),
+                'starvation': int(starvation)}
+    self.decision_history.append(decision)
+    logging.info('Input engine re-autotune: %s', decision)
+
   # ------------------------------------------------------------ consumer
 
   def __iter__(self) -> Iterator[Any]:
@@ -354,13 +461,24 @@ class ParallelBatchEngine:
   def __next__(self) -> Any:
     if self._num_workers == 0:
       return self._serial_next()
-    if (self._reuse and self._lease_order and
-        len(self._lease_order) >= self._ring_depth):
-      # Every slot (and backpressure permit) is leased out: no worker can
-      # ever produce the next batch. Failing loudly beats deadlocking.
-      raise RuntimeError(
-          f'all {self._ring_depth} ring slots are leased; call release() '
-          f'once per consumed batch before requesting the next one')
+    self._maybe_reautotune()
+    if self._reuse:
+      # A full ring is TRANSIENT when someone releases asynchronously
+      # (the trainer's placement stage frees each lease at transfer
+      # completion, from its own thread) — wait briefly for that. Only a
+      # ring nobody will ever release (a consumer ignoring the lease
+      # contract) stays full: fail loudly then, deadlocking never.
+      deadline = time.monotonic() + self._lease_timeout
+      with self._lease_cond:
+        while len(self._lease_order) >= self._ring_depth:
+          remaining = deadline - time.monotonic()
+          if remaining <= 0:
+            raise RuntimeError(
+                f'all {self._ring_depth} ring slots are leased (no '
+                f'release() for {self._lease_timeout:.1f}s); call '
+                f'release() once per consumed batch before requesting '
+                f'the next one')
+          self._lease_cond.wait(timeout=remaining)
     t0 = time.perf_counter()
     with self._cond:
       while (self._next_seq not in self._results and
@@ -379,7 +497,8 @@ class ParallelBatchEngine:
       raise result.exc
     if slot is not None:
       # Ring mode: the permit (and the slot) stay held until release().
-      self._lease_order.append(slot)
+      with self._lease_lock:
+        self._lease_order.append(slot)
     else:
       self._sem.release()
     self.delivered += 1
@@ -407,11 +526,17 @@ class ParallelBatchEngine:
     the slot to the worker pool (and its backpressure permit), after
     which those arrays WILL be overwritten. Call once per consumed batch,
     after its contents are copied/placed. No-op without
-    ``reuse_buffers``.
+    ``reuse_buffers``. Thread-safe: the trainer's placement stage
+    releases from its own thread while the fetch stage consumes.
     """
-    if self._num_workers == 0 or not self._reuse or not self._lease_order:
+    if self._num_workers == 0 or not self._reuse:
       return
-    self._free_slots.put(self._lease_order.pop(0))
+    with self._lease_cond:
+      if not self._lease_order:
+        return
+      slot = self._lease_order.pop(0)
+      self._lease_cond.notify_all()
+    self._free_slots.put(slot)
     self._sem.release()
 
   # ------------------------------------------------------------ lifecycle

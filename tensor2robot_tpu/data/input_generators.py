@@ -353,7 +353,8 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                open_retries: int = 3,
                engine_workers: Optional[int] = None,
                engine_ring_depth: Optional[int] = None,
-               reuse_batch_buffers: bool = False):
+               reuse_batch_buffers: bool = False,
+               engine_reautotune: Optional[bool] = None):
     super().__init__(batch_size, error_budget=error_budget)
     if not file_patterns:
       raise ValueError('Provide file_patterns.')
@@ -368,8 +369,17 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     self._engine_ring_depth = engine_ring_depth
     # Ring-slot reuse: delivered image arrays are views of recycled
     # buffers and the CONSUMER must call engine.release() per batch —
-    # only for callers that honor that contract (data/engine.py).
+    # the Trainer does this automatically at H2D transfer completion
+    # (its placement stage / inline place path detects the release hook);
+    # other callers must honor the contract themselves (data/engine.py).
     self._reuse_batch_buffers = reuse_batch_buffers
+    # Mid-run re-autotune (data/engine.py): re-evaluate the worker count
+    # at trainer log-window crossings from the live breakdown signals.
+    # None = on exactly when the worker count itself was autotuned — an
+    # explicit engine_workers is an operator decision the engine honors.
+    self._engine_reautotune = (engine_workers is None
+                               if engine_reautotune is None
+                               else bool(engine_reautotune))
 
   def _records(self, mode: str):
     """Yields raw serialized examples forever (train) or one epoch.
@@ -469,7 +479,8 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
         records, parse_fn, batch_size,
         num_workers=decision.num_workers,
         ring_depth=decision.ring_depth,
-        reuse_buffers=self._reuse_batch_buffers)
+        reuse_buffers=self._reuse_batch_buffers,
+        reautotune=self._engine_reautotune)
 
   def create_checkpointable_iterator(
       self, mode: str, batch_size: Optional[int] = None
@@ -527,6 +538,11 @@ class _CheckpointableEngineIterator:
       batch = next(self._engine)
       self._delivered += 1
       return batch
+
+  def release(self) -> None:
+    """Ring-buffer lease release, delegated to the engine (the trainer
+    detects this hook on its input iterator — see ``Trainer.train``)."""
+    self._engine.release()
 
   def save(self, path_prefix: str) -> str:
     path = path_prefix + '.json'

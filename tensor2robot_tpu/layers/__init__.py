@@ -8,6 +8,16 @@ from tensor2robot_tpu.layers.mdn import (
     get_mixture_distribution,
     mdn_nll_loss,
 )
+from tensor2robot_tpu.layers.remat import (
+    REMAT_CONV_TOWERS,
+    REMAT_FULL,
+    REMAT_NONE,
+    REMAT_POLICIES,
+    checkpoint_policy,
+    remat_method,
+    remat_module,
+    validate_remat_policy,
+)
 from tensor2robot_tpu.layers.resnet import (
     BLOCK_SIZES,
     FilmResNet,

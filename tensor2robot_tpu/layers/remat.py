@@ -1,0 +1,93 @@
+"""Activation-rematerialization policies for the conv towers.
+
+Sublinear activation checkpointing (Chen et al. 2016) as a declarative
+knob: a model picks a *policy name* and the layers apply ``jax.checkpoint``
+(via flax's lifted ``nn.remat``) around their tower blocks. Activation
+memory then trades against recompute on the MXU — the lever that moves
+the HBM batch ceiling (PERF_NOTES: the qtopt batch curve collapses 8.6×
+at batch 96 from HBM pressure while the MXU sits at ~22% utilization).
+
+Policies (``REMAT_POLICIES``):
+
+* ``none`` — status quo: XLA keeps every activation the backward needs.
+* ``conv_towers`` — each tower block is a checkpoint region; inside a
+  region only results of *weight-stationary* dots (no batch dimensions —
+  cheap, e.g. FiLM projections) are saved, so the big [B, H, W, C]
+  conv/BN activations are recomputed from the block boundary during the
+  backward pass. Activation memory drops from O(depth) blocks to
+  O(1) block + boundaries; recompute adds roughly one extra forward of
+  MXU work, which the measured ~22% MFU ceiling has headroom for.
+* ``full`` — like ``conv_towers`` but nothing inside a region is saved
+  (``nothing_saveable``): maximum memory savings, maximum recompute.
+
+Wrapping happens with flax lifted transforms, so parameter/collection
+trees are IDENTICAL with and without remat (checkpoints interchange;
+pinned by tests/test_memory_scaling.py), and the forward/backward values
+are exactly equal — remat changes scheduling, not math.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+REMAT_NONE = 'none'
+REMAT_CONV_TOWERS = 'conv_towers'
+REMAT_FULL = 'full'
+REMAT_POLICIES = (REMAT_NONE, REMAT_CONV_TOWERS, REMAT_FULL)
+
+
+def validate_remat_policy(policy: Optional[str]) -> str:
+  """Normalizes/validates a policy name (None → 'none')."""
+  policy = REMAT_NONE if policy is None else str(policy)
+  if policy not in REMAT_POLICIES:
+    raise ValueError(
+        f'Unknown remat_policy {policy!r}; expected one of {REMAT_POLICIES}.')
+  return policy
+
+
+def checkpoint_policy(policy: Optional[str]):
+  """The ``jax.checkpoint`` policy for a name (None when remat is off)."""
+  import jax
+
+  policy = validate_remat_policy(policy)
+  if policy == REMAT_NONE:
+    return None
+  if policy == REMAT_CONV_TOWERS:
+    return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+  return jax.checkpoint_policies.nothing_saveable
+
+
+def remat_module(module_cls, policy: Optional[str], static_argnums=()):
+  """Wraps a flax Module class in ``nn.remat`` per the named policy.
+
+  ``static_argnums`` index into ``__call__``'s arguments with ``self`` at
+  0 (flax's convention) — pass the indices of python-control-flow args
+  like ``train``. Returns ``module_cls`` untouched for policy 'none', so
+  call sites can apply it unconditionally.
+  """
+  policy = validate_remat_policy(policy)
+  if policy == REMAT_NONE:
+    return module_cls
+  import flax.linen as nn
+
+  return nn.remat(
+      module_cls, policy=checkpoint_policy(policy),
+      static_argnums=tuple(static_argnums))
+
+
+def remat_method(fn, policy: Optional[str], static_argnums=()):
+  """``nn.remat`` over an UNBOUND Module method (call as ``fn(self, ...)``).
+
+  For towers whose blocks are built inline in a ``@nn.compact``
+  ``__call__`` (e.g. ``vision_layers.ImagesToFeaturesModel``), wrapping a
+  helper method keeps the parameter tree byte-identical to the unwrapped
+  module — the lifted transform shares the caller's scope.
+  """
+  policy = validate_remat_policy(policy)
+  if policy == REMAT_NONE:
+    return fn
+  import flax.linen as nn
+
+  return nn.remat(
+      fn, policy=checkpoint_policy(policy),
+      static_argnums=tuple(static_argnums))

@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.remat import remat_module
+
 BLOCK_SIZES = {
     18: [2, 2, 2, 2],
     34: [3, 4, 6, 3],
@@ -163,6 +165,11 @@ class ResNet(nn.Module):
   # statistics in float32 internally). None → follow input/param promotion
   # (float32 params ⇒ float32 compute).
   dtype: Optional[Any] = None
+  # Activation remat around each residual block (layers/remat.py):
+  # 'conv_towers' / 'full' recompute block activations in the backward
+  # pass instead of keeping all of them live — same params, same values,
+  # less HBM. 'none' is the historical behavior.
+  remat_policy: str = 'none'
 
   @nn.compact
   def __call__(self,
@@ -173,6 +180,9 @@ class ResNet(nn.Module):
     bottleneck = self.resnet_size >= _BOTTLENECK_MIN_SIZE
     if film_gamma_betas is None:
       film_gamma_betas = [[None] * n for n in block_sizes]
+    # `train` (arg 3, counting self) drives python control flow inside
+    # the block, so it must stay static under jax.checkpoint.
+    block_cls = remat_module(_Block, self.remat_policy, static_argnums=(3,))
     endpoints: Dict[str, Any] = {}
 
     net = images if self.dtype is None else images.astype(self.dtype)
@@ -198,7 +208,7 @@ class ResNet(nn.Module):
       filters = self.num_filters * (2**i)
       strides = 1 if i == 0 else 2
       for j in range(num_blocks):
-        net = _Block(
+        net = block_cls(
             filters=filters,
             strides=strides if j == 0 else 1,
             bottleneck=bottleneck,
@@ -271,6 +281,7 @@ class FilmResNet(nn.Module):
   version: int = 2
   enabled_block_layers: Optional[Sequence[bool]] = None
   dtype: Optional[Any] = None
+  remat_policy: str = 'none'
 
   @nn.compact
   def __call__(self, images, embedding=None, train: bool = False):
@@ -279,6 +290,7 @@ class FilmResNet(nn.Module):
         num_classes=self.num_classes,
         version=self.version,
         dtype=self.dtype,
+        remat_policy=self.remat_policy,
         name='resnet')
     film_gamma_betas = None
     if embedding is not None:

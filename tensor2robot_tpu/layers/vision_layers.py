@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.remat import remat_method
 from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
 
 _NUM_CHANNELS_PER_BLOCK = 32
@@ -54,6 +55,26 @@ class ImagesToFeaturesModel(nn.Module):
   num_blocks: int = 5
   num_output_maps: int = 32
   use_batch_norm: bool = False  # reference default: layer norm
+  # Activation remat per conv block (layers/remat.py): recompute block
+  # activations during backward instead of keeping them live. Parameter
+  # tree and numerics are unchanged ('none' = historical behavior).
+  remat_policy: str = 'none'
+
+  def _conv_block(self, net, gamma, beta, i, train):
+    """One conv→norm→FiLM→relu block (the remat unit)."""
+    stride = 2 if i in (0, 1) else 1
+    net = nn.Conv(
+        features=_NUM_CHANNELS_PER_BLOCK,
+        kernel_size=(self.filter_size, self.filter_size),
+        strides=(stride, stride),
+        padding='VALID',
+        kernel_init=nn.initializers.xavier_uniform(),
+        bias_init=nn.initializers.constant(0.01),
+        name=f'conv{i + 2}')(net)
+    net = self._normalize(net, train, scale=False, name=f'norm{i + 2}')
+    if gamma is not None:
+      net = film_modulation(net, gamma, beta)
+    return nn.relu(net)
 
   @nn.compact
   def __call__(self,
@@ -72,21 +93,19 @@ class ImagesToFeaturesModel(nn.Module):
       split = jnp.split(film_output_params, 2 * self.num_blocks, axis=-1)
       gammas, betas = split[:self.num_blocks], split[self.num_blocks:]
 
+    # Method-form remat keeps the blocks' inline parameter names
+    # (conv{i}/norm{i} at this module's top level) byte-identical to the
+    # unwrapped tower. `i` (4) names modules and `train` (5) is python
+    # control flow — both static under jax.checkpoint.
+    block = remat_method(
+        ImagesToFeaturesModel._conv_block, self.remat_policy,
+        static_argnums=(4, 5))
+
     net = images
     for i in range(self.num_blocks):
-      stride = 2 if i in (0, 1) else 1
-      net = nn.Conv(
-          features=channels,
-          kernel_size=(self.filter_size, self.filter_size),
-          strides=(stride, stride),
-          padding='VALID',
-          kernel_init=nn.initializers.xavier_uniform(),
-          bias_init=nn.initializers.constant(0.01),
-          name=f'conv{i + 2}')(net)
-      net = self._normalize(net, train, scale=False, name=f'norm{i + 2}')
-      if gammas is not None:
-        net = film_modulation(net, gammas[i], betas[i])
-      net = nn.relu(net)
+      net = block(self, net,
+                  None if gammas is None else gammas[i],
+                  None if betas is None else betas[i], i, train)
 
     net = nn.Conv(
         features=self.num_output_maps,

@@ -113,6 +113,15 @@ class AbstractT2RModel(ModelInterface):
   * ``init_from_checkpoint_fn``: ``fn(params, model_state) -> (params,
     model_state)`` warm-start hook, the equivalent of
     ``default_init_from_checkpoint_fn`` (``abstract_model.py:88-118``).
+  * ``remat_policy``: activation-rematerialization policy applied around
+    this model's conv towers (``'none' | 'conv_towers' | 'full'``, see
+    :mod:`tensor2robot_tpu.layers.remat`). Trades activation HBM against
+    recompute so larger (micro)batches fit past the memory cliff; the
+    parameter tree and numerics are unchanged — only backward-pass
+    scheduling differs. Models that build remat-capable towers
+    (``layers.resnet.ResNet``, ``layers.vision_layers.
+    ImagesToFeaturesModel``, the qtopt/grasp2vec networks) thread this
+    through; models without towers accept and ignore it.
   """
 
   def __init__(self,
@@ -121,7 +130,10 @@ class AbstractT2RModel(ModelInterface):
                device_type: str = DEVICE_TYPE_TPU,
                use_avg_model_params: bool = False,
                avg_model_params_decay: float = 0.9999,
-               init_from_checkpoint_fn: Optional[Callable] = None):
+               init_from_checkpoint_fn: Optional[Callable] = None,
+               remat_policy: str = 'none'):
+    from tensor2robot_tpu.layers import remat as remat_lib
+
     self._preprocessor_cls = preprocessor_cls
     self._create_optimizer_fn = create_optimizer_fn
     if device_type not in (DEVICE_TYPE_CPU, DEVICE_TYPE_GPU, DEVICE_TYPE_TPU):
@@ -130,6 +142,7 @@ class AbstractT2RModel(ModelInterface):
     self.use_avg_model_params = use_avg_model_params
     self.avg_model_params_decay = avg_model_params_decay
     self.init_from_checkpoint_fn = init_from_checkpoint_fn
+    self._remat_policy = remat_lib.validate_remat_policy(remat_policy)
 
   # ------------------------------------------------------------------ device
 
@@ -140,6 +153,11 @@ class AbstractT2RModel(ModelInterface):
   @property
   def is_device_tpu(self) -> bool:
     return self._device_type == DEVICE_TYPE_TPU
+
+  @property
+  def remat_policy(self) -> str:
+    """Activation-remat policy name ('none' | 'conv_towers' | 'full')."""
+    return self._remat_policy
 
   @property
   def compute_dtype(self):

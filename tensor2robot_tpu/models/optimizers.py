@@ -94,6 +94,26 @@ def with_gradient_clipping(
   return optax.chain(*transforms)
 
 
+def with_gradient_accumulation(
+    optimizer: optax.GradientTransformation,
+    accumulate_steps: int) -> optax.GradientTransformation:
+  """Optimizer-level accumulation ACROSS dispatches (``optax.MultiSteps``).
+
+  Complements ``TrainerConfig.grad_accum_microbatches``, which slices one
+  host batch into microbatches INSIDE the jitted step (the memory lever —
+  activations never exist at the full effective batch). This wrapper
+  instead averages gradients over ``accumulate_steps`` consecutive host
+  batches and applies one real update per window — useful when the
+  effective batch should exceed what the host pipeline can deliver as a
+  single batch. The trainer's ``state.step`` still advances every
+  dispatch, so logging/checkpoint cadence is unchanged; only every
+  ``accumulate_steps``-th dispatch moves the params.
+  """
+  if accumulate_steps <= 1:
+    return optimizer
+  return optax.MultiSteps(optimizer, every_k_schedule=accumulate_steps)
+
+
 def default_create_optimizer_fn() -> optax.GradientTransformation:
   """The reference default: Adam at 1e-4 (abstract_model.py:168-178)."""
   return create_adam_optimizer()

@@ -10,6 +10,10 @@
 * :mod:`~tensor2robot_tpu.observability.metricsz` — opt-in
   ``GET /metricsz`` HTTP endpoint serving the live ``report()`` JSON for
   fleet scraping (``TrainerConfig.metricsz_port`` / ``T2R_METRICSZ_PORT``).
+* :mod:`~tensor2robot_tpu.observability.memory` — device (HBM) memory
+  telemetry: allocator ``memory_stats()`` published as
+  ``device/memory/*`` gauges, train scalars, and the
+  ``device_memory_peak_mb`` readings BENCH batch-curve points record.
 
 The trainer's per-dispatch step-time breakdown (host wait / H2D
 placement / device step / callbacks, ``examples_per_sec``,
@@ -17,7 +21,10 @@ placement / device step / callbacks, ``examples_per_sec``,
 ``train/trainer.py`` and the README "Observability" section.
 """
 
-from tensor2robot_tpu.observability import metrics, metricsz, tracing
+from tensor2robot_tpu.observability import memory, metrics, metricsz, tracing
+from tensor2robot_tpu.observability.memory import (device_memory_peak_mb,
+                                                   device_memory_stats,
+                                                   memory_scalars)
 from tensor2robot_tpu.observability.metrics import (Counter, Gauge,
                                                     Histogram, Registry)
 from tensor2robot_tpu.observability.tracing import (capture,
@@ -25,6 +32,8 @@ from tensor2robot_tpu.observability.tracing import (capture,
                                                     step_annotation)
 
 __all__ = [
-    'metrics', 'metricsz', 'tracing', 'Counter', 'Gauge', 'Histogram',
-    'Registry', 'capture', 'dump_chrome_trace', 'span', 'step_annotation',
+    'memory', 'metrics', 'metricsz', 'tracing', 'Counter', 'Gauge',
+    'Histogram', 'Registry', 'capture', 'device_memory_peak_mb',
+    'device_memory_stats', 'dump_chrome_trace', 'memory_scalars', 'span',
+    'step_annotation',
 ]

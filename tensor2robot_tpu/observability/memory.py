@@ -1,0 +1,105 @@
+"""Device (HBM) memory telemetry: ``memory_stats()`` → registry gauges.
+
+The qtopt batch curve collapses 8.6× between batch 64 and 96 — an
+HBM-pressure cliff that, until now, could only be *inferred* from
+throughput. This module reads the allocator's own accounting
+(``jax.local_devices()[0].memory_stats()``: ``bytes_in_use``,
+``peak_bytes_in_use``, ``largest_alloc_size``, ``bytes_limit`` on TPU
+backends) and publishes it three ways:
+
+* registry gauges under ``device/memory/*`` (``metrics.report()``,
+  ``/metricsz``, BENCH observability_report);
+* train scalars (``memory/device_peak_mb`` …) merged at log-window
+  crossings by the trainer, so TensorBoard shows memory beside
+  throughput with zero call-site changes;
+* one-shot reads for ``bench.py`` / ``tools/measure_baselines.py`` so
+  every batch-curve point carries ``device_memory_peak_mb`` — the cliff
+  is pinned to bytes in the artifact, not inferred from a throughput
+  collapse.
+
+CPU backends return no stats (``memory_stats()`` is None/empty there);
+every entry point degrades to None/{} rather than raising, so the same
+code runs in tier-1 CPU tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+# The stats worth publishing (allocator keys as reported by PJRT/TFRT
+# backends). Other keys (num_allocs, ...) stay readable via raw stats.
+_GAUGE_KEYS = ('bytes_in_use', 'peak_bytes_in_use', 'largest_alloc_size',
+               'bytes_limit', 'bytes_reserved')
+
+SCOPE = 'device/memory'
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+  """Raw allocator stats for ``device`` (default: first local device).
+
+  None when the backend exposes none (CPU) or jax is unavailable.
+  """
+  try:
+    import jax
+
+    if device is None:
+      device = jax.local_devices()[0]
+    stats = getattr(device, 'memory_stats', lambda: None)()
+  except Exception:  # pylint: disable=broad-except
+    return None
+  if not stats:
+    return None
+  return {k: int(v) for k, v in stats.items()
+          if isinstance(v, (int, float))}
+
+
+def record_memory_gauges(device=None) -> Dict[str, int]:
+  """Publishes the known stats as ``device/memory/*`` gauges.
+
+  Returns the published subset ({} when unavailable). Cheap (one host
+  call into the runtime), safe to call at every log window.
+  """
+  stats = device_memory_stats(device)
+  if not stats:
+    return {}
+  scope = metrics_lib.scope(SCOPE)
+  out = {}
+  for key in _GAUGE_KEYS:
+    if key in stats:
+      scope.gauge(key).set(stats[key])
+      out[key] = stats[key]
+  return out
+
+
+def memory_scalars(device=None) -> Dict[str, float]:
+  """Train-scalar view (MB) the trainer merges at log crossings.
+
+  ``memory/device_peak_mb`` is the allocator's high-water mark — the
+  number that decides whether a batch size fits; ``memory/device_mb`` is
+  live bytes at the read. Empty on stat-less backends so the scalar
+  schema never carries fake zeros.
+  """
+  stats = record_memory_gauges(device)
+  if not stats:
+    return {}
+  out: Dict[str, float] = {}
+  if 'peak_bytes_in_use' in stats:
+    out['memory/device_peak_mb'] = stats['peak_bytes_in_use'] / 1e6
+  if 'bytes_in_use' in stats:
+    out['memory/device_mb'] = stats['bytes_in_use'] / 1e6
+  if 'bytes_limit' in stats and stats['bytes_limit']:
+    out['memory/device_limit_mb'] = stats['bytes_limit'] / 1e6
+    if 'peak_bytes_in_use' in stats:
+      out['memory/device_peak_fraction'] = (
+          stats['peak_bytes_in_use'] / stats['bytes_limit'])
+  return out
+
+
+def device_memory_peak_mb(device=None) -> Optional[float]:
+  """Peak HBM bytes in use, in MB (None when the backend has no stats)."""
+  stats = device_memory_stats(device)
+  if not stats or 'peak_bytes_in_use' not in stats:
+    return None
+  return stats['peak_bytes_in_use'] / 1e6

@@ -124,6 +124,39 @@ def replicated(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P())
 
 
+def microbatch_split(tree: Any, num_microbatches: int) -> Any:
+  """Reshapes batch leaves ``[B, ...]`` → ``[M, B/M, ...]`` for grad accum.
+
+  The microbatch axis (dim 0 after the split) stays UNSHARDED — it is the
+  ``lax.scan`` axis of the gradient-accumulation step — while the
+  per-microbatch batch dim keeps the normal batch-axis sharding (GSPMD
+  propagates it through the reshape; each microbatch still spans the
+  data×fsdp axes). This mirrors ``stacked_batch_sharding``'s convention
+  for ``steps_per_dispatch`` groups, so ``K`` (scan over host batches)
+  and ``M`` (scan over microbatch slices) nest as one program:
+  ``[K, B, ...]`` → per-step ``[B, ...]`` → ``[M, B/M, ...]``.
+
+  Runs inside jit (pure reshape, no data movement on the host). ``B``
+  must divide by ``num_microbatches``; the error names the offending
+  leaf. For sharded batches, ``B / M`` should remain divisible by the
+  product of the batch mesh axes or GSPMD inserts a reshard.
+  """
+  if num_microbatches <= 1:
+    return tree
+
+  def split(path, x):
+    shape = tuple(x.shape)
+    if not shape or shape[0] % num_microbatches:
+      raise ValueError(
+          f'grad_accum_microbatches={num_microbatches} must divide the '
+          f'batch dim; got shape {shape} at '
+          f'{jax.tree_util.keystr(path)}.')
+    return x.reshape(
+        (num_microbatches, shape[0] // num_microbatches) + shape[1:])
+
+  return jax.tree_util.tree_map_with_path(split, tree)
+
+
 def batch_shardings_for(mesh: Mesh, tree: Any) -> Any:
   """A matching tree of batch shardings for an arbitrary batch pytree."""
   sharding = batch_sharding(mesh)

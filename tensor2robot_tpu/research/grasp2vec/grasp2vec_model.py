@@ -133,9 +133,11 @@ class Grasp2VecModel(AbstractT2RModel):
     # wholesale TPU cast, tpu_model_wrapper.py:105-118); the embedding
     # vectors come back float32 and the loss head stays float32.
     return (networks.Embedding(resnet_size=self._resnet_size,
-                               dtype=self.compute_dtype),
+                               dtype=self.compute_dtype,
+                               remat_policy=self.remat_policy),
             networks.Embedding(resnet_size=self._resnet_size,
-                               dtype=self.compute_dtype))
+                               dtype=self.compute_dtype,
+                               remat_policy=self.remat_policy))
 
   def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
     features, _ = self.validated_features(features, mode)

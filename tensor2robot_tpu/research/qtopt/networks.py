@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.remat import remat_module
+
 GRASP_PARAM_SIZES = {
     'projected_vector': 2,
     'tip_vectors_first_finger': 2,
@@ -137,6 +139,12 @@ class Grasping44(nn.Module):
   batch_norm_decay: float = 0.9997
   batch_norm_epsilon: float = 0.001
   dtype: Optional[jnp.dtype] = None
+  # Activation remat around each _ConvBN tower block (layers/remat.py):
+  # the backward recomputes the [B, 79, 79, 64] tower activations from
+  # block boundaries instead of keeping all ~15 of them live — the knob
+  # that moves the HBM batch cliff (batch 96 collapse, PERF_NOTES).
+  # Identical params and numerics; 'none' is the historical program.
+  remat_policy: str = 'none'
 
   @nn.compact
   def __call__(self,
@@ -145,6 +153,9 @@ class Grasping44(nn.Module):
                train: bool = False,
                softmax: bool = False) -> Tuple[jnp.ndarray, Dict]:
     end_points: Dict[str, jnp.ndarray] = {}
+    # `train` (arg 2, counting self) selects BN batch-vs-running stats in
+    # python, so it stays static under jax.checkpoint.
+    conv_bn = remat_module(_ConvBN, self.remat_policy, static_argnums=(2,))
     action_batched = grasp_params.ndim == 3
     if self.dtype is not None:
       images = images.astype(self.dtype)
@@ -171,7 +182,7 @@ class Grasping44(nn.Module):
         momentum=self.batch_norm_decay, epsilon=self.batch_norm_epsilon,
         dtype=self.dtype, name='bn1')(net, pooled, train)
     for l in range(2, 2 + self.num_convs[0]):
-      net = _ConvBN(64, 5, dtype=self.dtype, name=f'conv{l}')(net, train)
+      net = conv_bn(64, 5, dtype=self.dtype, name=f'conv{l}')(net, train)
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     end_points['pool2'] = net
 
@@ -200,11 +211,11 @@ class Grasping44(nn.Module):
 
     for l in range(2 + self.num_convs[0],
                    2 + self.num_convs[0] + self.num_convs[1]):
-      net = _ConvBN(64, 3, dtype=self.dtype, name=f'conv{l}')(net, train)
+      net = conv_bn(64, 3, dtype=self.dtype, name=f'conv{l}')(net, train)
     net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
     for l in range(2 + self.num_convs[0] + self.num_convs[1],
                    2 + sum(self.num_convs)):
-      net = _ConvBN(64, 3, padding='VALID', dtype=self.dtype,
+      net = conv_bn(64, 3, padding='VALID', dtype=self.dtype,
                     name=f'conv{l}')(net, train)
     end_points['final_conv'] = net
 

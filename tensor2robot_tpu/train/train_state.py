@@ -74,6 +74,38 @@ def create_train_state(model,
       rng=state_rng)
 
 
+def init_grad_accumulators(params: Any) -> Any:
+  """Zeroed float32 gradient accumulators shaped like ``params``.
+
+  Float32 regardless of param/compute dtype: summing M microbatch
+  gradients in bfloat16 would lose the low bits the optimizer update
+  depends on. The accumulators live only inside the jitted step's
+  ``lax.scan`` carry, which XLA updates in place (donated across scan
+  iterations) — they never exist M times.
+  """
+  return jax.tree_util.tree_map(
+      lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+
+
+def accumulate_grads(acc: Any, grads: Any) -> Any:
+  """One accumulation step: ``acc += grads`` in float32."""
+  return jax.tree_util.tree_map(
+      lambda a, g: a + g.astype(jnp.float32), acc, grads)
+
+
+def finalize_accumulated_grads(acc: Any, params: Any,
+                               num_microbatches: int) -> Any:
+  """Mean over microbatches, cast back to the params' gradient dtype.
+
+  For a mean-reduced loss, the mean of M microbatch-mean gradients IS the
+  full-batch gradient, so the optimizer sees exactly what the unsliced
+  step would feed it (up to f32 summation order).
+  """
+  return jax.tree_util.tree_map(
+      lambda a, p: (a / num_microbatches).astype(jnp.asarray(p).dtype),
+      acc, params)
+
+
 def apply_ema(state: TrainState, new_params, decay: float) -> Optional[Any]:
   """One EMA update; returns the new ema tree (or None when disabled)."""
   if state.ema_params is None:

@@ -34,20 +34,53 @@ import numpy as np
 import optax
 
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import memory as memory_lib
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct, algebra
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
 from tensor2robot_tpu.train import resilience
-from tensor2robot_tpu.train.train_state import (TrainState, apply_ema,
-                                                create_train_state)
+from tensor2robot_tpu.train.train_state import (TrainState,
+                                                accumulate_grads, apply_ema,
+                                                create_train_state,
+                                                finalize_accumulated_grads,
+                                                init_grad_accumulators)
 
 Batch = Tuple[Any, Any]
 # What the train loop's place() emits and the prefetch queue carries:
 # (placed (features, labels), use_auto_layout_executable).
 PlacedBatch = Tuple[Batch, bool]
 MetricDict = Dict[str, float]
+
+
+def _place_releasing(place: Callable[[Batch], 'PlacedBatch'],
+                     release: Callable[[], None],
+                     batch: Batch) -> 'PlacedBatch':
+  """Places ``batch`` and returns its ring-buffer lease (data/engine.py).
+
+  The release point depends on what placement actually does with the
+  host bytes:
+
+  * Accelerator backends: ``device_put`` COPIES to device memory, so
+    place, block on the placed leaves (transfer completion only — never
+    compute), then release. This is the ROADMAP PR-3 follow-up's
+    transfer-completion release point.
+  * XLA-CPU: ``device_put`` may ZERO-COPY alias the host numpy buffer —
+    "transfer completion" never copies, and releasing would let the
+    engine overwrite the live batch under the step (observed as
+    corrupted training). Take an explicit host copy of the ring views,
+    release, then place the copy — exactly the copy ``np.stack`` paid
+    before ring buffers existed.
+  """
+  if jax.default_backend() == 'cpu':
+    batch = jax.tree_util.tree_map(lambda x: np.array(x, copy=True), batch)
+    release()
+    return place(batch)
+  placed = place(batch)
+  jax.block_until_ready(placed[0])
+  release()
+  return placed
 
 
 def crossed_interval(interval: int, step_before: int, step_after: int) -> bool:
@@ -146,6 +179,24 @@ class TrainerConfig:
   # first boundary ON OR AFTER each multiple, exactly like
   # iterations_per_loop; callbacks see only boundary steps.
   steps_per_dispatch: int = 1
+  # Microbatch gradient accumulation (GPipe-style): the jitted step runs
+  # a lax.scan over M slices of the host batch — [B, ...] reshaped to
+  # [M, B/M, ...] — accumulating gradients in donated float32 carries,
+  # then applies ONE optimizer update on the microbatch-mean gradient.
+  # Peak activation memory follows the MICRObatch (B/M), so effective
+  # batches past the HBM cliff train at near-optimal per-example
+  # throughput (the qtopt curve collapses 8.6× at batch 96; M=2×64 keeps
+  # batch-64 activations). For mean-reduced losses the update equals the
+  # full-batch step exactly (f32 accumulators; pinned by
+  # tests/test_memory_scaling.py), with one caveat: batch-coupled ops
+  # (BatchNorm batch statistics, batch-shaped dropout masks) see the
+  # microbatch — "ghost batch norm" semantics, B/M-sized stats.
+  # Preprocessing runs ONCE over the full host batch (same rng draws as
+  # the unsliced step); the per-step rng fold_in, EMA update, and the
+  # non-finite guard (evaluated over the ACCUMULATED gradients) all
+  # advance once per effective batch. Composes with steps_per_dispatch:
+  # K host batches × M microbatches nest as one XLA program. B % M == 0.
+  grad_accum_microbatches: int = 1
   # Per-dispatch step-time breakdown (observability/): decomposes each
   # dispatch's wall time into host wait-for-batch, H2D placement,
   # dispatch/enqueue, device step, and callback overhead, and merges
@@ -215,7 +266,8 @@ class _DevicePrefetcher:
 
   def __init__(self, it: Iterator[Batch],
                place: Callable[[Batch], 'PlacedBatch'], depth: int,
-               place_stage: Optional[bool] = None):
+               place_stage: Optional[bool] = None,
+               release: Optional[Callable[[], None]] = None):
     import queue
     import threading
 
@@ -223,6 +275,14 @@ class _DevicePrefetcher:
     self._host_q: Optional['queue.Queue'] = None
     self._err: Optional[BaseException] = None
     self._stop = threading.Event()
+    # Ring-buffer lease release (data/engine.py reuse_buffers): called
+    # once per batch AFTER its H2D transfer completes, so the engine may
+    # recycle the host buffers the batch's arrays were views of. The
+    # placement stage is the transfer-completion point this closes the
+    # ROADMAP PR-3 follow-up with: place() → block on the placed leaves
+    # → release() — all on the place/consumer thread, off the dispatch
+    # critical path.
+    self._release = release
     # Queue telemetry: a depth gauge pinned near 0 plus a climbing
     # starvation counter is the registry's signature of an input-bound
     # run (the breakdown's host_wait_ms says the same from the loop
@@ -264,7 +324,10 @@ class _DevicePrefetcher:
             # decode; its time shows up as placement_overlapped_ms in
             # the breakdown (off the dispatch critical path).
             with tracing.span('trainer/place_stage', annotate=False):
-              placed = place(item)
+              if self._release is not None:
+                placed = _place_releasing(place, self._release, item)
+              else:
+                placed = place(item)
             self._q.put(placed)
         except BaseException as e:
           if self._err is None:
@@ -321,7 +384,10 @@ class _DevicePrefetcher:
         raise self._err
       raise StopIteration
     if self._consumer_place is not None:
-      item = self._consumer_place(item)
+      if self._release is not None:
+        item = _place_releasing(self._consumer_place, self._release, item)
+      else:
+        item = self._consumer_place(item)
     self._m_batches.inc()
     return item
 
@@ -363,7 +429,9 @@ class _DevicePrefetcher:
 
 
 def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
-                     max_steps: int) -> Iterator[Batch]:
+                     max_steps: int,
+                     release: Optional[Callable[[], None]] = None
+                     ) -> Iterator[Batch]:
   """Stacks K host batches into one ``[K, batch, ...]`` step-group.
 
   Groups are clipped so the train loop never overshoots ``max_steps``,
@@ -372,6 +440,12 @@ def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
   ``np.stack`` always sees uniform shapes. Short groups just retrace the
   scan executable. Tracks emitted steps itself so grouping stays correct
   when a prefetcher pulls groups ahead of consumption.
+
+  ``release``: ring-buffer lease release of the source iterator
+  (``data/engine.py`` ``reuse_buffers``). ``np.stack`` copies every
+  source batch out of its ring slot, so the K leases are returned right
+  after each group is stacked — before placement, which only ever sees
+  the copies.
   """
   emitted = start_step
 
@@ -383,6 +457,9 @@ def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
         lambda *xs: np.stack(xs), *[b[0] for b in group])
     labels = jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *[b[1] for b in group])
+    if release is not None:
+      for _ in group:
+        release()
     return features, labels
 
   group: List[Batch] = []
@@ -478,6 +555,9 @@ class _DispatchBreakdown:
     self._wall_hist = metrics_lib.histogram('trainer/step_wall_ms')
     self._place_hist = metrics_lib.histogram('trainer/placement_ms')
     self._callback_hist = metrics_lib.histogram('trainer/callback_ms')
+    # Closed log windows: the input engine's mid-run re-autotune keys off
+    # this counter (one re-evaluation per window, data/engine.py).
+    self._windows = metrics_lib.counter('trainer/breakdown_windows')
     self._skipped_counter = metrics_lib.counter(
         'resilience/nonfinite_skipped_steps')
     self._reset_window()
@@ -549,6 +629,7 @@ class _DispatchBreakdown:
     }
     for key, value in out.items():
       metrics_lib.gauge(f'trainer/{key}').set(value)
+    self._windows.inc()
     self._reset_window()
     return out
 
@@ -602,6 +683,7 @@ class Trainer:
     self._preprocessor = model.preprocessor
     self._optimizer = model.create_optimizer()
     self._loop_k = max(1, int(config.steps_per_dispatch))
+    self._accum_m = max(1, int(config.grad_accum_microbatches))
     self._state: Optional[TrainState] = None
     self._train_step_fn = None
     self._eval_step_fn = None
@@ -681,27 +763,65 @@ class Trainer:
     optimizer = self._optimizer
     decay = model.avg_model_params_decay
     guard_nonfinite = self._config.nonfinite_mode != 'off'
+    accum_m = self._accum_m
 
     def train_step(state: TrainState, features, labels):
       step_rng = jax.random.fold_in(state.rng, state.step)
       pre_rng, net_rng = jax.random.split(step_rng)
+      # Preprocessing covers the FULL host batch in one call — with
+      # microbatching this keeps every rng draw (crop offsets,
+      # photometric distortions) identical to the unsliced step; only
+      # the network forward/backward is sliced.
       features_p, labels_p = preprocessor.preprocess(
           features, labels, ModeKeys.TRAIN, pre_rng)
 
-      def loss_fn(params):
-        variables = dict(state.model_state)
+      def loss_fn(params, model_state, f, l):
+        variables = dict(model_state)
         variables['params'] = params
         outputs, new_variables = model.inference_network_fn(
-            variables, features_p, labels_p, ModeKeys.TRAIN, net_rng)
-        loss, scalars = model.model_train_fn(
-            features_p, labels_p, outputs, ModeKeys.TRAIN)
+            variables, f, l, ModeKeys.TRAIN, net_rng)
+        loss, scalars = model.model_train_fn(f, l, outputs, ModeKeys.TRAIN)
         new_model_state = {
             k: v for k, v in dict(new_variables).items() if k != 'params'
         }
         return loss, (scalars, new_model_state)
 
       grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-      (loss, (scalars, new_model_state)), grads = grad_fn(state.params)
+      if accum_m == 1:
+        (loss, (scalars, new_model_state)), grads = grad_fn(
+            state.params, state.model_state, features_p, labels_p)
+      else:
+        # Microbatch accumulation: scan over [M, B/M, ...] slices with
+        # f32 accumulators in the (donated) carry; ONE update per
+        # effective batch. model_state threads through the scan, so
+        # BatchNorm running averages advance per microbatch (their
+        # values never feed the TRAIN-mode forward, so loss/grads are
+        # unaffected by the threading order).
+        micro_f = mesh_lib.microbatch_split(features_p, accum_m)
+        micro_l = (None if labels_p is None else
+                   mesh_lib.microbatch_split(labels_p, accum_m))
+
+        def micro_body(carry, mb):
+          model_state, grad_acc, loss_acc = carry
+          f, l = mb
+          (mb_loss, (mb_scalars, new_ms)), mb_grads = grad_fn(
+              state.params, model_state, f, l)
+          carry = (new_ms, accumulate_grads(grad_acc, mb_grads),
+                   loss_acc + mb_loss.astype(jnp.float32))
+          return carry, mb_scalars
+
+        (new_model_state, grad_acc, loss_acc), scalars_m = jax.lax.scan(
+            micro_body,
+            (state.model_state, init_grad_accumulators(state.params),
+             jnp.zeros((), jnp.float32)),
+            (micro_f, micro_l))
+        grads = finalize_accumulated_grads(grad_acc, state.params, accum_m)
+        loss = loss_acc / accum_m
+        # Mean-reduced scalars: the microbatch mean IS the full-batch
+        # value; reduced in f32 for the same reason the accumulators are.
+        scalars = jax.tree_util.tree_map(
+            lambda s: jnp.mean(jnp.asarray(s).astype(jnp.float32), axis=0),
+            scalars_m)
       updates, new_opt_state = optimizer.update(
           grads, state.opt_state, state.params)
       new_params = optax.apply_updates(state.params, updates)
@@ -714,7 +834,12 @@ class Trainer:
       scalars = dict(scalars)
       scalars['loss'] = loss
       if guard_nonfinite:
-        # Device-side guard: ok == all_finite(loss, grads). The ENTIRE
+        # Device-side guard: ok == all_finite(loss, grads). With
+        # grad_accum_microbatches > 1, `grads` here is the ACCUMULATED
+        # (microbatch-mean) tree — one bad microbatch poisons the whole
+        # effective batch's update, which is the correct granularity:
+        # the optimizer only ever sees the accumulated gradient.
+        # The ENTIRE
         # state transition is selected through where(ok, new, old), so a
         # non-finite batch leaves params/opt-state/EMA/step untouched —
         # no host sync, no extra dispatch; the host policy reads the
@@ -912,6 +1037,11 @@ class Trainer:
             ) -> MetricDict:
     """Interleaved train/eval loop (train_and_evaluate semantics)."""
     config = self._config
+    # Ring-buffer lease hook (data/engine.py reuse_buffers): present on
+    # engine-backed iterators; None otherwise. Called once per consumed
+    # batch at the point its bytes stop being needed (H2D transfer
+    # completion, or the np.stack copy in the K>1 grouping path).
+    release_fn = getattr(train_iter, 'release', None)
     if self._state is None:
       resuming = (self._manager is not None and
                   self._manager.latest_step() is not None)
@@ -923,6 +1053,12 @@ class Trainer:
       # restarted stream repeats examples anyway, so dropping it is
       # never a loss.
       first_batch: Optional[Batch] = None if resuming else (features, labels)
+      if resuming and release_fn is not None:
+        # The dropped probe batch still holds its ring lease; block
+        # until initialization consumed its values (async dispatches
+        # may still be reading the slot buffers) before releasing.
+        jax.block_until_ready(self._state)
+        release_fn()
     else:
       first_batch = None
 
@@ -974,15 +1110,24 @@ class Trainer:
     if first_batch is not None:
       train_iter = itertools.chain([first_batch], train_iter)
     host_iter: Iterator[Batch] = train_iter
+    place_release = release_fn
     if self._loop_k > 1:
+      # The grouping stack copies batches out of their ring slots, so
+      # leases are released there; downstream stages see only copies.
       host_iter = _grouped_batches(
-          train_iter, self._loop_k, step, config.max_train_steps)
+          train_iter, self._loop_k, step, config.max_train_steps,
+          release=release_fn)
+      place_release = None
 
     prefetcher: Optional[_DevicePrefetcher] = None
     prefetch_depth = config.resolved_prefetch_batches()
     if prefetch_depth > 0:
-      prefetcher = _DevicePrefetcher(host_iter, place, prefetch_depth)
+      prefetcher = _DevicePrefetcher(host_iter, place, prefetch_depth,
+                                     release=place_release)
       batches: Iterator[PlacedBatch] = iter(prefetcher)
+    elif place_release is not None:
+      batches = (_place_releasing(place, place_release, b)
+                 for b in host_iter)
     else:
       batches = (place(b) for b in host_iter)
     # Previous dispatch's device-side non-finite count, evaluated one
@@ -1055,6 +1200,10 @@ class Trainer:
           # scalars dict, so MetricsLogger/TensorBoard publish them with
           # zero call-site changes.
           scalars.update(breakdown.window_scalars())
+          # HBM gauges (peak/live bytes) ride the same scalar merge, so
+          # TensorBoard shows memory beside throughput; no-op (empty) on
+          # backends without allocator stats (CPU).
+          scalars.update(memory_lib.memory_scalars())
           scalars.update(
               _resilience_scalars(resilience_snap, self._nonfinite_policy))
         for cb in self._callbacks:

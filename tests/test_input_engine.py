@@ -193,9 +193,12 @@ class TestRingBuffers:
       assert not np.array_equal(first, snapshot)
 
   def test_unreleased_leases_fail_loudly_not_deadlock(self):
+    # lease_timeout shortened: a consumer that NEVER releases gets the
+    # loud error after the grace window an async releaser (the trainer's
+    # placement stage) would have used.
     eng = engine_lib.ParallelBatchEngine(
         iter(_records(60)), _ring_parse([]), 5, num_workers=2,
-        ring_depth=3, reuse_buffers=True)
+        ring_depth=3, reuse_buffers=True, lease_timeout=0.2)
     with eng:
       for _ in range(3):
         next(eng)  # never released
@@ -208,6 +211,50 @@ class TestRingBuffers:
     with eng:
       out = list(eng)
     assert len(out) == 4  # plain allocation mode, stream intact
+
+  def test_ring_release_from_trainer_placement_stage(self):
+    """The ROADMAP PR-3 follow-up, closed: the trainer's dedicated
+    placement stage releases each lease at transfer completion, so
+    reuse_buffers rings work under the three-stage prefetcher — alloc
+    count == ring_depth for a stream much longer than the ring, output
+    ordered and intact."""
+    from tensor2robot_tpu.train.trainer import _DevicePrefetcher
+
+    serial = _collect(0, n=100, parse=_ring_parse([]))
+    allocs = []
+    eng = engine_lib.ParallelBatchEngine(
+        iter(_records(100)), _ring_parse(allocs), 5, num_workers=2,
+        ring_depth=3, reuse_buffers=True)
+    # place() copies out of the ring slot (what shard_batch's device_put
+    # does for real); the prefetcher then releases the lease.
+    prefetcher = _DevicePrefetcher(
+        eng, place=lambda b: (b.copy(), False), depth=2, place_stage=True,
+        release=eng.release)
+    out = [placed for placed, _ in prefetcher]
+    prefetcher.close()
+    eng.close()
+    assert len(allocs) == 3  # exactly ring_depth buffers, ever
+    assert len(out) == len(serial) == 20
+    for a, b in zip(serial, out):
+      np.testing.assert_array_equal(a, b)
+
+  def test_ring_release_from_consumer_place_path(self):
+    """CPU backends place on the consumer thread; the release hook must
+    fire there too."""
+    from tensor2robot_tpu.train.trainer import _DevicePrefetcher
+
+    allocs = []
+    eng = engine_lib.ParallelBatchEngine(
+        iter(_records(100)), _ring_parse(allocs), 5, num_workers=2,
+        ring_depth=3, reuse_buffers=True)
+    prefetcher = _DevicePrefetcher(
+        eng, place=lambda b: (b.copy(), False), depth=2, place_stage=False,
+        release=eng.release)
+    out = list(prefetcher)
+    prefetcher.close()
+    eng.close()
+    assert len(allocs) == 3
+    assert len(out) == 20
 
 
 # ----------------------------------------------------------- autotune
@@ -282,6 +329,115 @@ class TestAutotune:
     assert engine_lib.last_decision() == decision
     assert metrics_lib.gauge('data/engine/workers').value == 4
     assert decision.as_dict()['ring_depth'] == 8
+
+
+class TestMidRunReautotune:
+  """ROADMAP PR-3 follow-up: the engine re-evaluates its worker count at
+  trainer log-window crossings, at most one change per window, with the
+  decision history published as data/engine/* gauges."""
+
+  @staticmethod
+  def _engine(records=600, workers=1, ring=8, cpus=4):
+    return engine_lib.ParallelBatchEngine(
+        iter(_records(records)), _parse, 5, num_workers=workers,
+        ring_depth=ring, reautotune=True, cpus=cpus)
+
+  @staticmethod
+  def _window(input_bound, starvation=0):
+    """Simulates one closed breakdown window with the given signals."""
+    metrics_lib.gauge('trainer/input_bound_fraction').set(input_bound)
+    if starvation:
+      metrics_lib.counter('trainer/prefetch/starvation').inc(starvation)
+    metrics_lib.counter('trainer/breakdown_windows').inc()
+
+  def test_grows_when_window_says_input_bound(self, clean_registry):
+    metrics_lib.counter('trainer/dispatches').inc(64)
+    eng = self._engine()
+    with eng:
+      next(eng)
+      assert eng._num_workers == 1  # no window yet: build decision holds
+      self._window(0.8)
+      next(eng)
+      assert eng._num_workers == 3  # min(cpus-1, 8), capped by ring
+      assert metrics_lib.counter(
+          'data/engine/reautotune/changes').value == 1
+      assert metrics_lib.gauge(
+          'data/engine/reautotune/target_workers').value == 3
+      assert metrics_lib.gauge('data/engine/workers').value == 3
+      assert eng.decision_history[-1]['to'] == 3
+      # Same window: NO further change (one re-evaluation per window).
+      for _ in range(5):
+        next(eng)
+      assert metrics_lib.counter(
+          'data/engine/reautotune/changes').value == 1
+
+  def test_shrinks_when_window_says_compute_bound(self, clean_registry):
+    metrics_lib.counter('trainer/dispatches').inc(64)
+    eng = self._engine(workers=3)
+    with eng:
+      got = [next(eng)]
+      self._window(0.01)
+      got.append(next(eng))
+      assert eng._num_workers == 1
+      # Retired threads drain their in-flight tickets; stream intact.
+      got.extend(next(eng) for _ in range(10))
+    serial = _collect(0, n=600)
+    for a, b in zip(serial, got):
+      np.testing.assert_array_equal(a, b)
+
+  def test_stream_identical_across_resizes(self, clean_registry):
+    serial = _collect(0, n=300)
+    metrics_lib.counter('trainer/dispatches').inc(64)
+    eng = self._engine(records=300, workers=2)
+    got = []
+    with eng:
+      for i, batch in enumerate(eng):
+        got.append(batch)
+        if i == 5:
+          self._window(0.9)    # grow next delivery
+        elif i == 20:
+          self._window(0.01)   # shrink back to 1
+    assert len(got) == len(serial)
+    for a, b in zip(serial, got):
+      np.testing.assert_array_equal(a, b)
+    assert metrics_lib.counter('data/engine/reautotune/changes').value == 2
+    assert [d['to'] for d in eng.decision_history] == [3, 1]
+
+  def test_starvation_delta_not_lifetime_drives_growth(self,
+                                                       clean_registry):
+    """An hour-old starvation incident must not pin the pool grown: only
+    NEW starvation (the per-window delta) counts."""
+    metrics_lib.counter('trainer/dispatches').inc(64)
+    metrics_lib.counter('trainer/prefetch/starvation').inc(7)  # historical
+    eng = self._engine(workers=2)
+    with eng:
+      next(eng)
+      self._window(0.2)  # mid-band fraction, NO new starvation
+      next(eng)
+      assert eng._num_workers == 2  # unchanged
+      self._window(0.2, starvation=3)  # fresh starvation this window
+      next(eng)
+      assert eng._num_workers == 3
+
+  def test_untrusted_short_window_changes_nothing(self, clean_registry):
+    metrics_lib.counter('trainer/dispatches').inc(3)  # below threshold
+    eng = self._engine(workers=2)
+    with eng:
+      next(eng)
+      self._window(0.9)
+      next(eng)
+      assert eng._num_workers == 2
+
+  def test_disabled_without_flag(self, clean_registry):
+    metrics_lib.counter('trainer/dispatches').inc(64)
+    eng = engine_lib.ParallelBatchEngine(
+        iter(_records(100)), _parse, 5, num_workers=1, ring_depth=8,
+        cpus=4)  # reautotune defaults off
+    with eng:
+      next(eng)
+      self._window(0.9)
+      next(eng)
+      assert eng._num_workers == 1
 
 
 # -------------------------------------------- native end-to-end stream
@@ -826,8 +982,8 @@ class TestPlacementStage:
 
     class ForcedPlaceStage(original):
 
-      def __init__(self, it, place, depth, place_stage=None):
-        super().__init__(it, place, depth, place_stage=True)
+      def __init__(self, it, place, depth, place_stage=None, **kwargs):
+        super().__init__(it, place, depth, place_stage=True, **kwargs)
 
     results = {}
     for mode in ('inline', 'staged'):

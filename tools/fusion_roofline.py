@@ -11,6 +11,13 @@ Usage::
     python tools/fusion_roofline.py            # qtopt (the bench step)
     python tools/fusion_roofline.py grasp2vec  # batch-16 bf16 towers
     python tools/fusion_roofline.py wtl        # batch-32 vision trial
+    python tools/fusion_roofline.py qtopt --batch 128 --accum 2
+        # the microbatch-accumulation step (effective batch 128 as
+        # 2×64): per-fusion table of the scan program — scan-body ops
+        # appear once (region events are skipped), so the table shows
+        # the PER-MICROBATCH kernels plus the accumulation epilogue
+    python tools/fusion_roofline.py qtopt --remat conv_towers
+        # remat'd towers: recompute fusions show up in the backward rows
 """
 
 from __future__ import annotations
@@ -244,17 +251,17 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
   return total / 1e9, {k: v / 1e9 for k, v in ops.items()}
 
 
-def _build_workload(name: str):
+def _build_workload(name: str, remat: str = 'none'):
   """(model, batch_size) for each profiled workload; batch sizes match
   the PERF_NOTES / BASELINE.json recording configurations."""
   if name == 'qtopt':
     from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
 
-    return GraspingModelWrapper(device_type='tpu'), 32
+    return GraspingModelWrapper(device_type='tpu', remat_policy=remat), 32
   if name == 'grasp2vec':
     from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
 
-    return Grasp2VecModel(device_type='tpu'), 16
+    return Grasp2VecModel(device_type='tpu', remat_policy=remat), 16
   if name == 'wtl':
     from tensor2robot_tpu.research.vrgripper import (
         VRGripperEnvVisionTrialModel)
@@ -265,6 +272,7 @@ def _build_workload(name: str):
 
 
 def main(argv=None):
+  import argparse
   import tempfile
 
   import jax
@@ -274,11 +282,27 @@ def main(argv=None):
   from tensor2robot_tpu.specs import make_random_numpy
   from tensor2robot_tpu.train import Trainer, TrainerConfig
 
-  argv = sys.argv[1:] if argv is None else argv
-  workload = argv[0] if argv else 'qtopt'
-  model, batch_size = _build_workload(workload)
+  parser = argparse.ArgumentParser()
+  parser.add_argument('workload', nargs='?', default='qtopt',
+                      choices=('qtopt', 'grasp2vec', 'wtl'))
+  parser.add_argument('--batch', type=int, default=None,
+                      help='override the workload batch size (with '
+                           '--accum this is the EFFECTIVE batch)')
+  parser.add_argument('--accum', type=int, default=1,
+                      help='grad_accum_microbatches: roofline the '
+                           'microbatch-accumulation scan program')
+  parser.add_argument('--remat', default='none',
+                      choices=('none', 'conv_towers', 'full'),
+                      help='activation remat policy on the towers')
+  args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+
+  workload = args.workload
+  model, batch_size = _build_workload(workload, remat=args.remat)
+  if args.batch is not None:
+    batch_size = args.batch
   config = TrainerConfig(model_dir='', max_train_steps=1,
-                         eval_interval_steps=0, log_interval_steps=0)
+                         eval_interval_steps=0, log_interval_steps=0,
+                         grad_accum_microbatches=args.accum)
   trainer = Trainer(model, config)
   preprocessor = model.preprocessor
   feature_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
@@ -306,7 +330,24 @@ def main(argv=None):
     jax.block_until_ready(st.params)
   total_ms, ops = device_op_times_full(tracedir)
   ops = {k: v / n for k, v in ops.items()}
-  print(f'device ms/step: {total_ms / n:.3f}')
+  # Accum-step aware: with M > 1 the step is a lax.scan over M
+  # microbatches whose `while` REGION events are skipped (see
+  # trace_profile.is_region_event), so each scan-body kernel is counted
+  # once per microbatch — the per-step totals already include all M
+  # iterations. Label the table with both granularities.
+  label = f'device ms/step: {total_ms / n:.3f}'
+  if args.accum > 1:
+    label += (f'  (effective batch {batch_size} = '
+              f'{args.accum}×{batch_size // args.accum} microbatches; '
+              f'{total_ms / n / args.accum:.3f} ms/microbatch)')
+  if args.remat != 'none':
+    label += f'  [remat={args.remat}]'
+  print(label)
+  from tensor2robot_tpu.observability import memory as memory_lib
+
+  peak_mb = memory_lib.device_memory_peak_mb()
+  if peak_mb is not None:
+    print(f'device memory peak: {peak_mb:.0f} MB')
   print(roofline_table(ops, hlo, top=20))
 
 

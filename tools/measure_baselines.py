@@ -29,9 +29,16 @@ TEST_DATA = os.path.join(REPO, 'tests', 'test_data',
 
 
 def _time_train_step(model, batch_size: int, steps: int = 50,
-                     generator=None, trace: bool = False):
+                     generator=None, trace: bool = False,
+                     grad_accum: int = 1):
   """(wall steps/s, trace-measured device ms/step or None) for the
-  jitted train step over device-resident random batches."""
+  jitted train step over device-resident random batches.
+
+  ``grad_accum=M`` compiles the microbatch-accumulation step
+  (``TrainerConfig.grad_accum_microbatches``): ``batch_size`` is the
+  EFFECTIVE batch, sliced into M microbatches inside the program — the
+  configuration the accum batch curve measures against the HBM cliff.
+  """
   import jax
 
   from tensor2robot_tpu.data.input_generators import (
@@ -45,7 +52,8 @@ def _time_train_step(model, batch_size: int, steps: int = 50,
   generator.batch_size = batch_size
   generator.set_specification_from_model(model, ModeKeys.TRAIN)
   config = TrainerConfig(model_dir='', max_train_steps=1,
-                         eval_interval_steps=0, log_interval_steps=0)
+                         eval_interval_steps=0, log_interval_steps=0,
+                         grad_accum_microbatches=grad_accum)
   trainer = Trainer(model, config)
   it = generator.create_iterator(ModeKeys.TRAIN)
   trainer.train(it, None)
@@ -168,39 +176,55 @@ def measure_pose_env_maml(batch_size: int = 64):
   return _time_train_step(model, batch_size=batch_size, trace=True)
 
 
-def measure_qtopt_batch(batch_size: int, steps: int = 30):
+def measure_qtopt_batch(batch_size: int, steps: int = 30,
+                        grad_accum: int = 1, remat: str = 'none'):
   """One QT-Opt batch-size point: (wall steps/s, device ms/step)."""
   from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
 
-  return _time_train_step(GraspingModelWrapper(device_type='tpu'),
-                          batch_size=batch_size, steps=steps, trace=True)
+  return _time_train_step(
+      GraspingModelWrapper(device_type='tpu', remat_policy=remat),
+      batch_size=batch_size, steps=steps, trace=True,
+      grad_accum=grad_accum)
 
 
-def measure_qtopt_batch_curve(batches=(32, 48, 64, 96, 128)) -> dict:
-  """Per-example throughput curve (r4 verdict #2).
+def measure_qtopt_batch_curve(batches=(32, 48, 64, 96, 128),
+                              accums=(1,)) -> dict:
+  """Per-example throughput curve (r4 verdict #2), memory-annotated.
 
-  Each batch size runs in its OWN subprocess: coexisting compiled
-  executables make the tunneled backend re-stream them per dispatch and
-  poison the numbers (see tools/profile_record_train.py docstring).
-  Returns {batch: {steps_per_sec, device_ms, examples_per_sec}}.
+  Each (batch, accum) point runs in its OWN subprocess: coexisting
+  compiled executables make the tunneled backend re-stream them per
+  dispatch and poison the numbers (see tools/profile_record_train.py
+  docstring). Every point carries ``device_memory_peak_mb`` from the
+  allocator's own ``memory_stats()``, so the HBM cliff is pinned to
+  bytes in the artifact rather than inferred from a throughput collapse.
+  ``accums``: grad_accum_microbatches values per batch size (M > 1 only
+  where M divides the batch) — the accum curve BENCH_r06 records.
+  Returns {(batch, accum) or batch: point dict}.
   """
   import subprocess
   import sys
 
   curve = {}
   for b in batches:
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), '--qtopt-batch', str(b)],
-        capture_output=True, text=True)
-    line = None
-    for out_line in proc.stdout.splitlines():
-      if out_line.startswith('{'):
-        line = out_line
-    if line is None:
-      print(f'  batch {b} FAILED:\n{proc.stdout[-500:]}\n{proc.stderr[-800:]}')
-      continue
-    curve[b] = json.loads(line)
-    print(f'  batch {b}: {curve[b]}', flush=True)
+    for m in accums:
+      if b % m:
+        continue
+      args = [sys.executable, os.path.abspath(__file__),
+              '--qtopt-batch', str(b)]
+      if m > 1:
+        args += ['--accum', str(m)]
+      proc = subprocess.run(args, capture_output=True, text=True)
+      line = None
+      for out_line in proc.stdout.splitlines():
+        if out_line.startswith('{'):
+          line = out_line
+      key = b if m == 1 else (b, m)
+      if line is None:
+        print(f'  batch {b} M={m} FAILED:\n{proc.stdout[-500:]}\n'
+              f'{proc.stderr[-800:]}')
+        continue
+      curve[key] = json.loads(line)
+      print(f'  batch {b} M={m}: {curve[key]}', flush=True)
   return curve
 
 
@@ -219,9 +243,17 @@ def main(argv=None):
   parser.add_argument('--qtopt-batch', type=int, default=None,
                       help='measure ONE qtopt batch point and print one '
                            'JSON line (subprocess mode for the curve)')
+  parser.add_argument('--accum', type=int, default=1,
+                      help='grad_accum_microbatches for the --qtopt-batch '
+                           'point (batch is the EFFECTIVE batch)')
+  parser.add_argument('--remat', default='none',
+                      choices=('none', 'conv_towers', 'full'),
+                      help='activation remat policy for the --qtopt-batch '
+                           'point')
   parser.add_argument('--only', default=None,
                       help='comma list of: pose_env, grasp2vec, wtl, '
-                           'maml, qtopt_curve (default: all)')
+                           'maml, qtopt_curve, qtopt_accum_curve '
+                           '(default: all but qtopt_accum_curve)')
   args = parser.parse_args(argv)
 
   import jax
@@ -229,7 +261,14 @@ def main(argv=None):
   on_tpu = jax.default_backend() != 'cpu'
 
   if args.qtopt_batch is not None:
-    wall, device_ms = measure_qtopt_batch(args.qtopt_batch)
+    from tensor2robot_tpu.observability import memory as memory_lib
+
+    wall, device_ms = measure_qtopt_batch(
+        args.qtopt_batch, grad_accum=args.accum, remat=args.remat)
+    # Allocator high-water mark AFTER the timed loop: with the whole
+    # point in its own subprocess, the peak IS this configuration's —
+    # the number that says on which side of the HBM cliff it ran.
+    peak_mb = memory_lib.device_memory_peak_mb()
     print(json.dumps({
         'steps_per_sec': round(wall, 3),
         'device_ms': round(device_ms, 2) if device_ms else None,
@@ -237,6 +276,10 @@ def main(argv=None):
         'device_examples_per_sec': (
             round(1000.0 / device_ms * args.qtopt_batch, 1)
             if device_ms else None),
+        'device_memory_peak_mb': (round(peak_mb, 1)
+                                  if peak_mb is not None else None),
+        'grad_accum_microbatches': args.accum,
+        'remat_policy': args.remat,
     }))
     return
 
@@ -244,6 +287,22 @@ def main(argv=None):
     print('WARNING: not on TPU; numbers will not be recorded.')
   want = set(args.only.split(',')) if args.only else {
       'pose_env', 'grasp2vec', 'wtl', 'maml', 'qtopt_curve'}
+  if 'qtopt_accum_curve' in want:
+    # The accum curve: effective batches past the measured cliff, M
+    # sized so the MICRObatch stays at the known-good 64 (plus the M=1
+    # cliff points for the same-session A/B). BENCH_r06's headline
+    # acceptance: effective batch 128 = 2×64 holds ≥90% of batch-64
+    # per-example device throughput.
+    print('qtopt ACCUM batch curve (each point in its own subprocess) ...',
+          flush=True)
+    accum_curve = measure_qtopt_batch_curve(
+        batches=(64, 96, 128, 192, 256), accums=(1, 2, 3, 4))
+    for key, point in sorted(accum_curve.items(), key=str):
+      b, m = key if isinstance(key, tuple) else (key, 1)
+      if point.get('device_examples_per_sec'):
+        print(f'  effective batch {b} (M={m}): '
+              f"{point['device_examples_per_sec']} ex/s device, "
+              f"peak {point.get('device_memory_peak_mb')} MB", flush=True)
 
   measured = {}
   if 'pose_env' in want:
@@ -301,6 +360,11 @@ def main(argv=None):
             'number under the device-anchored key.', flush=True)
     for b, value in device_curve.items():
       measured[f'qtopt_examples_per_sec_per_chip_batch{b}'] = value
+      peak = curve[b].get('device_memory_peak_mb')
+      if peak is not None:
+        # Bytes beside the throughput: the cliff's location is
+        # self-describing in the recorded curve.
+        measured[f'qtopt_device_memory_peak_mb_batch{b}'] = peak
     if device_curve:
       measured['qtopt_optimal_batch'] = int(
           max(device_curve, key=device_curve.get))
