@@ -728,6 +728,27 @@ def main():
     print(json.dumps({'metric': 'observability_report',
                       'error': repr(e)[:200]}))
 
+  # Distributed-resilience gauges (heartbeat ages, per-host steps,
+  # coordinated stops, barrier timeouts, torn-checkpoint skips) beside
+  # the report: on a pod, BENCH rounds record whether the run was
+  # coordination-healthy; single-process runs record the (empty)
+  # baseline. The `cluster` section of the report above additionally
+  # carries process-0's merged per-host registry when heartbeats ran.
+  try:
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+
+    print(json.dumps({
+        'metric': 'distributed_report',
+        'process_count': jax.process_count(),
+        'process_index': jax.process_index(),
+        'distributed': metrics_lib.snapshot('distributed/'),
+        'torn_checkpoints_skipped':
+            metrics_lib.counter('checkpoint/torn_skipped').value,
+    }))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'distributed_report',
+                      'error': repr(e)[:200]}))
+
   print(json.dumps({
       'metric': metric,
       'value': round(steps_per_sec, 3),

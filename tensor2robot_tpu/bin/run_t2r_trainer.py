@@ -92,6 +92,16 @@ def _run(args, resilience):
     # fail the job; the restarted run restores and continues.
     logging.warning('%s; exiting with resumable status %d.', e, e.exit_code)
     sys.exit(e.exit_code)
+  except Exception as e:
+    # Liveness failures (train/distributed_resilience.DeadHostError and
+    # kin) carry their own exit status (43): a peer process died, the
+    # scheduler should restart the WHOLE job from the last committed
+    # checkpoint rather than treat this worker as an ordinary crash.
+    code = getattr(e, 'exit_code', None)
+    if code is not None:
+      logging.error('%s; exiting with status %d.', e, code)
+      sys.exit(code)
+    raise
   operative = t2r_config.operative_config_str()
   logging.info('Operative config:\n%s', operative)
   save_config(operative, 'operative_config-0.gin')

@@ -9,11 +9,15 @@ directory on the filesystem).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Optional
 
+from tensor2robot_tpu.export import exporters as exporters_lib
 from tensor2robot_tpu.export.exporters import ModelExporter
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.train import resilience
 from tensor2robot_tpu.train.trainer import TrainerCallback
 
 
@@ -22,6 +26,16 @@ class AsyncExportCallback(TrainerCallback):
 
   The export runs on a worker thread so the train loop never blocks on
   serialization (the AsyncCheckpointSaverHook capability).
+
+  Preemption-aware (the distributed-resilience contract): the callback
+  persists ``last_exported_step`` into the export root after every
+  version, so a restarted run SKIPS checkpoints its pre-preemption
+  incarnation already exported (``export/skipped_already_exported``);
+  when a graceful shutdown has been requested, the forced preemption
+  checkpoint's export runs SYNCHRONOUSLY — the process is about to exit
+  resumable (42), and a daemon worker thread would be killed mid-write,
+  leaving a torn version for the commit marker to catch. In
+  multi-process runs only the primary process exports.
   """
 
   def __init__(self,
@@ -40,22 +54,42 @@ class AsyncExportCallback(TrainerCallback):
       return self._export_dir
     return os.path.join(trainer.config.model_dir, 'export', self._export_name)
 
+  def _shutdown_requested(self, trainer) -> bool:
+    shutdown = (getattr(trainer, '_shutdown', None)
+                or resilience.active_shutdown())
+    return shutdown is not None and shutdown.requested
+
   def after_checkpoint(self, trainer, step: int) -> None:
     import jax
 
+    if not getattr(trainer, 'is_primary_process', True):
+      return  # one export version per job, not one per host
     export_dir = self._resolve_export_dir(trainer)
+    last = exporters_lib.read_export_state(export_dir).get(
+        'last_exported_step')
+    if last is not None and int(step) <= int(last):
+      metrics_lib.counter('export/skipped_already_exported').inc()
+      logging.info(
+          'Skipping export of checkpoint step %d: step %d was already '
+          'exported before the restart.', step, last)
+      return
     model = trainer.model
     # Snapshot to host NOW: the jitted train step donates the state buffers,
     # so device arrays captured by the worker thread would be deleted.
     state = jax.device_get(trainer.state)
-    if not self._asynchronous:
-      self._exporter.export(model, state, export_dir)
-      return
-    self.join()  # one in-flight export at a time; drop-behind is fine
 
     def work(state=state):
       self._exporter.export(model, state, export_dir)
+      exporters_lib.write_export_state(export_dir,
+                                       last_exported_step=int(step))
 
+    if not self._asynchronous or self._shutdown_requested(trainer):
+      # Shutdown path: this is the forced preemption checkpoint — finish
+      # the export before the process exits 42 rather than racing a
+      # daemon thread against interpreter teardown.
+      work()
+      return
+    self.join()  # one in-flight export at a time; drop-behind is fine
     self._pending = threading.Thread(target=work, daemon=True)
     self._pending.start()
 

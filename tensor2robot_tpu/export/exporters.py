@@ -44,6 +44,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.specs import algebra
 from tensor2robot_tpu.specs import assets as assets_lib
 from tensor2robot_tpu.specs import numpy_gen
@@ -54,6 +55,16 @@ STATE_DIRNAME = 'state'
 SERVING_FN_FILENAME = 'serving_fn.jax_export'
 WARMUP_NPZ_FILENAME = 'warmup_requests.npz'
 WARMUP_EXAMPLES_FILENAME = 'warmup_requests.tfexamples'
+# Written LAST into every export version: a version dir without it is a
+# torn/partial export (a copy or move that died mid-flight) and hot-
+# reloading predictors must skip it. The local os.replace publish is
+# already atomic — the marker is the cross-filesystem/rsync-era guard
+# mirroring the checkpoint commit protocol (train/checkpoints.py).
+EXPORT_COMMIT_FILENAME = 'export_commit.json'
+# Persisted exporter position (export root, not version): survives a
+# preemption so the restarted trainer/evaluator skips already-exported
+# checkpoints instead of re-exporting them.
+EXPORT_STATE_FILENAME = 'export_state.json'
 
 
 def to_plain_tree(obj):
@@ -206,6 +217,52 @@ def valid_export_dirs(export_root: str) -> List[str]:
   return valid
 
 
+def committed_export_dirs(export_root: str,
+                          dirs: Optional[List[str]] = None) -> List[str]:
+  """Filters export version dirs to COMMITTED ones (legacy-aware).
+
+  Once any version carries :data:`EXPORT_COMMIT_FILENAME`, versions
+  without it are torn/partial (a copy that died mid-flight) and are
+  skipped with an ``export/uncommitted_skipped`` count; marker-less
+  legacy roots (exports written before the marker existed) stay fully
+  visible so old artifacts keep serving.
+  """
+  if dirs is None:
+    dirs = valid_export_dirs(export_root)
+  marked = [d for d in dirs
+            if os.path.exists(os.path.join(d, EXPORT_COMMIT_FILENAME))]
+  if not marked:
+    return dirs
+  skipped = len(dirs) - len(marked)
+  if skipped:
+    metrics_lib.counter('export/uncommitted_skipped').inc(skipped)
+    logging.warning(
+        'Ignoring %d export version(s) under %r without a commit marker '
+        '(torn/partial export).', skipped, export_root)
+  return marked
+
+
+def read_export_state(export_root: str) -> Dict[str, Any]:
+  """The persisted exporter position, or {} (missing/corrupt file)."""
+  try:
+    with open(os.path.join(export_root, EXPORT_STATE_FILENAME)) as f:
+      return dict(json.load(f))
+  except (OSError, ValueError, TypeError):
+    return {}
+
+
+def write_export_state(export_root: str, **updates) -> None:
+  """Atomically merges ``updates`` into the persisted exporter state."""
+  os.makedirs(export_root, exist_ok=True)
+  state = read_export_state(export_root)
+  state.update(updates)
+  path = os.path.join(export_root, EXPORT_STATE_FILENAME)
+  tmp = f'{path}.tmp{os.getpid()}'
+  with open(tmp, 'w') as f:
+    json.dump(state, f, indent=2)
+  os.replace(tmp, path)
+
+
 def gc_export_versions(export_root: str, keep: int = 5) -> None:
   """Keeps the N newest versions (``_DirectoryVersionGC``, checkpoint_hooks)."""
   versions = _numeric_version_dirs(export_root)
@@ -325,6 +382,14 @@ class ModelExporter:
     with open(os.path.join(tmp_dir, EXPORT_META_FILENAME), 'w') as f:
       json.dump(meta, f, indent=2)
 
+    # 5. Commit marker, written LAST: a version dir missing it is a torn
+    # export and hot-reloading predictors skip it (the local rename
+    # below is atomic; the marker survives non-atomic replication).
+    with open(os.path.join(tmp_dir, EXPORT_COMMIT_FILENAME), 'w') as f:
+      json.dump({'global_step': int(state.step), 'time': time.time()}, f)
+      f.flush()
+      os.fsync(f.fileno())
+
     # Atomic publish: predictors never observe partial exports.
     os.replace(tmp_dir, final_dir)
     if self._keep:
@@ -392,8 +457,37 @@ def create_valid_result_larger(metric_key: str):
   return compare
 
 
+def _should_skip_export(trainer, export_root: str) -> bool:
+  """Preemption-aware gating for step-keyed exporters (LatestExporter,
+  AsyncExportCallback's root — BestExporter dedups via its persisted
+  best metrics instead).
+
+  Skips (a) non-primary processes of a multi-process job — one export
+  version per job, not one per host — and (b) checkpoints at or below
+  the persisted ``last_exported_step``, so a restarted run never
+  re-exports what it already published (counted as
+  ``export/skipped_already_exported``).
+  """
+  if not getattr(trainer, 'is_primary_process', True):
+    return True
+  last = read_export_state(export_root).get('last_exported_step')
+  step = int(trainer.state.step) if trainer.state is not None else 0
+  if last is not None and step <= int(last):
+    metrics_lib.counter('export/skipped_already_exported').inc()
+    logging.info(
+        'Skipping export of step %d under %r: step %d was already '
+        'exported before the restart.', step, export_root, last)
+    return True
+  return False
+
+
 class LatestExporter:
-  """Exports on every eval, keeping N newest (LatestExporter semantics)."""
+  """Exports on every eval, keeping N newest (LatestExporter semantics).
+
+  Preemption-aware: persists ``last_exported_step`` into the export
+  root after every version, and skips checkpoints a pre-restart
+  incarnation already exported.
+  """
 
   def __init__(self, name: str = 'latest_exporter_numpy', keep: int = 5,
                saved_model: bool = False):
@@ -403,11 +497,21 @@ class LatestExporter:
   def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
     del metrics
     export_root = os.path.join(trainer.config.model_dir, 'export', self.name)
-    return self._exporter.export(trainer.model, trainer.state, export_root)
+    if _should_skip_export(trainer, export_root):
+      return None
+    path = self._exporter.export(trainer.model, trainer.state, export_root)
+    write_export_state(export_root,
+                       last_exported_step=int(trainer.state.step))
+    return path
 
 
 class BestExporter:
-  """Exports only when the metric improves (BestExporter semantics)."""
+  """Exports only when the metric improves (BestExporter semantics).
+
+  The best-so-far metrics are PERSISTED beside the versions, so a
+  restarted run keeps raising the bar instead of re-exporting the first
+  post-restart eval as a fresh "best".
+  """
 
   def __init__(self,
                name: str = 'best_exporter_numpy',
@@ -422,11 +526,27 @@ class BestExporter:
   def export(self, trainer, metrics: Dict[str, float]) -> Optional[str]:
     if not metrics:
       return None
+    if not getattr(trainer, 'is_primary_process', True):
+      return None
+    export_root = os.path.join(trainer.config.model_dir, 'export', self.name)
+    if self._best_metrics is None:
+      # Restart dedup: the pre-preemption best is the bar to beat — a
+      # restarted run re-evaluating an already-exported checkpoint gets
+      # the same metrics, which are not an improvement, so nothing is
+      # re-exported. (No step gate here: a better metric at the same
+      # step IS a legitimate new best within a run.)
+      persisted = read_export_state(export_root).get('best_metrics')
+      if isinstance(persisted, dict):
+        self._best_metrics = {k: float(v) for k, v in persisted.items()}
     if not self._compare_fn(self._best_metrics, metrics):
+      metrics_lib.counter('export/skipped_not_improved').inc()
       return None
     self._best_metrics = dict(metrics)
-    export_root = os.path.join(trainer.config.model_dir, 'export', self.name)
-    return self._exporter.export(trainer.model, trainer.state, export_root)
+    path = self._exporter.export(trainer.model, trainer.state, export_root)
+    write_export_state(export_root,
+                       last_exported_step=int(trainer.state.step),
+                       best_metrics=self._best_metrics)
+    return path
 
 
 def create_default_exporters(best_metric_key: str = 'loss',

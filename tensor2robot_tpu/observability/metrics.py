@@ -43,7 +43,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'Registry', 'Scope', 'counter',
     'gauge', 'histogram', 'scope', 'snapshot', 'delta', 'report',
-    'dump_report', 'reset', 'registry',
+    'dump_report', 'reset', 'registry', 'register_report_provider',
+    'unregister_report_provider',
 ]
 
 
@@ -251,13 +252,30 @@ class Registry:
     return out
 
   def report(self) -> Dict[str, object]:
-    """End-of-run JSON-ready dump: all metrics + process metadata."""
-    return {
+    """End-of-run JSON-ready dump: all metrics + process metadata.
+
+    Registered report providers (:func:`register_report_provider`)
+    contribute extra named sections — e.g. the distributed-resilience
+    layer's ``cluster`` section merging every host's registry — so
+    ``/metricsz`` and ``dump_report`` reflect the whole job without this
+    module importing anything beyond stdlib.
+    """
+    out: Dict[str, object] = {
         'kind': 'metrics_report',
         'pid': os.getpid(),
         'uptime_sec': round(time.time() - self._start_time, 3),
         'metrics': self.snapshot(),
     }
+    with _providers_lock:
+      providers = dict(_report_providers)
+    for name, fn in providers.items():
+      try:
+        out[name] = fn()
+      except Exception as e:  # pylint: disable=broad-except
+        # A broken provider must not take down /metricsz or end-of-run
+        # reporting; surface the failure in-band instead.
+        out[name] = {'error': repr(e)}
+    return out
 
   def dump_report(self, path: str) -> str:
     """Writes :meth:`report` as JSON to ``path`` (dirs created)."""
@@ -299,6 +317,31 @@ class Scope:
 
   def snapshot(self) -> Dict[str, object]:
     return self._registry.snapshot(self._prefix)
+
+
+# Named extra sections merged into every report() — see Registry.report.
+# Process-global like the registry itself; guarded by its own lock so
+# providers can (un)register from any thread.
+_report_providers: Dict[str, object] = {}
+_providers_lock = threading.Lock()
+
+
+def register_report_provider(name: str, fn) -> None:
+  """Adds ``fn() -> dict`` as a named section of every ``report()``.
+
+  Reserved section names (the report's own keys) are rejected; a
+  re-registration under the same name replaces the previous provider
+  (the common restart-in-process case).
+  """
+  if name in ('kind', 'pid', 'uptime_sec', 'metrics'):
+    raise ValueError(f'report section name {name!r} is reserved')
+  with _providers_lock:
+    _report_providers[name] = fn
+
+
+def unregister_report_provider(name: str) -> None:
+  with _providers_lock:
+    _report_providers.pop(name, None)
 
 
 # The process-global instance (Prometheus-default-registry style); the

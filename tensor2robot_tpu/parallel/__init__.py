@@ -9,9 +9,12 @@ from tensor2robot_tpu.parallel.mesh import (
     SEQ_AXIS,
     MeshSpec,
     batch_sharding,
+    create_local_mesh,
     create_mesh,
+    describe_topology,
     global_batch_size,
     initialize_multihost,
+    mesh_spans_processes,
     replicated,
     shard_batch,
     single_device_mesh,

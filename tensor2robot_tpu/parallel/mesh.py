@@ -96,9 +96,60 @@ def create_mesh(devices: Optional[Sequence] = None,
   return MeshSpec(data=data, fsdp=fsdp, model=model, seq=seq).create(devices)
 
 
+def create_local_mesh(data: int = -1,
+                      fsdp: int = 1,
+                      model: int = 1,
+                      seq: int = 1) -> Mesh:
+  """A mesh over THIS process's devices only (per-host SPMD mode).
+
+  In a multi-process job each host then runs its own replica group:
+  batches are host-global, no cross-host collectives are compiled into
+  the step, and cross-host agreement (preemption, checkpoint commits,
+  liveness) is owned by the control plane
+  (``train/distributed_resilience.py``) rather than the data plane. This
+  is the layout the 2-process resilience drills run, and the fallback
+  for backends whose XLA build cannot execute multi-process programs.
+  """
+  return MeshSpec(data=data, fsdp=fsdp, model=model,
+                  seq=seq).create(jax.local_devices())
+
+
 def single_device_mesh() -> Mesh:
   return Mesh(np.asarray(jax.devices()[:1]).reshape((1, 1, 1, 1)),
               DEFAULT_AXES)
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+  """Whether ``mesh`` contains devices from more than one process.
+
+  The data-plane test multi-host code paths must branch on — NOT
+  ``jax.process_count()``: a per-host mesh in a multi-process job feeds
+  host-global batches exactly like a single-process run, while a global
+  mesh needs per-process shard assembly.
+  """
+  return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def describe_topology(mesh: Optional[Mesh] = None, **extra) -> Dict[str, Any]:
+  """The run topology a checkpoint is only valid within.
+
+  Recorded in every checkpoint commit marker
+  (``train/checkpoints.py``) and validated on restore: resuming a 2-host
+  run on 1 host (or onto a different mesh shape / microbatch config)
+  silently misinterprets the saved state, so the mismatch must fail
+  loudly instead. ``extra`` carries trainer-level knobs
+  (``grad_accum_microbatches``, ``steps_per_dispatch``).
+  """
+  out: Dict[str, Any] = {
+      'process_count': jax.process_count(),
+  }
+  if mesh is not None:
+    out['mesh_shape'] = {name: int(mesh.shape[name])
+                         for name in mesh.axis_names}
+    out['device_count'] = int(mesh.devices.size)
+    out['mesh_spans_processes'] = mesh_spans_processes(mesh)
+  out.update({k: v for k, v in extra.items() if v is not None})
+  return out
 
 
 # ---------------------------------------------------------------- shardings
@@ -194,7 +245,11 @@ def shard_batch(batch: Any, mesh: Mesh, formats: Any = None,
   (``steps_per_dispatch``); shard dim 1 instead of dim 0.
   """
   sharding = stacked_batch_sharding(mesh) if stacked else batch_sharding(mesh)
-  if jax.process_count() > 1:
+  # Branch on the MESH spanning processes, not on process_count: a
+  # per-host mesh in a multi-process job (the distributed-resilience
+  # drills, per-host replica groups) feeds host-global batches exactly
+  # like a single-process run.
+  if mesh_spans_processes(mesh):
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)), batch)

@@ -268,9 +268,30 @@ class ExportedModelPredictor(AbstractPredictor):
     return self._feature_spec
 
   def restore(self) -> bool:
+    # committed_export_dirs: torn/partial versions (no commit marker)
+    # are never load candidates; legacy marker-less roots stay visible.
     return poll_and_load_newest(
-        lambda: exporters_lib.valid_export_dirs(self._export_root),
-        self._loaded_dir, self._timeout, self._load)
+        lambda: exporters_lib.committed_export_dirs(self._export_root),
+        self._loaded_dir, self._timeout, self._load_with_fallback)
+
+  def _load_with_fallback(self, export_dir: str) -> bool:
+    """Falls back to the last-good loaded model on a failed hot reload
+    (same contract as SavedModelPredictor; ``predictor/load_fallbacks``)."""
+    try:
+      return self._load(export_dir)
+    except Exception as e:  # pylint: disable=broad-except
+      if not self.is_loaded:
+        raise
+      from tensor2robot_tpu.observability import metrics as metrics_lib
+
+      metrics_lib.counter('predictor/load_fallbacks').inc()
+      import logging
+
+      logging.warning(
+          'Hot reload of export %r failed (%r); continuing to serve the '
+          'last-good model from %r (step %d).', export_dir, e,
+          self._loaded_dir, self._global_step)
+      return True
 
   def _load(self, export_dir: str) -> bool:
     import hashlib
@@ -397,6 +418,12 @@ class ExportedModelPredictor(AbstractPredictor):
   @property
   def is_loaded(self) -> bool:
     return self._variables is not None
+
+  @property
+  def model_path(self) -> Optional[str]:
+    """The export version dir currently being served (None before
+    restore) — the hot-reload observability twin of global_step."""
+    return self._loaded_dir
 
   @property
   def global_step(self) -> int:

@@ -16,6 +16,7 @@ they instantiate this class.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
@@ -23,6 +24,7 @@ import numpy as np
 
 from tensor2robot_tpu.export import exporters as exporters_lib
 from tensor2robot_tpu.export import savedmodel as savedmodel_lib
+from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.predictors.predictors import (AbstractPredictor,
                                                     _expand_to_spec_rank,
                                                     poll_and_load_newest)
@@ -30,15 +32,30 @@ from tensor2robot_tpu.specs import SpecStruct, algebra
 
 
 def _saved_model_dirs(export_root: str):
-  """Export versions that carry a loadable SavedModel."""
+  """Export versions that carry a loadable SavedModel.
+
+  Commit-aware: versions without the export commit marker (torn/partial
+  exports — a replication that died mid-flight) are ignored, so a
+  hot-reloading robot host never loads half a model
+  (``export/uncommitted_skipped``); marker-less legacy roots stay fully
+  visible.
+  """
   return [
-      d for d in exporters_lib.valid_export_dirs(export_root)
+      d for d in exporters_lib.committed_export_dirs(export_root)
       if os.path.exists(os.path.join(d, savedmodel_lib.SAVED_MODEL_PB))
   ]
 
 
 class SavedModelPredictor(AbstractPredictor):
-  """Serves the newest export version through its SavedModel signature."""
+  """Serves the newest export version through its SavedModel signature.
+
+  Hot-reload hardened: a new version that fails to load (torn files the
+  marker could not catch, an incompatible signature) FALLS BACK to the
+  last-good loaded model instead of raising mid-control-loop — a robot
+  keeps acting on the previous policy while the fleet investigates —
+  counted as ``predictor/load_fallbacks``. The failure only propagates
+  when there is no last-good model to fall back to.
+  """
 
   def __init__(self,
                export_dir: str,
@@ -61,7 +78,20 @@ class SavedModelPredictor(AbstractPredictor):
   def restore(self) -> bool:
     return poll_and_load_newest(
         lambda: _saved_model_dirs(self._export_root),
-        self._loaded_dir, self._timeout, self._load)
+        self._loaded_dir, self._timeout, self._load_with_fallback)
+
+  def _load_with_fallback(self, export_dir: str) -> bool:
+    try:
+      return self._load(export_dir)
+    except Exception as e:  # pylint: disable=broad-except
+      if not self.is_loaded:
+        raise
+      metrics_lib.counter('predictor/load_fallbacks').inc()
+      logging.warning(
+          'Hot reload of export %r failed (%r); continuing to serve the '
+          'last-good model from %r (step %d).', export_dir, e,
+          self._loaded_dir, self._global_step)
+      return True
 
   def _load(self, export_dir: str) -> bool:
     import tensorflow as tf

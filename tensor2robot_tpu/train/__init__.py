@@ -5,6 +5,15 @@ from tensor2robot_tpu.train.checkpoints import (
     checkpoints_iterator,
     latest_checkpoint_step,
 )
+from tensor2robot_tpu.train.distributed_resilience import (
+    LIVENESS_EXIT_CODE,
+    CoordinatedShutdown,
+    DeadHostError,
+    DistributedContext,
+    HeartbeatService,
+    TopologyMismatchError,
+    aggregate_snapshots,
+)
 from tensor2robot_tpu.train.train_state import (
     TrainState,
     apply_ema,

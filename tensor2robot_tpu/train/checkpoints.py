@@ -8,15 +8,32 @@ restart-from-latest Estimator semantics, and the continuous evaluator's
 checkpoint BACKUP: a separate evaluator process copies the step it wants
 to evaluate into its own directory first, so the trainer's retention GC
 cannot delete it mid-restore (``utils/train_eval.py:590-707``).
+
+Atomic commit protocol (the distributed-resilience extension): every
+finished checkpoint step carries a ``commit.json`` marker recording the
+run topology (process count, mesh shape, microbatch config) and, in
+multi-process runs, an ack file from EVERY host. A checkpoint is only
+*visible* — to ``restore``, :func:`latest_checkpoint_step`, the
+continuous evaluator and the predictors — once the marker exists, which
+happens strictly after all hosts finished writing (barriered over the
+``jax.distributed`` coordination service, ``train/
+distributed_resilience.py``). A step without its marker is a TORN
+checkpoint (a save cut off by preemption or a dead host) and is skipped
+with a ``checkpoint/torn_skipped`` count; a marker whose topology does
+not match the current run fails loudly instead of silently
+misinterpreting the state. Directories written before this protocol
+(no markers anywhere) keep the PR-1 behavior: try newest, fall back on
+parse errors.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import shutil
 import time
-from typing import Any, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -24,55 +41,364 @@ import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.train.distributed_resilience import (
+    DistributedContext, TopologyMismatchError)
+
+COMMIT_FILENAME = 'commit.json'
+HOST_ACK_PREFIX = 'host_ack_'
+
+# (directory, step) pairs already reported as torn, so polling callers
+# (checkpoints_iterator scans every second) count each torn checkpoint
+# once rather than once per scan.
+_REPORTED_TORN: Set[Tuple[str, int]] = set()
+
+
+def _step_dir(directory: str, step: int) -> str:
+  return os.path.join(directory, f'ckpt_{int(step)}')
+
+
+def commit_marker_path(directory: str, step: int) -> str:
+  return os.path.join(_step_dir(directory, step), COMMIT_FILENAME)
+
+
+def read_commit_marker(directory: str, step: int) -> Optional[Dict[str, Any]]:
+  """The commit marker for ``step``, or None if absent/unreadable."""
+  try:
+    with open(commit_marker_path(directory, step)) as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return None
+
+
+def write_commit_marker(directory: str, step: int,
+                        topology: Optional[Dict[str, Any]] = None,
+                        hosts: Optional[List[int]] = None) -> str:
+  """Atomically publishes the commit marker for ``step``."""
+  payload = {
+      'step': int(step),
+      'time': time.time(),
+      'hosts': sorted(hosts) if hosts is not None else [0],
+  }
+  if topology is not None:
+    payload['topology'] = dict(topology)
+  path = commit_marker_path(directory, step)
+  tmp = f'{path}.tmp{os.getpid()}'
+  with open(tmp, 'w') as f:
+    json.dump(payload, f, indent=2)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  return path
+
+
+def _fs_steps(directory: str) -> List[int]:
+  """Step numbers present on disk (any commit status), ascending."""
+  try:
+    names = os.listdir(directory)
+  except FileNotFoundError:
+    return []
+  steps = []
+  for name in names:
+    if not name.startswith('ckpt_') or name.endswith('.orbax-checkpoint-tmp'):
+      continue
+    suffix = name.rsplit('_', 1)[-1]
+    if suffix.isdigit():
+      steps.append(int(suffix))
+  return sorted(steps)
+
+
+def _report_torn(directory: str, step: int, where: str) -> None:
+  key = (os.path.abspath(directory), int(step))
+  if key in _REPORTED_TORN:
+    return
+  _REPORTED_TORN.add(key)
+  metrics_lib.counter('checkpoint/torn_skipped').inc()
+  logging.warning(
+      'Checkpoint step %d under %r has no commit marker — a torn '
+      'checkpoint (save cut off by preemption or a dead host); skipping '
+      'it in %s.', step, directory, where)
+
+
+def _committed_steps(directory: str, steps: List[int],
+                     where: str) -> Tuple[List[int], bool]:
+  """Filters ``steps`` to committed ones under the legacy rule.
+
+  Returns ``(visible_steps, protocol_active)``: if NO step carries a
+  marker the directory predates the commit protocol and every step stays
+  visible (PR-1 behavior); once any marker exists, unmarked steps are
+  torn and are skipped with a ``checkpoint/torn_skipped`` count.
+  """
+  marked = [s for s in steps
+            if os.path.exists(commit_marker_path(directory, s))]
+  if not marked:
+    return steps, False
+  for s in steps:
+    if s not in marked:
+      _report_torn(directory, s, where)
+  return marked, True
+
+
+def _check_topology(saved: Optional[Dict[str, Any]],
+                    expected: Optional[Dict[str, Any]],
+                    directory: str, step: int) -> None:
+  """Loud, actionable error when a checkpoint's topology mismatches."""
+  if not saved or not expected:
+    return
+  mismatches = {
+      key: (saved[key], expected[key])
+      for key in sorted(set(saved) & set(expected))
+      if saved[key] != expected[key]
+  }
+  if not mismatches:
+    return
+  detail = '; '.join(
+      f'{key}: checkpoint has {was!r}, this run has {now!r}'
+      for key, (was, now) in mismatches.items())
+  raise TopologyMismatchError(
+      f'Checkpoint step {step} under {directory!r} was saved with a '
+      f'different topology than this run: {detail}. Restoring it would '
+      f'silently misinterpret the saved state. Either relaunch with the '
+      f'recorded topology (e.g. the same number of processes and mesh '
+      f'shape), or — if the change is intentional — disable the check '
+      f'with TrainerConfig.checkpoint_topology_check=False / '
+      f'CheckpointManager(topology=None).')
 
 
 class CheckpointManager:
-  """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+  """Orbax wrapper with an atomic (multi-host-aware) commit protocol.
+
+  Single-process: the Orbax manager behaves as before, plus every
+  finalized step gets a ``commit.json`` marker (written once the async
+  write is known complete — at the next ``save`` or at
+  ``wait_until_finished``), and ``restore`` prefers committed steps.
+
+  Multi-process (``distributed`` context passed): process 0 is the
+  single payload writer — its Orbax manager runs with
+  ``active_processes={0}`` so Orbax's internal barriers never span the
+  job — and commit requires every host:
+
+    1. primary saves the payload (synchronously) and waits;
+    2. barrier; every host writes its ``host_ack_<p>.json`` into the
+       step dir (the per-host "shard" — carrying process metadata — that
+       fault injection can corrupt);
+    3. barrier; primary validates all acks and atomically publishes
+       ``commit.json`` with the run topology;
+    4. barrier; ``save`` returns True on every host.
+
+  Any host dying mid-protocol leaves the step UNCOMMITTED (never
+  restored) and surfaces as a bounded
+  :class:`~tensor2robot_tpu.train.distributed_resilience.DeadHostError`
+  on the survivors instead of a hang.
+  """
 
   def __init__(self,
                directory: str,
                max_to_keep: Optional[int] = 5,
                keep_period: Optional[int] = None,
                save_interval_steps: int = 1,
-               async_save: bool = True):
+               async_save: bool = True,
+               topology: Optional[Dict[str, Any]] = None,
+               distributed: Optional[DistributedContext] = None,
+               barrier_timeout_secs: float = 600.0):
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    options = ocp.CheckpointManagerOptions(
-        max_to_keep=max_to_keep,
-        keep_period=keep_period,
-        save_interval_steps=save_interval_steps,
-        enable_async_checkpointing=async_save,
-        step_prefix='ckpt')
-    self._manager = ocp.CheckpointManager(directory, options=options)
     self._directory = directory
+    self._topology = dict(topology) if topology else None
+    self._ctx = distributed
+    self._barrier_timeout = float(barrier_timeout_secs)
+    self._save_interval = max(1, int(save_interval_steps))
+    self._save_seq = 0  # barrier-id uniqueness across repeated saves
+    self._pending_marker: Optional[int] = None
+    self._manager: Optional[ocp.CheckpointManager] = None
+    self._restore_checkpointer = None
+    if self._ctx is None or self._ctx.is_primary:
+      extra = {}
+      if self._ctx is not None:
+        # Orbax must never barrier across the job: our commit protocol
+        # owns cross-host ordering (over the coordination service, with
+        # bounded timeouts); Orbax's own syncs collapse to this process.
+        # Multi-process commit is also barrier-synchronous — the marker
+        # must only be published once the payload is durably on disk —
+        # so async writes buy nothing and are disabled. Orbax refuses
+        # create=True with active_processes set; the root directory was
+        # created above.
+        async_save = False
+        extra = dict(
+            create=False,
+            multiprocessing_options=ocp.options.MultiprocessingOptions(
+                primary_host=self._ctx.process_index,
+                active_processes={self._ctx.process_index},
+                barrier_sync_key_prefix=(
+                    f't2r_ckpt_p{self._ctx.process_index}')))
+      options = ocp.CheckpointManagerOptions(
+          max_to_keep=max_to_keep,
+          keep_period=keep_period,
+          save_interval_steps=save_interval_steps,
+          enable_async_checkpointing=async_save,
+          step_prefix='ckpt',
+          **extra)
+      self._manager = ocp.CheckpointManager(directory, options=options)
 
   @property
   def directory(self) -> str:
     return self._directory
 
+  @property
+  def topology(self) -> Optional[Dict[str, Any]]:
+    return self._topology
+
+  def _flush_pending_marker(self) -> None:
+    """Publishes the marker for the last async save once it finished.
+
+    Called with the Orbax write known complete (after
+    ``wait_until_finished`` or at the head of the next ``save`` — Orbax
+    serializes saves, so starting a new one implies the previous write
+    is durable). A crash before this point correctly leaves the step
+    uncommitted: its write may be torn.
+    """
+    if self._pending_marker is None:
+      return
+    step, self._pending_marker = self._pending_marker, None
+    if os.path.isdir(_step_dir(self._directory, step)):
+      write_commit_marker(self._directory, step, topology=self._topology)
+    else:
+      # Retention GC may legitimately have collected the step already;
+      # anything else (e.g. a still-unfinalized write) is a bug worth
+      # hearing about — the step would read as torn forever.
+      logging.warning(
+          'Commit marker for checkpoint step %d skipped: step directory '
+          'no longer exists under %r.', step, self._directory)
+
   def save(self, step: int, state, force: bool = False) -> bool:
+    step = int(step)
+    if self._ctx is not None:
+      return self._save_distributed(step, state, force)
     # Hand Orbax the DEVICE arrays: its async path owns the device→host
     # copy (blocking only for the D2H transfer, writing to disk in the
     # background). An eager jax.device_get here would serialize a full
     # host copy into the train loop even with async_save=True, defeating
     # async checkpointing. Safe against buffer donation: Orbax completes
     # the D2H copy before save() returns.
-    step = int(step)
     if step in self._manager.all_steps():
       return False  # already saved (e.g. final forced save after an in-loop one)
     # checkpoint/save_ms is what the TRAIN LOOP pays (with async_save it
     # covers only the blocking D2H copy; the disk write happens in the
     # background and is accounted by checkpoint/wait_ms at barriers).
     with tracing.span('checkpoint/save'):
+      if self._pending_marker is not None:
+        # The previous async write must be DURABLE before its marker is
+        # published (the whole point of the marker). Orbax's save would
+        # wait on it internally anyway, so this adds no stall.
+        self._manager.wait_until_finished()
+        self._flush_pending_marker()
       saved = self._manager.save(
           step, args=ocp.args.StandardSave(state), force=force)
     if saved:
+      self._pending_marker = step
       metrics_lib.counter('checkpoint/saves').inc()
     return saved
+
+  def _save_distributed(self, step: int, state, force: bool) -> bool:
+    """The multi-host commit protocol; every host calls this at the same
+    step (the trainer's boundaries guarantee it)."""
+    ctx = self._ctx
+    if read_commit_marker(self._directory, step) is not None:
+      return False  # already committed; consistent across hosts
+    if not force and step % self._save_interval:
+      return False  # mirror Orbax's own interval gate, identically per host
+    self._save_seq += 1
+    seq = self._save_seq
+    step_dir = _step_dir(self._directory, step)
+    with tracing.span('checkpoint/save'):
+      if self._manager is not None:
+        # Single payload writer. The host copy is explicit: with a
+        # per-host mesh in a multi-process runtime Orbax refuses device
+        # arrays, and the commit barriers serialize on the write anyway.
+        if step not in self._manager.all_steps():
+          self._manager.save(
+              step, args=ocp.args.StandardSave(jax.device_get(state)),
+              force=True)
+          self._manager.wait_until_finished()
+      ctx.barrier(f'ckpt/{step}/{seq}/saved', self._barrier_timeout)
+      # Every host acknowledges INTO the step dir: the commit marker is
+      # only written over a complete set of acks, so a host that died
+      # before finishing leaves the step uncommitted.
+      ack = {
+          'process_index': ctx.process_index,
+          'step': step,
+          'pid': os.getpid(),
+          'time': time.time(),
+      }
+      ack_path = os.path.join(
+          step_dir, f'{HOST_ACK_PREFIX}{ctx.process_index}.json')
+      tmp = f'{ack_path}.tmp{os.getpid()}'
+      with open(tmp, 'w') as f:
+        json.dump(ack, f)
+        f.flush()
+        os.fsync(f.fileno())
+      os.replace(tmp, ack_path)
+      ctx.barrier(f'ckpt/{step}/{seq}/acked', self._barrier_timeout)
+      if ctx.is_primary:
+        acked = self._read_acks(step)
+        missing = set(range(ctx.process_count)) - set(acked)
+        if missing:
+          raise RuntimeError(
+              f'checkpoint step {step}: host ack(s) missing for '
+              f'process(es) {sorted(missing)} AFTER the ack barrier '
+              f'passed — the shared filesystem dropped or corrupted '
+              f'them; refusing to commit a torn checkpoint.')
+        write_commit_marker(self._directory, step, topology=self._topology,
+                            hosts=sorted(acked))
+      ctx.barrier(f'ckpt/{step}/{seq}/committed', self._barrier_timeout)
+    metrics_lib.counter('checkpoint/saves').inc()
+    return True
+
+  def _read_acks(self, step: int) -> List[int]:
+    step_dir = _step_dir(self._directory, step)
+    acked = []
+    try:
+      names = os.listdir(step_dir)
+    except FileNotFoundError:
+      return acked
+    for name in names:
+      if not (name.startswith(HOST_ACK_PREFIX) and name.endswith('.json')):
+        continue
+      try:
+        with open(os.path.join(step_dir, name)) as f:
+          acked.append(int(json.load(f)['process_index']))
+      except (OSError, ValueError, KeyError, TypeError):
+        continue  # unparseable ack == no ack: the step stays uncommitted
+    return acked
+
+  def _restore_payload(self, step: int, target):
+    """Reads one step's payload into ``target``'s structure."""
+    if self._manager is not None:
+      return self._manager.restore(
+          int(step), args=ocp.args.StandardRestore(target))
+    # Non-primary host: single-process read of the committed payload.
+    if self._restore_checkpointer is None:
+      ctx = self._ctx
+      self._restore_checkpointer = ocp.Checkpointer(
+          ocp.StandardCheckpointHandler(),
+          multiprocessing_options=ocp.options.MultiprocessingOptions(
+              primary_host=ctx.process_index,
+              active_processes={ctx.process_index},
+              barrier_sync_key_prefix=f't2r_restore_p{ctx.process_index}'))
+    item_dir = os.path.join(_step_dir(self._directory, step), 'default')
+    if not os.path.isdir(item_dir):
+      item_dir = _step_dir(self._directory, step)
+    return self._restore_checkpointer.restore(
+        item_dir, args=ocp.args.StandardRestore(target))
 
   def restore(self, state, step: Optional[int] = None,
               fallback_to_older: bool = True):
     """Restores into the structure of ``state`` (an abstract/concrete tree).
+
+    Only COMMITTED steps are candidates once the commit protocol is in
+    use (any marker present); a step missing its marker is torn and is
+    never restored (``checkpoint/torn_skipped``). The committed step's
+    recorded topology must match this manager's (when both are known) or
+    a :class:`TopologyMismatchError` explains the mismatch.
 
     With ``fallback_to_older`` (the default when no explicit ``step`` is
     requested), a truncated/corrupt latest checkpoint — the signature of
@@ -82,21 +408,39 @@ class CheckpointManager:
     restores exactly that step or raises.
     """
     if step is not None:
+      step = int(step)
+      _, protocol_active = _committed_steps(
+          self._directory, _fs_steps(self._directory), 'restore')
+      marker = read_commit_marker(self._directory, step)
+      if protocol_active and marker is None:
+        raise RuntimeError(
+            f'checkpoint step {step} under {self._directory!r} has no '
+            f'commit marker (torn/uncommitted); refusing to restore it.')
+      if marker is not None:
+        _check_topology(marker.get('topology'), self._topology,
+                        self._directory, step)
       with tracing.span('checkpoint/restore'):
-        restored = self._manager.restore(
-            int(step), args=ocp.args.StandardRestore(jax.device_get(state)))
+        restored = self._restore_payload(step, jax.device_get(state))
       metrics_lib.counter('checkpoint/restores').inc()
       return restored
-    steps = sorted(self._manager.all_steps(), reverse=True)
+    steps, _ = _committed_steps(
+        self._directory, _fs_steps(self._directory), 'restore')
+    steps = sorted(steps, reverse=True)
     if not steps:
       return None
     target = jax.device_get(state)
     last_exc: Optional[BaseException] = None
     for i, s in enumerate(steps):
+      marker = read_commit_marker(self._directory, s)
+      if marker is not None:
+        # Topology mismatch is NOT a fallback case: every step in this
+        # directory came from the same job shape, so older steps would
+        # fail identically — raise the actionable error instead.
+        _check_topology(marker.get('topology'), self._topology,
+                        self._directory, s)
       try:
         with tracing.span('checkpoint/restore'):
-          restored = self._manager.restore(
-              int(s), args=ocp.args.StandardRestore(target))
+          restored = self._restore_payload(s, target)
         metrics_lib.counter('checkpoint/restores').inc()
         if i > 0:
           metrics_lib.counter('checkpoint/restore_fallbacks').inc(i)
@@ -116,18 +460,34 @@ class CheckpointManager:
         f'to restore; last error: {last_exc!r}') from last_exc
 
   def latest_step(self) -> Optional[int]:
-    return self._manager.latest_step()
+    if self._manager is not None and self._ctx is None:
+      return self._manager.latest_step()
+    steps = _fs_steps(self._directory)
+    return steps[-1] if steps else None
+
+  def latest_committed_step(self) -> Optional[int]:
+    """Newest step ``restore`` would actually consider."""
+    steps, _ = _committed_steps(
+        self._directory, _fs_steps(self._directory), 'latest_committed_step')
+    return steps[-1] if steps else None
 
   def all_steps(self):
-    return sorted(self._manager.all_steps())
+    if self._manager is not None and self._ctx is None:
+      return sorted(self._manager.all_steps())
+    return _fs_steps(self._directory)
 
   def wait_until_finished(self) -> None:
     # Time the train loop spends barriered on in-flight async writes.
     with tracing.span('checkpoint/wait'):
-      self._manager.wait_until_finished()
+      if self._manager is not None:
+        self._manager.wait_until_finished()
+      self._flush_pending_marker()
 
   def close(self) -> None:
-    self._manager.close()
+    if self._manager is not None:
+      self._manager.wait_until_finished()
+      self._flush_pending_marker()
+      self._manager.close()
 
   def __enter__(self):
     return self
@@ -137,25 +497,23 @@ class CheckpointManager:
 
 
 def latest_checkpoint_step(directory: str) -> Optional[int]:
-  """Latest finalized step in ``directory`` without opening a manager.
+  """Latest COMMITTED step in ``directory`` without opening a manager.
 
   Non-numeric ``ckpt_*`` entries (stray tmp dirs, editor droppings,
   backup copies) are skipped rather than crashing the scan — this
   function gates resume decisions and continuous eval, so it must stay
   robust to whatever accumulates in a long-lived model dir.
+
+  Commit-aware: once any step in the directory carries a commit marker,
+  unmarked steps are torn (or still being written) and are not reported
+  — so the continuous evaluator and the predictors never pick up a
+  checkpoint mid-write. Each torn step counts ``checkpoint/torn_skipped``
+  once (not once per poll). Marker-less legacy directories behave as
+  before.
   """
-  try:
-    names = os.listdir(directory)
-  except FileNotFoundError:
-    return None
-  steps = []
-  for name in names:
-    if not name.startswith('ckpt_') or name.endswith('.orbax-checkpoint-tmp'):
-      continue
-    suffix = name.rsplit('_', 1)[-1]
-    if suffix.isdigit():
-      steps.append(int(suffix))
-  return max(steps) if steps else None
+  steps, _ = _committed_steps(directory, _fs_steps(directory),
+                              'latest_checkpoint_step')
+  return steps[-1] if steps else None
 
 
 EVAL_BACKUP_DIRNAME = 'current_eval_checkpoint'

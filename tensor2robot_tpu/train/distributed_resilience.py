@@ -1,0 +1,555 @@
+"""Distributed fault tolerance: the control plane for multi-process runs.
+
+PR 1's resilience layer is single-process: ``GracefulShutdown`` only
+checkpoints the host that caught the signal, and nothing detects a peer
+that died mid-collective. On a real pod preemption hits ONE worker first
+— an uncoordinated checkpoint is a torn checkpoint. This module adds the
+host-level coordination that makes every prior subsystem survive a pod:
+
+* :class:`DistributedContext` — a thin, timeout-bounded wrapper over the
+  ``jax.distributed`` coordination service (gRPC key-value store +
+  barriers). Everything here is deliberately **control plane**: no
+  device collectives, so coordination works on any backend, keeps
+  working while the data plane is wedged, and every wait has an
+  enforceable deadline (a hung XLA collective does not).
+* :class:`CoordinatedShutdown` — any process's SIGTERM propagates to all
+  processes: the first observer proposes a stop, every host publishes
+  its current dispatch boundary, and all agree on ``max`` — so every
+  host forces a checkpoint at the SAME step and exits resumable
+  (``PREEMPTED_EXIT_CODE``) together.
+* :class:`HeartbeatService` — each host publishes a heartbeat file
+  (last-completed step + a registry snapshot) into the shared model dir;
+  a monitor thread flags stragglers and declares a host DEAD after a
+  timeout, exiting with :data:`LIVENESS_EXIT_CODE` and a loud error
+  instead of hanging forever in a collective or barrier.
+* :func:`aggregate_snapshots` — process-0 merges the per-host registry
+  snapshots riding the heartbeats (counters summed, gauges labeled per
+  host), so train scalars, ``/metricsz`` and the end-of-run report
+  reflect the whole job instead of one process (closes the PR-2 ROADMAP
+  follow-up).
+
+The atomic multi-host checkpoint commit protocol built on these
+primitives lives in ``train/checkpoints.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.train import resilience
+
+# A host that declared a PEER dead exits with this status: distinct from
+# PREEMPTED_EXIT_CODE (42, resumable as-is) — the scheduler should
+# restart the WHOLE job, not just this worker.
+LIVENESS_EXIT_CODE = 43
+
+HEARTBEAT_DIRNAME = 'heartbeats'
+
+
+class DeadHostError(RuntimeError):
+  """A peer process stopped participating (barrier timeout / stale
+  heartbeat). Raised instead of hanging forever; carries the exit status
+  long-running binaries should use."""
+
+  exit_code = LIVENESS_EXIT_CODE
+
+
+class TopologyMismatchError(RuntimeError):
+  """A checkpoint's recorded topology does not match the current run."""
+
+
+def _coordination_client():
+  """The process's jax.distributed coordination-service client, or None."""
+  try:
+    from jax._src import distributed  # pylint: disable=g-import-not-at-top
+
+    return distributed.global_state.client
+  except Exception:  # pylint: disable=broad-except
+    return None
+
+
+class DistributedContext:
+  """Host-level coordination fabric: gRPC KV store + bounded barriers.
+
+  All keys/barrier ids are namespaced, every blocking call takes a
+  timeout, and a barrier timeout surfaces as :class:`DeadHostError`
+  (naming the barrier) rather than the raw gRPC DEADLINE_EXCEEDED.
+  """
+
+  def __init__(self, client, process_index: int, process_count: int,
+               namespace: str = 't2r'):
+    self._client = client
+    self.process_index = int(process_index)
+    self.process_count = int(process_count)
+    self._ns = namespace.rstrip('/')
+
+  @classmethod
+  def create(cls, namespace: str = 't2r') -> Optional['DistributedContext']:
+    """The context for this process, or None outside a multi-process job."""
+    import jax  # pylint: disable=g-import-not-at-top
+
+    if jax.process_count() <= 1:
+      return None
+    client = _coordination_client()
+    if client is None:
+      logging.warning(
+          'Multi-process run (%d processes) without a jax.distributed '
+          'coordination client; distributed resilience is DISABLED — '
+          'preemption and checkpoints will be uncoordinated.',
+          jax.process_count())
+      return None
+    return cls(client, jax.process_index(), jax.process_count(),
+               namespace=namespace)
+
+  @property
+  def is_primary(self) -> bool:
+    return self.process_index == 0
+
+  def _key(self, key: str) -> str:
+    return f'{self._ns}/{key}'
+
+  def put(self, key: str, value: str) -> bool:
+    """First-writer-wins set; False if another process set it first."""
+    try:
+      self._client.key_value_set(self._key(key), str(value))
+      return True
+    except Exception as e:  # pylint: disable=broad-except
+      if 'ALREADY_EXISTS' in str(e):
+        return False
+      raise
+
+  def get_dir(self, prefix: str) -> Dict[str, str]:
+    """Non-blocking: all (key, value) pairs under ``prefix``, unprefixed."""
+    full = self._key(prefix)
+    out = {}
+    for key, value in self._client.key_value_dir_get(full):
+      out[key[len(self._key('')):]] = value
+    return out
+
+  def get(self, key: str, timeout_secs: float) -> Optional[str]:
+    """Blocking get; None if the key never appears within the timeout."""
+    try:
+      return self._client.blocking_key_value_get(
+          self._key(key), int(timeout_secs * 1000))
+    except Exception:  # pylint: disable=broad-except
+      return None
+
+  def barrier(self, name: str, timeout_secs: float) -> None:
+    """All processes wait at ``name``; DeadHostError on timeout.
+
+    Barrier ids are one-shot in the coordination service — callers must
+    make ``name`` unique per use (embed the step / a sequence number).
+    """
+    try:
+      with tracing_span('distributed/barrier'):
+        self._client.wait_at_barrier(self._key(name),
+                                     int(timeout_secs * 1000))
+    except Exception as e:  # pylint: disable=broad-except
+      metrics_lib.counter('distributed/barrier_timeouts').inc()
+      raise DeadHostError(
+          f'process {self.process_index}/{self.process_count} timed out '
+          f'after {timeout_secs:.0f}s at barrier {name!r}: one or more '
+          f'peer processes stopped participating (preempted, crashed, or '
+          f'wedged). The job should be restarted as a whole; resuming '
+          f'will restore the last COMMITTED checkpoint. Underlying '
+          f'error: {e}') from e
+
+
+def tracing_span(name: str):
+  """Lazy import of the tracing span (observability stays optional)."""
+  from tensor2robot_tpu.observability import tracing  # pylint: disable=g-import-not-at-top
+
+  return tracing.span(name, annotate=False)
+
+
+class CoordinatedShutdown:
+  """Cross-host preemption agreement over the coordination KV store.
+
+  Polled at every dispatch boundary (the same place the single-process
+  loop checks ``GracefulShutdown.requested``), plus once after the loop:
+
+  1. A host whose LOCAL shutdown flag is set proposes a stop (a
+     KV entry under ``shutdown/proposal/``).
+  2. Every host that observes a proposal publishes its own current
+     boundary step — all of them within one dispatch, since all poll
+     every boundary; a host that already COMPLETED training publishes
+     its final step from the trainer's post-loop poll.
+  3. Every host spin-polls the KV store (deadline-bounded — never a
+     hang) until all ``process_count`` steps are published, then
+     computes the SAME target ``max(published steps)`` and keeps
+     training until it reaches it, so the forced checkpoint lands on
+     one common step on every host.
+
+  Deliberately BARRIER-FREE: a gRPC barrier would deadlock against the
+  checkpoint-commit barriers when one host finishes training before the
+  proposal lands. With KV polling that skew resolves instead: the
+  completed host's published (final) step wins the max, every other
+  host trains to it, and the aligned final save commits normally.
+
+  ``poll`` returns the agreed target step (or None). The trainer
+  checkpoints at the first boundary >= target and raises
+  :class:`~tensor2robot_tpu.train.resilience.PreemptedError`.
+  """
+
+  def __init__(self,
+               context: DistributedContext,
+               local: Optional[resilience.GracefulShutdown],
+               negotiate_timeout_secs: float = 120.0,
+               poll_interval_secs: float = 0.05):
+    self._ctx = context
+    self._local = local
+    self._timeout = float(negotiate_timeout_secs)
+    self._poll_interval = float(poll_interval_secs)
+    self._proposed = False
+    self._published = False
+    self._target: Optional[int] = None
+    self._m_stops = metrics_lib.counter('distributed/coordinated_stops')
+    self._m_target = metrics_lib.gauge('distributed/coordinated_stop_step')
+
+  @property
+  def target_step(self) -> Optional[int]:
+    return self._target
+
+  def request(self) -> None:
+    """Programmatic local shutdown request (tests, cluster agents)."""
+    if self._local is not None:
+      self._local.request()
+
+  def poll(self, step: int) -> Optional[int]:
+    """One boundary's coordination round; returns the agreed stop step."""
+    if self._target is not None:
+      return self._target
+    if (not self._proposed and self._local is not None
+        and self._local.requested):
+      self._proposed = True
+      # Directory-style key: the coordination service's dir_get only
+      # lists keys UNDER a prefix, so the poll below can see it.
+      self._ctx.put(f'shutdown/proposal/{self._ctx.process_index}',
+                    str(int(step)))
+      logging.warning(
+          'Process %d observed a local shutdown signal at step %d; '
+          'proposing a coordinated stop to all %d processes.',
+          self._ctx.process_index, step, self._ctx.process_count)
+    if not self._ctx.get_dir('shutdown/proposal/'):
+      return None
+    # A proposal exists (ours or a peer's): publish this host's boundary
+    # once — we then PAUSE here (the published step must stay our true
+    # position) until every host has published, bounded by the deadline.
+    if not self._published:
+      self._published = True
+      self._ctx.put(f'shutdown/step/{self._ctx.process_index}',
+                    str(int(step)))
+    deadline = time.monotonic() + self._timeout
+    while True:
+      published = self._ctx.get_dir('shutdown/step/')
+      if len(published) >= self._ctx.process_count:
+        break
+      if time.monotonic() > deadline:
+        metrics_lib.counter('distributed/barrier_timeouts').inc()
+        raise DeadHostError(
+            f'coordinated shutdown negotiation: only {len(published)} of '
+            f'{self._ctx.process_count} processes published a stop '
+            f'boundary within {self._timeout:.0f}s — one or more peers '
+            f'died mid-negotiation. Restart the job; it will resume from '
+            f'the last committed checkpoint.')
+      time.sleep(self._poll_interval)
+    # Keys come back namespace-stripped but path-full: 'shutdown/step/<p>'.
+    steps = {int(key.rsplit('/', 1)[-1]): int(value)
+             for key, value in published.items()}
+    self._target = max(steps.values())
+    self._m_stops.inc()
+    self._m_target.set(self._target)
+    logging.warning(
+        'Coordinated stop agreed: all %d processes checkpoint at step %d '
+        '(published boundaries: %s).', self._ctx.process_count,
+        self._target, {f'host{h}': s for h, s in sorted(steps.items())})
+    return self._target
+
+
+# ------------------------------------------------ heartbeats + aggregation
+
+
+def aggregate_snapshots(snapshots: Dict[int, Dict[str, Any]]
+                        ) -> Dict[str, Any]:
+  """Merges per-host registry snapshots into one job-level view.
+
+  * counters (int values) are SUMMED under their original name;
+  * gauges (float values) are labeled per host — ``name/host<p>`` — a
+    gauge has no meaningful cross-host sum;
+  * histograms (dict values) merge count/sum (mean recomputed);
+    min/max/percentiles are per-host artifacts and are dropped.
+  """
+  merged: Dict[str, Any] = {}
+  for host in sorted(snapshots):
+    for name, value in snapshots[host].items():
+      if isinstance(value, bool):
+        continue
+      if isinstance(value, int):
+        merged[name] = merged.get(name, 0) + value
+      elif isinstance(value, float):
+        merged[f'{name}/host{host}'] = value
+      elif isinstance(value, dict):
+        agg = merged.setdefault(name, {'count': 0, 'sum': 0.0})
+        if 'count' in agg:  # guard against a counter/hist name collision
+          agg['count'] += int(value.get('count', 0))
+          agg['sum'] += float(value.get('sum', 0.0))
+          agg['mean'] = agg['sum'] / agg['count'] if agg['count'] else 0.0
+  return merged
+
+
+class HeartbeatService:
+  """Per-host liveness publisher + peer monitor over the shared model dir.
+
+  Each host atomically rewrites ``<directory>/host_<p>.json`` every
+  ``interval_secs``: wall time, last-completed step, pid, and a registry
+  snapshot (the payload process-0 aggregates). The same thread monitors
+  every peer's file:
+
+  * age > ``straggler_after_secs`` → flagged (gauge + counter + log);
+  * age > ``dead_after_secs`` → the peer is DEAD. ``action='exit'``
+    (what the trainer installs) logs a loud liveness error and calls
+    ``os._exit(LIVENESS_EXIT_CODE)`` — the only way out when the main
+    thread is wedged inside a collective; ``action='flag'`` records the
+    dead set for the owner to act on (tests, embedders).
+
+  The shared directory is the same filesystem the checkpoints already
+  require (GCS/NFS on a real pod), so heartbeats need no extra
+  infrastructure and remain observable post-mortem.
+  """
+
+  def __init__(self,
+               directory: str,
+               process_index: int,
+               process_count: int,
+               interval_secs: float = 5.0,
+               straggler_after_secs: float = 15.0,
+               dead_after_secs: float = 60.0,
+               action: str = 'exit',
+               include_metrics: bool = True,
+               on_dead: Optional[Callable[[Set[int]], None]] = None):
+    if action not in ('exit', 'flag'):
+      raise ValueError(f"action must be 'exit' or 'flag', got {action!r}")
+    self._dir = directory
+    self.process_index = int(process_index)
+    self.process_count = int(process_count)
+    self._interval = float(interval_secs)
+    self._straggler_after = float(straggler_after_secs)
+    self._dead_after = float(dead_after_secs)
+    self._action = action
+    self._include_metrics = include_metrics
+    self._on_dead = on_dead
+    self._step = 0
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self._started_at = time.time()
+    self.dead_hosts: Set[int] = set()
+    self._flagged_stragglers: Set[int] = set()
+    hb = metrics_lib.scope('distributed/heartbeat')
+    self._m_beats = hb.counter('beats')
+    self._m_stragglers = hb.counter('stragglers_flagged')
+    self._m_last_step = hb.gauge('last_completed_step')
+    self._hb_scope = hb
+
+  # ------------------------------------------------------------- publishing
+
+  def set_step(self, step: int) -> None:
+    """Called by the train loop at each dispatch boundary."""
+    self._step = int(step)
+
+  def _path(self, host: int) -> str:
+    return os.path.join(self._dir, f'host_{host}.json')
+
+  def beat(self, done: bool = False) -> None:
+    """Publishes one heartbeat (atomic tmp+rename, crash-safe)."""
+    os.makedirs(self._dir, exist_ok=True)
+    payload = {
+        'time': time.time(),
+        'step': self._step,
+        'pid': os.getpid(),
+        'process_index': self.process_index,
+        'done': bool(done),
+    }
+    if self._include_metrics:
+      payload['metrics'] = metrics_lib.snapshot()
+    path = self._path(self.process_index)
+    tmp = f'{path}.tmp{os.getpid()}'
+    with open(tmp, 'w') as f:
+      json.dump(payload, f)
+    os.replace(tmp, path)
+    self._m_beats.inc()
+    self._m_last_step.set(self._step)
+
+  # ------------------------------------------------------------- monitoring
+
+  def read_peers(self) -> Dict[int, Dict[str, Any]]:
+    """All hosts' latest heartbeat payloads (including our own file)."""
+    out = {}
+    for host in range(self.process_count):
+      try:
+        with open(self._path(host)) as f:
+          out[host] = json.load(f)
+      except (OSError, ValueError):
+        continue
+    return out
+
+  def check_peers(self) -> Dict[int, float]:
+    """One monitoring pass; returns peer → heartbeat age in seconds."""
+    now = time.time()
+    peers = self.read_peers()
+    ages: Dict[int, float] = {}
+    newly_dead: Set[int] = set()
+    for host in range(self.process_count):
+      if host == self.process_index:
+        continue
+      payload = peers.get(host)
+      # A peer that never beat ages from our start (startup grace).
+      age = now - (payload['time'] if payload else self._started_at)
+      ages[host] = age
+      if payload is not None and payload.get('done'):
+        # The peer finished its run and said goodbye: a growing age is
+        # not death, and declaring it dead would needlessly kill THIS
+        # still-training host.
+        self._hb_scope.gauge(f'host{host}/age_sec').set(age)
+        continue
+      self._hb_scope.gauge(f'host{host}/age_sec').set(age)
+      if payload is not None:
+        self._hb_scope.gauge(f'host{host}/step').set(payload.get('step', 0))
+      if age > self._dead_after:
+        if host not in self.dead_hosts:
+          newly_dead.add(host)
+        self.dead_hosts.add(host)
+      elif age > self._straggler_after:
+        if host not in self._flagged_stragglers:
+          self._flagged_stragglers.add(host)
+          self._m_stragglers.inc()
+          logging.warning(
+              'Host %d is straggling: last heartbeat %.1fs ago (straggler '
+              'threshold %.1fs, declared dead at %.1fs).', host, age,
+              self._straggler_after, self._dead_after)
+      else:
+        self._flagged_stragglers.discard(host)
+    self._hb_scope.gauge('dead_hosts').set(len(self.dead_hosts))
+    if newly_dead:
+      self._handle_dead(newly_dead, ages)
+    return ages
+
+  def _handle_dead(self, newly_dead: Set[int], ages: Dict[int, float]) -> None:
+    detail = ', '.join(f'host {h} (last heartbeat {ages[h]:.1f}s ago)'
+                       for h in sorted(newly_dead))
+    message = (
+        f'LIVENESS: declaring {detail} DEAD after '
+        f'{self._dead_after:.0f}s without a heartbeat. This process '
+        f'(host {self.process_index}) would otherwise hang forever in the '
+        f'next collective or barrier; exiting with status '
+        f'{LIVENESS_EXIT_CODE} so the scheduler restarts the job from the '
+        f'last committed checkpoint.')
+    logging.critical(message)
+    if self._on_dead is not None:
+      self._on_dead(set(newly_dead))
+    if self._action == 'exit':
+      print(message, file=sys.stderr, flush=True)
+      # os._exit, not sys.exit: the main thread may be wedged inside a
+      # collective/barrier and would never process a normal exception.
+      os._exit(LIVENESS_EXIT_CODE)
+
+  # ----------------------------------------------------------- aggregation
+
+  def aggregate(self) -> Dict[str, Any]:
+    """Job-level merged metrics (this host's LIVE registry + peers'
+    heartbeat snapshots)."""
+    snaps: Dict[int, Dict[str, Any]] = {}
+    for host, payload in self.read_peers().items():
+      if host == self.process_index:
+        continue
+      metrics = payload.get('metrics')
+      if isinstance(metrics, dict):
+        snaps[host] = metrics
+    snaps[self.process_index] = metrics_lib.snapshot()
+    return aggregate_snapshots(snaps)
+
+  def aggregated_scalars(self) -> Dict[str, float]:
+    """Flat ``cluster/...`` scalars for the trainer's log-crossing merge:
+    summed counters plus per-host step/age gauges (full per-host gauge
+    labeling stays in ``/metricsz`` and the report, where cardinality is
+    free)."""
+    out: Dict[str, float] = {}
+    for name, value in self.aggregate().items():
+      if isinstance(value, int):
+        out[f'cluster/{name}'] = float(value)
+    for host, payload in sorted(self.read_peers().items()):
+      out[f'cluster/host{host}/step'] = float(payload.get('step', 0))
+      out[f'cluster/host{host}/heartbeat_age_sec'] = (
+          time.time() - float(payload.get('time', self._started_at)))
+    return out
+
+  def cluster_report(self) -> Dict[str, Any]:
+    """The ``/metricsz`` + end-of-run report section (report provider)."""
+    peers = self.read_peers()
+    now = time.time()
+    return {
+        'process_index': self.process_index,
+        'process_count': self.process_count,
+        'dead_hosts': sorted(self.dead_hosts),
+        'hosts': {
+            str(host): {
+                'step': payload.get('step'),
+                'pid': payload.get('pid'),
+                'heartbeat_age_sec': round(now - payload.get('time', now), 3),
+            } for host, payload in sorted(peers.items())
+        },
+        'merged_metrics': self.aggregate(),
+    }
+
+  # -------------------------------------------------------------- lifecycle
+
+  def start(self) -> 'HeartbeatService':
+    if self._thread is not None:
+      return self
+    self._started_at = time.time()
+    self._stop.clear()
+
+    def run():
+      while not self._stop.is_set():
+        try:
+          self.beat()
+          self.check_peers()
+        except Exception:  # pylint: disable=broad-except
+          logging.exception('Heartbeat pass failed (non-fatal).')
+        self._stop.wait(self._interval)
+
+    self._thread = threading.Thread(target=run, daemon=True,
+                                    name='t2r-heartbeat')
+    self._thread.start()
+    if self.process_index == 0:
+      metrics_lib.register_report_provider('cluster', self.cluster_report)
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=5.0)
+      self._thread = None
+    # The 'cluster' report provider stays REGISTERED: the heartbeat
+    # files it reads persist, so the end-of-run report / a post-training
+    # /metricsz scrape still shows the whole job's merged view (a later
+    # service in the same process replaces the registration).
+    # Final beat says goodbye (done=True): post-mortem tooling sees the
+    # last completed step, and peers still training do not declare this
+    # orderly exit a death. Never raise during shutdown.
+    try:
+      self.beat(done=True)
+    except OSError:
+      pass
+
+  def __enter__(self) -> 'HeartbeatService':
+    return self.start()
+
+  def __exit__(self, *exc) -> None:
+    self.stop()

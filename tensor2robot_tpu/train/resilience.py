@@ -23,6 +23,14 @@ and resumable input streams are the recovery half):
   dispatch's flag after queueing the next), so policy enforcement adds
   no device sync to the pipeline — the lag is safe precisely because the
   update was already guarded on device.
+
+Everything here is SINGLE-process. In a multi-process job a signal
+lands on one worker first; ``train/distributed_resilience.py`` layers
+the cross-host half on top: ``CoordinatedShutdown`` propagates the flag
+so every host checkpoints the SAME step and exits
+``PREEMPTED_EXIT_CODE`` together, heartbeats declare dead hosts instead
+of hanging, and the checkpoint that gets forced goes through the atomic
+multi-host commit protocol in ``train/checkpoints.py``.
 """
 
 from __future__ import annotations

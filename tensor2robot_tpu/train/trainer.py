@@ -40,6 +40,7 @@ from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct, algebra
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
+from tensor2robot_tpu.train import distributed_resilience as dist_lib
 from tensor2robot_tpu.train import resilience
 from tensor2robot_tpu.train.train_state import (TrainState,
                                                 accumulate_grads, apply_ema,
@@ -217,6 +218,36 @@ class TrainerConfig:
   # env var also opts in); 0 = an ephemeral port (logged, and readable
   # from ``observability.metricsz.global_server().port``).
   metricsz_port: Optional[int] = None
+  # Distributed resilience (train/distributed_resilience.py), the
+  # multi-process extension of handle_preemption: coordinated preemption
+  # (any host's SIGTERM → ALL hosts checkpoint the same step and exit
+  # resumable together), the atomic multi-host checkpoint commit
+  # protocol, per-host heartbeats with a liveness monitor, and process-0
+  # metric aggregation. None = auto: on iff jax.process_count() > 1 and
+  # the jax.distributed coordination service is available; False forces
+  # it off (each process then behaves like PR 1's single-process layer —
+  # NOT safe on real pods).
+  distributed_coordination: Optional[bool] = None
+  # Heartbeat cadence and liveness thresholds (multi-process only). A
+  # host whose heartbeat is older than straggler_after is flagged; older
+  # than liveness_timeout is DEAD: with liveness_action='exit' the
+  # monitor logs a loud liveness error and exits
+  # distributed_resilience.LIVENESS_EXIT_CODE instead of letting the
+  # survivors hang forever in a collective/barrier ('flag' only records
+  # it — embedders that own their own death handling).
+  heartbeat_interval_secs: float = 5.0
+  heartbeat_straggler_secs: float = 15.0
+  liveness_timeout_secs: float = 60.0
+  liveness_action: str = 'exit'
+  # Validate a checkpoint's recorded topology (process count, mesh
+  # shape, microbatch config) against this run on restore; a mismatch is
+  # a loud TopologyMismatchError instead of silently misread state.
+  checkpoint_topology_check: bool = True
+
+  def resolved_distributed_coordination(self) -> bool:
+    if self.distributed_coordination is not None:
+      return self.distributed_coordination
+    return jax.process_count() > 1
 
   def resolved_auto_input_layouts(self) -> bool:
     if jax.process_count() > 1:
@@ -697,6 +728,18 @@ class Trainer:
     # Step the current dispatch started from; callbacks use crossed() so
     # their interval semantics survive steps_per_dispatch > 1.
     self._dispatch_start_step = 0
+    # Distributed control plane (multi-process runs only): coordinated
+    # preemption, the multi-host checkpoint commit protocol, heartbeats.
+    self._dist_ctx: Optional[dist_lib.DistributedContext] = None
+    if config.resolved_distributed_coordination():
+      self._dist_ctx = dist_lib.DistributedContext.create()
+    self._heartbeat: Optional[dist_lib.HeartbeatService] = None
+    topology = None
+    if config.checkpoint_topology_check:
+      topology = mesh_lib.describe_topology(
+          self._mesh,
+          grad_accum_microbatches=self._accum_m,
+          steps_per_dispatch=self._loop_k)
     self._manager: Optional[ckpt_lib.CheckpointManager] = None
     if config.model_dir:
       self._manager = ckpt_lib.CheckpointManager(
@@ -704,7 +747,9 @@ class Trainer:
           max_to_keep=config.max_checkpoints_to_keep,
           keep_period=config.keep_checkpoint_period,
           save_interval_steps=config.save_interval_steps,
-          async_save=config.async_checkpoints)
+          async_save=config.async_checkpoints,
+          topology=topology,
+          distributed=self._dist_ctx)
     # Opt-in live metrics endpoint (config port or T2R_METRICSZ_PORT
     # env); process-global and idempotent, so a second Trainer in the
     # same process reuses the running server.
@@ -747,6 +792,17 @@ class Trainer:
   def nonfinite_policy(self) -> Optional['resilience.NonFinitePolicy']:
     """Host-side non-finite accounting (None when the guard is off)."""
     return self._nonfinite_policy
+
+  @property
+  def distributed_context(self) -> Optional['dist_lib.DistributedContext']:
+    """The multi-process control plane (None in single-process runs)."""
+    return self._dist_ctx
+
+  @property
+  def is_primary_process(self) -> bool:
+    """Whether this process owns job-wide side effects (exports,
+    checkpoint payloads, aggregation). True in single-process runs."""
+    return self._dist_ctx is None or self._dist_ctx.is_primary
 
   def crossed(self, interval: int, step: int) -> bool:
     """Whether the dispatch that just reported ``step`` crossed a multiple
@@ -1139,12 +1195,45 @@ class Trainer:
     prev_out: Optional[MetricDict] = None
     shutdown = (self._shutdown if self._shutdown is not None
                 else resilience.active_shutdown())
+    # Multi-process control plane: coordinated preemption agreement and
+    # the per-host heartbeat/liveness monitor (model_dir is the shared
+    # medium — without one, liveness degrades to barrier timeouts only).
+    coordinated: Optional[dist_lib.CoordinatedShutdown] = None
+    if self._dist_ctx is not None:
+      coordinated = dist_lib.CoordinatedShutdown(self._dist_ctx, shutdown)
+      if config.model_dir:
+        self._heartbeat = dist_lib.HeartbeatService(
+            os.path.join(config.model_dir,
+                         dist_lib.HEARTBEAT_DIRNAME),
+            process_index=self._dist_ctx.process_index,
+            process_count=self._dist_ctx.process_count,
+            interval_secs=config.heartbeat_interval_secs,
+            straggler_after_secs=config.heartbeat_straggler_secs,
+            dead_after_secs=config.liveness_timeout_secs,
+            action=config.liveness_action)
+        self._heartbeat.set_step(step)
+        self._heartbeat.start()
+    # The step ALL processes agreed to stop at (or this process's own
+    # boundary for a single-process shutdown). The loop keeps training
+    # until it reaches it, so every host's forced checkpoint lands on
+    # one common step.
+    stop_step: Optional[int] = None
     try:
       while step < config.max_train_steps:
-        if shutdown is not None and shutdown.requested:
+        if stop_step is None:
+          if coordinated is not None:
+            # One boundary's coordination round: propagates any host's
+            # local SIGTERM to every process and agrees on the common
+            # stop step (max of all published boundaries).
+            stop_step = coordinated.poll(step)
+          elif shutdown is not None and shutdown.requested:
+            stop_step = step
+        if stop_step is not None and step >= stop_step:
           # Preemption: the in-flight dispatch finished (we are at a
           # boundary); force a checkpoint + input-state save and exit
-          # with the distinct resumable status.
+          # with the distinct resumable status. In a multi-process run
+          # every host takes this branch at the SAME step and the save
+          # below runs the atomic commit protocol.
           logging.warning(
               'Graceful shutdown requested; checkpointing step %d and '
               'raising PreemptedError (resumable).', self.step)
@@ -1185,6 +1274,10 @@ class Trainer:
             examples=int(np.prod(batch_leaves[0].shape[:2]))
             if self._loop_k > 1 and batch_leaves
             else (batch_leaves[0].shape[0] if batch_leaves else 0))
+        if self._heartbeat is not None:
+          # Liveness payload: peers (and post-mortem tooling) see the
+          # last COMPLETED dispatch boundary, not a wall-clock guess.
+          self._heartbeat.set_step(step)
         if self._nonfinite_policy is not None:
           prev, pending_nonfinite = pending_nonfinite, (
               scalars.get('nonfinite_count'), step)
@@ -1206,6 +1299,13 @@ class Trainer:
           scalars.update(memory_lib.memory_scalars())
           scalars.update(
               _resilience_scalars(resilience_snap, self._nonfinite_policy))
+          if (self._heartbeat is not None and self._dist_ctx is not None
+              and self._dist_ctx.is_primary):
+            # Whole-job view (PR-2 follow-up): process 0 merges every
+            # host's registry snapshot riding the heartbeats — counters
+            # summed, per-host step/age gauges — into the same scalars
+            # dict TensorBoard already publishes.
+            scalars.update(self._heartbeat.aggregated_scalars())
         for cb in self._callbacks:
           cb.after_step(self, step, scalars)
         if (self._manager is not None and
@@ -1221,10 +1321,21 @@ class Trainer:
     finally:
       if prefetcher is not None:
         prefetcher.close()
+      if self._heartbeat is not None:
+        self._heartbeat.stop()
+        self._heartbeat = None
     if (self._nonfinite_policy is not None and
         pending_nonfinite is not None and pending_nonfinite[0] is not None):
       # Flush the final dispatch's flag before declaring success.
       self._nonfinite_policy.observe(*pending_nonfinite)
+    if coordinated is not None and stop_step is None:
+      # A peer may have proposed a stop while this host was finishing its
+      # last dispatch: join the (bounded) negotiation so the peer is not
+      # stranded at the barrier. Any agreed target includes this host's
+      # completed boundary in its max, so completion proceeds normally —
+      # and the final save's commit barriers align across hosts because
+      # every host saves the same final step.
+      coordinated.poll(step)
     self.save_checkpoint(force=True)
     if self._manager is not None:
       self._manager.wait_until_finished()

@@ -17,6 +17,19 @@ paths end-to-end on the CPU backend instead of trusting unit mocks:
 * :func:`truncate_checkpoint` / :func:`vanish_checkpoint` — simulate a
   write cut off mid-flight / a GC'd or lost checkpoint step.
 
+Multi-process injectors (the ``tests/test_distributed_resilience.py``
+drills over the real 2-process ``jax.distributed`` harness):
+
+* :class:`KillSelfCallback` — hard-kills THIS process mid-run (SIGKILL:
+  no graceful path, no flushes), modelling a host that dies — the
+  survivors must declare it dead instead of hanging.
+* :class:`DelayDispatchCallback` — stalls one host's dispatch boundary,
+  modelling a straggler for the heartbeat monitor to flag.
+* :func:`remove_commit_marker` / :func:`corrupt_checkpoint_host_ack` —
+  tear a checkpoint the way a mid-commit death does: the step's payload
+  looks complete but the commit protocol never finished, so restore
+  must skip it.
+
 All schedules are explicit step/index sets or seeded draws — a failing
 test replays bit-identically.
 """
@@ -122,6 +135,47 @@ class PreemptionCallback(TrainerCallback):
       self._shutdown.request()
 
 
+class KillSelfCallback(TrainerCallback):
+  """Hard-kills this process at/after ``at_step`` (host-death drill).
+
+  SIGKILL by default: no Python teardown, no heartbeat stop, no commit
+  barrier release — exactly what a crashed/preempted-without-grace host
+  looks like to its peers. Survivors must take the liveness path
+  (heartbeat timeout → ``LIVENESS_EXIT_CODE`` or a bounded
+  ``DeadHostError``), never a hang.
+  """
+
+  def __init__(self, at_step: int, signum: int = 9):
+    self._at_step = int(at_step)
+    self._signum = int(signum)
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if step >= self._at_step:
+      os.kill(os.getpid(), self._signum)
+
+
+class DelayDispatchCallback(TrainerCallback):
+  """Stalls this host's dispatch boundaries (straggler injection).
+
+  Sleeps ``delay_secs`` at every boundary in ``[at_step, until_step)``;
+  with per-host application (gate on ``jax.process_index()`` in the
+  caller), one slow host lags the job so the heartbeat monitor's
+  straggler detection has something real to flag.
+  """
+
+  def __init__(self, at_step: int, delay_secs: float,
+               until_step: Optional[int] = None):
+    self._at_step = int(at_step)
+    self._until = until_step
+    self._delay = float(delay_secs)
+
+  def after_step(self, trainer, step: int, scalars) -> None:
+    if step >= self._at_step and (self._until is None or step < self._until):
+      import time
+
+      time.sleep(self._delay)
+
+
 # ------------------------------------------------------- on-disk faults
 
 
@@ -184,3 +238,36 @@ def vanish_checkpoint(ckpt_dir: str, step: int) -> None:
   """Deletes checkpoint ``step`` outright (lost dir / GC race)."""
   shutil.rmtree(os.path.join(ckpt_dir, f'ckpt_{int(step)}'),
                 ignore_errors=True)
+
+
+def remove_commit_marker(ckpt_dir: str, step: int) -> None:
+  """Un-commits checkpoint ``step``: the payload stays, the marker goes.
+
+  The exact on-disk signature of a job that died between finishing the
+  payload write and publishing the commit — restore must treat the step
+  as torn (``checkpoint/torn_skipped``) and fall back.
+  """
+  from tensor2robot_tpu.train import checkpoints as ckpt_lib
+
+  path = ckpt_lib.commit_marker_path(ckpt_dir, step)
+  if not os.path.exists(path):
+    raise FileNotFoundError(path)
+  os.remove(path)
+
+
+def corrupt_checkpoint_host_ack(ckpt_dir: str, step: int, host: int) -> None:
+  """Corrupts one host's ack "shard" of a multi-host checkpoint.
+
+  Overwrites ``host_ack_<host>.json`` with garbage bytes — the
+  mid-commit signature of that host's write being torn. A commit
+  attempted over it must refuse; an already-committed step keeps its
+  marker (the commit already proved the ack existed intact).
+  """
+  from tensor2robot_tpu.train import checkpoints as ckpt_lib
+
+  path = os.path.join(ckpt_dir, f'ckpt_{int(step)}',
+                      f'{ckpt_lib.HOST_ACK_PREFIX}{int(host)}.json')
+  if not os.path.exists(path):
+    raise FileNotFoundError(path)
+  with open(path, 'wb') as f:
+    f.write(b'\xde\xad\xbe\xef torn')
