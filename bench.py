@@ -446,6 +446,108 @@ def bench_device_cem(n_actions: int = 6):
   }))
 
 
+def bench_serving_plane(clients_sweep=(1, 8, 16, 32), headline_clients=32,
+                        duration_secs=2.0):
+  """Cross-client batched serving vs the serial per-robot predictor.
+
+  The serving acceptance drill (ISSUE 6): N closed-loop synthetic
+  clients (one action request each, the robot control-loop pattern)
+  against the in-process batching plane, vs ONE client calling the same
+  predictor serially — today's one-predictor-per-robot operating point.
+  The mock is the 2048-wide MLP (utils/mocks.py): a batch-1 predict on
+  it is weight-streaming/dispatch-bound, so a batch-64 dispatch costs
+  about what batch-1 does — the same per-chip economics as the
+  tunnel-attached critic, which is where cross-client batching pays.
+  Acceptance: headline actions/s >= 4x serial at >= 8 clients, p50/p99
+  in the same line. An HTTP line measures the stdlib JSON/TCP edge on
+  top (transport, not the batching plane).
+  """
+  import numpy as np
+
+  from tensor2robot_tpu.predictors import CheckpointPredictor
+  from tensor2robot_tpu.serving import DynamicBatcher, ServingServer
+  from tensor2robot_tpu.serving import loadgen
+  from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+  model = MockT2RModel(device_type='tpu', hidden_size=2048)
+  predictor = CheckpointPredictor(model, model_dir='/nonexistent')
+  predictor.init_randomly()
+
+  def features_fn(i):
+    return {'measured_position':
+            np.full((1, 2), 0.01 * (i + 1), np.float32)}
+
+  serial_aps = loadgen.serial_baseline(
+      predictor, features_fn(0), duration_secs=duration_secs)
+  print(json.dumps({
+      'metric': 'serving_single_client_serial_actions_per_sec',
+      'value': round(serial_aps, 1),
+      'unit': 'actions/sec',
+      'note': 'one client, predict() back-to-back, 1 example each — the '
+              'per-robot baseline the serving plane is measured against',
+  }))
+
+  from tensor2robot_tpu.observability import metrics as metrics_lib
+
+  reports = {}
+  with DynamicBatcher(predictor, max_batch=64,
+                      batch_deadline_ms=0.2) as batcher:
+    submit = loadgen.inproc_submit_fn(batcher)
+    compiles_after_warm = metrics_lib.counter(
+        'serving/bucket_compiles').value
+    for clients in clients_sweep:
+      reports[clients] = report = loadgen.run_load(
+          submit, features_fn, num_clients=clients,
+          duration_secs=duration_secs)
+      print(json.dumps({
+          'metric': 'serving_client_sweep',
+          **report.as_dict(),
+          'speedup_vs_serial': round(report.actions_per_sec / serial_aps, 2)
+          if serial_aps else None,
+      }))
+    recompiles = (metrics_lib.counter('serving/bucket_compiles').value -
+                  compiles_after_warm)
+
+  head = reports[headline_clients]
+  print(json.dumps({
+      'metric': 'serving_actions_per_sec',
+      'value': round(head.actions_per_sec, 1),
+      'unit': 'actions/sec',
+      'clients': head.clients,
+      'latency_ms_p50': round(head.latency_ms_p50, 2),
+      'latency_ms_p99': round(head.latency_ms_p99, 2),
+      'errors': head.errors,
+      'serial_actions_per_sec': round(serial_aps, 1),
+      'speedup_vs_serial': round(head.actions_per_sec / serial_aps, 2)
+      if serial_aps else None,
+      'recompiles_after_warmup': recompiles,
+      'note': 'acceptance: >= 4x serial at >= 8 clients, '
+              '0 recompiles after warmup',
+  }))
+  print(json.dumps({'metric': 'serving_latency_ms_p50',
+                    'value': round(head.latency_ms_p50, 2), 'unit': 'ms',
+                    'clients': head.clients}))
+  print(json.dumps({'metric': 'serving_latency_ms_p99',
+                    'value': round(head.latency_ms_p99, 2), 'unit': 'ms',
+                    'clients': head.clients}))
+
+  # The HTTP front door (stdlib ThreadingHTTPServer + JSON): transport
+  # overhead rides on top of the batching plane, so this line is about
+  # the edge, not the dispatch economics.
+  with ServingServer(predictor, max_batch=64,
+                     batch_deadline_ms=0.2) as server:
+    http_report = loadgen.run_load(
+        loadgen.http_submit_fn('127.0.0.1', server.port),
+        features_fn, num_clients=8, duration_secs=duration_secs)
+  print(json.dumps({
+      'metric': 'serving_http_actions_per_sec',
+      'value': round(http_report.actions_per_sec, 1),
+      'unit': 'actions/sec',
+      **{k: v for k, v in http_report.as_dict().items()
+         if k not in ('actions_per_sec',)},
+  }))
+
+
 def bench_native_reader():
   """Native interleave-reader throughput on generated shards — JSON line."""
   import os
@@ -532,6 +634,25 @@ def main():
       i += 1
 
   trainer.train(batch_iter(), None)  # 1 step: init + compile
+
+  # Restart-goodput slice (ROADMAP direction 5): process start → first
+  # completed train step, as recorded by the trainer's gauge. With
+  # T2R_COMPILATION_CACHE_DIR set, the second bench round measures the
+  # cache-hit restart.
+  try:
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+    from tensor2robot_tpu.utils import compilation_cache as cache_lib
+
+    print(json.dumps({
+        'metric': 'restart_to_first_step_seconds',
+        'value': round(metrics_lib.gauge(
+            'trainer/restart_to_first_step_seconds').value, 3),
+        'unit': 's',
+        'compilation_cache_dir': cache_lib.enabled_dir(),
+    }))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'restart_to_first_step_seconds',
+                      'error': repr(e)[:200]}))
 
   state = trainer.state
   step_fn = trainer._train_step_fn  # pylint: disable=protected-access
@@ -690,6 +811,32 @@ def main():
     except Exception as e:
       print(json.dumps({'metric': 'grasp2vec_record_train_steps_per_sec',
                         'error': repr(e)[:200]}))
+  # Serving plane: ALWAYS measured on the CPU mock (the acceptance
+  # criterion's operating point; the TPU path's gain is gated on a real
+  # chip where the CEM dispatch dominates). On a TPU run the suite goes
+  # to a JAX_PLATFORMS=cpu subprocess so a second set of executables
+  # never coexists with the bench trainer's on the tunneled backend.
+  try:
+    if on_tpu:
+      import os as os_lib
+      import subprocess
+      import sys as sys_lib
+
+      env = dict(os_lib.environ, JAX_PLATFORMS='cpu')
+      proc = subprocess.run(
+          [sys_lib.executable, os_lib.path.abspath(__file__), '--serving'],
+          capture_output=True, text=True, timeout=1800, env=env)
+      for out_line in proc.stdout.splitlines():
+        if out_line.startswith('{'):
+          print(out_line)
+      if proc.returncode != 0:
+        raise RuntimeError(f'serving subprocess rc={proc.returncode}; '
+                           f'stderr: {proc.stderr[-300:]}')
+    else:
+      bench_serving_plane()
+  except Exception as e:
+    print(json.dumps({'metric': 'serving_actions_per_sec',
+                      'error': repr(e)[:200]}))
   try:
     bench_native_reader()
   except Exception as e:
@@ -764,4 +911,9 @@ def main():
 
 
 if __name__ == '__main__':
-  main()
+  import sys
+
+  if '--serving' in sys.argv[1:]:
+    bench_serving_plane()  # CPU-pinned subprocess entry (see main)
+  else:
+    main()

@@ -1,0 +1,115 @@
+"""Serving binary: batched multi-client action serving from an export root.
+
+Loads the newest committed export version (waiting for the trainer's
+first export when ``--restore-timeout-secs`` is set), warms every batch
+bucket, and serves ``POST /v1/predict`` with dynamic cross-client
+batching. Hot model swap is on by default: the reload poller follows the
+export root's commit markers and swaps new versions in between dispatches
+with zero dropped requests (a torn or broken export leaves the last-good
+model serving).
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_serving \
+      --export_dir /models/m/export/latest_exporter_numpy \
+      --port 8000 --max-batch 64 --batch-deadline-ms 5 \
+      --metricsz-port 8001 --compilation-cache-dir /var/cache/t2r-xla
+
+SIGTERM/SIGINT drain: the HTTP listener stops, queued requests complete,
+then the process exits 0 — a fleet scheduler can roll the serving tier
+without failing client requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--export_dir', required=True,
+                      help='Versioned export root (the trainer exporter '
+                           'output, e.g. .../export/latest_exporter_numpy).')
+  parser.add_argument('--port', type=int, default=8000)
+  parser.add_argument('--host', default='127.0.0.1',
+                      help='Bind address; loopback by default — serving '
+                           'beyond the host is an operator decision.')
+  parser.add_argument('--max-batch', type=int, default=64,
+                      help='Largest single device dispatch (the batch-64 '
+                           'CEM optimum from BENCH_r05).')
+  parser.add_argument('--batch-deadline-ms', type=float, default=5.0,
+                      help='Max assembly wait: a batch dispatches at '
+                           'max-batch examples or this deadline, '
+                           'whichever first.')
+  parser.add_argument('--max-queue', type=int, default=1024,
+                      help='Queued-request bound; beyond it clients get '
+                           '503 (backpressure, not unbounded latency).')
+  parser.add_argument('--request-timeout-secs', type=float, default=30.0)
+  parser.add_argument('--reload-interval-secs', type=float, default=10.0,
+                      help='Export-root poll cadence for hot swap; '
+                           '<= 0 disables reloading.')
+  parser.add_argument('--restore-timeout-secs', type=float, default=0.0,
+                      help='How long to wait for the FIRST export to '
+                           'appear before giving up.')
+  parser.add_argument('--metricsz-port', type=int, default=None,
+                      help='Also serve the metrics registry (incl. the '
+                           'serving report section) at /metricsz.')
+  parser.add_argument('--compilation-cache-dir', default=None,
+                      help='Persistent XLA cache: restarted servers '
+                           'deserialize bucket executables instead of '
+                           'recompiling (T2R_COMPILATION_CACHE_DIR).')
+  args = parser.parse_args(argv)
+  logging.basicConfig(
+      level=logging.INFO,
+      format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+
+  from tensor2robot_tpu.observability import metricsz
+  from tensor2robot_tpu.predictors import ExportedModelPredictor
+  from tensor2robot_tpu.serving import ServingServer
+
+  predictor = ExportedModelPredictor(
+      export_dir=args.export_dir, timeout=args.restore_timeout_secs)
+  if not predictor.restore():
+    logging.error('No committed export appeared under %r within %.1fs.',
+                  args.export_dir, args.restore_timeout_secs)
+    return 1
+
+  reload_interval = (args.reload_interval_secs
+                     if args.reload_interval_secs > 0 else None)
+  server = ServingServer(
+      predictor,
+      port=args.port,
+      host=args.host,
+      request_timeout_secs=args.request_timeout_secs,
+      compilation_cache_dir=args.compilation_cache_dir,
+      max_batch=args.max_batch,
+      batch_deadline_ms=args.batch_deadline_ms,
+      max_queue=args.max_queue,
+      reload_interval_secs=reload_interval)
+
+  stop = threading.Event()
+
+  def handle_signal(signum, frame):
+    del frame
+    logging.info('Received signal %d; draining and shutting down.', signum)
+    stop.set()
+
+  previous = {sig: signal.signal(sig, handle_signal)
+              for sig in (signal.SIGTERM, signal.SIGINT)}
+  try:
+    with server:
+      metricsz.maybe_start(args.metricsz_port)
+      logging.info('Serving model version %d at %s',
+                   server.batcher.model_version, server.url)
+      stop.wait()
+  finally:
+    for sig, handler in previous.items():
+      signal.signal(sig, handler)
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

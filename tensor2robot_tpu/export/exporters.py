@@ -217,6 +217,12 @@ def valid_export_dirs(export_root: str) -> List[str]:
   return valid
 
 
+# Torn export versions already counted/warned about, so a hot-reload
+# poller (the serving plane polls every reload interval) logs and counts
+# each torn dir ONCE instead of once per poll.
+_reported_torn_exports: set = set()
+
+
 def committed_export_dirs(export_root: str,
                           dirs: Optional[List[str]] = None) -> List[str]:
   """Filters export version dirs to COMMITTED ones (legacy-aware).
@@ -233,12 +239,15 @@ def committed_export_dirs(export_root: str,
             if os.path.exists(os.path.join(d, EXPORT_COMMIT_FILENAME))]
   if not marked:
     return dirs
-  skipped = len(dirs) - len(marked)
-  if skipped:
-    metrics_lib.counter('export/uncommitted_skipped').inc(skipped)
+  torn = [d for d in dirs if d not in marked
+          and d not in _reported_torn_exports]
+  if torn:
+    _reported_torn_exports.update(torn)
+    metrics_lib.counter('export/uncommitted_skipped').inc(len(torn))
     logging.warning(
         'Ignoring %d export version(s) under %r without a commit marker '
-        '(torn/partial export).', skipped, export_root)
+        '(torn/partial export): %s', len(torn), export_root,
+        [os.path.basename(d) for d in torn])
   return marked
 
 

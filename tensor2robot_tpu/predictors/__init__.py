@@ -4,6 +4,7 @@ from tensor2robot_tpu.predictors.predictors import (
     AbstractPredictor,
     CheckpointPredictor,
     ExportedModelPredictor,
+    StatelessServingFn,
 )
 
 

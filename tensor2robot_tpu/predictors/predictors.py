@@ -20,7 +20,7 @@ from __future__ import annotations
 import abc
 import os
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -31,6 +31,28 @@ from tensor2robot_tpu.specs import SpecStruct, algebra
 from tensor2robot_tpu.specs import numpy_gen
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
 from tensor2robot_tpu.train import train_state as ts_lib
+from tensor2robot_tpu.utils.concurrency import ReaderWriterLock
+
+
+class StatelessServingFn(NamedTuple):
+  """A predictor's compute core as a pure function over ``(params, batch)``.
+
+  This is the seam the batched serving plane (``serving/``) builds on:
+  ``fn`` is jax-traceable and closes over NO weights — all state rides in
+  ``params`` — so one program serves any client count (bucketed batch
+  shapes compile once per bucket) and hot model swap is a params pointer
+  swap. ``AbstractPredictor.predict()`` is the single-client wrapper
+  around exactly this function.
+  """
+
+  # fn(params, features) -> outputs; jax-traceable, batch-polymorphic.
+  fn: Callable
+  params: Any
+  feature_spec: SpecStruct
+  version: int  # the model version served (global step)
+  # Equal keys <=> same compute PROGRAM (only weights differ), so a
+  # consumer's compiled-executable cache survives a hot swap.
+  program_key: Any
 
 
 class AbstractPredictor(abc.ABC):
@@ -73,6 +95,20 @@ class AbstractPredictor(abc.ABC):
     raise NotImplementedError(
         f'{type(self).__name__} does not expose a traceable serving fn.')
 
+  def stateless_serving_fn(self) -> StatelessServingFn:
+    """The loaded model as a :class:`StatelessServingFn` snapshot.
+
+    The serving plane's contract: the returned tuple is immutable — a
+    later ``restore()`` produces a NEW snapshot rather than mutating
+    this one — so a consumer can keep dispatching against it while a
+    reload happens concurrently. Raises for predictor flavors whose
+    compute path is not a jax function (e.g. the TF SavedModel
+    signature); the serving plane then degrades to batched
+    ``predict()`` calls.
+    """
+    raise NotImplementedError(
+        f'{type(self).__name__} does not expose a stateless serving fn.')
+
   @property
   @abc.abstractmethod
   def is_loaded(self) -> bool:
@@ -89,7 +125,13 @@ class AbstractPredictor(abc.ABC):
 
 
 class _JitForward:
-  """Shared jitted PREDICT chain: preprocess → network → export outputs."""
+  """Shared jitted PREDICT chain: preprocess → network → export outputs.
+
+  The chain is STATELESS — ``traceable(variables, features)`` closes over
+  only the model's code, never its weights — so it doubles as the
+  ``StatelessServingFn.fn`` the batched serving plane compiles per batch
+  bucket; ``__call__`` is the single-client numpy wrapper around it.
+  """
 
   def __init__(self, model):
     self._model = model
@@ -150,6 +192,10 @@ class CheckpointPredictor(AbstractPredictor):
     self._variables = None
     self._global_step = -1
     self._restored_step: Optional[int] = None
+    # Reload vs in-flight predict exclusion: restore() swaps several
+    # fields; without the lock a concurrent predict can read a torn
+    # (new-step, old-params) combination (utils/concurrency.py).
+    self._reload_lock = ReaderWriterLock()
     self._feature_spec = algebra.filter_required_flat_tensor_spec(
         t2r_model.preprocessor.get_in_feature_specification(ModeKeys.PREDICT))
 
@@ -167,8 +213,10 @@ class CheckpointPredictor(AbstractPredictor):
 
   def init_randomly(self) -> None:
     state = self._init_state()
-    self._variables = jax.device_get(dict(state.eval_variables))
-    self._global_step = 0
+    variables = jax.device_get(dict(state.eval_variables))
+    with self._reload_lock.write_locked():
+      self._variables = variables
+      self._global_step = 0
 
   def restore(self) -> bool:
     ckpt_dir = f'{self._model_dir}/checkpoints'
@@ -187,19 +235,33 @@ class CheckpointPredictor(AbstractPredictor):
       restored = manager.restore(state, step=step)
     if restored is None:
       return False
-    self._variables = jax.device_get(dict(restored.eval_variables))
-    self._global_step = int(restored.step)
-    self._restored_step = step
+    variables = jax.device_get(dict(restored.eval_variables))
+    # Only the publication is exclusive: checkpoint IO and D2H above ran
+    # without blocking in-flight predicts.
+    with self._reload_lock.write_locked():
+      self._variables = variables
+      self._global_step = int(restored.step)
+      self._restored_step = step
     return True
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
     self.assert_is_loaded()
-    features = _expand_to_spec_rank(features, self._feature_spec)
-    return self._forward(self._variables, features)
+    with self._reload_lock.read_locked():
+      features = _expand_to_spec_rank(features, self._feature_spec)
+      return self._forward(self._variables, features)
 
   def device_serving_fn(self):
     self.assert_is_loaded()
-    return self._forward.traceable, self._variables
+    with self._reload_lock.read_locked():
+      return self._forward.traceable, self._variables
+
+  def stateless_serving_fn(self) -> StatelessServingFn:
+    self.assert_is_loaded()
+    with self._reload_lock.read_locked():
+      return StatelessServingFn(
+          fn=self._forward.traceable, params=self._variables,
+          feature_spec=self._feature_spec, version=self._global_step,
+          program_key=('jit_forward', id(self._forward)))
 
   @property
   def is_loaded(self) -> bool:
@@ -261,6 +323,10 @@ class ExportedModelPredictor(AbstractPredictor):
     self._loaded_dir: Optional[str] = None
     self._parse_fn = None
     self._serving_digest: Optional[str] = None
+    # Hot reload swaps _forward/_traceable/_variables/_feature_spec as a
+    # group; the lock keeps an in-flight predict from mixing generations
+    # (new serving fn + old params = shape-mismatch crash).
+    self._reload_lock = ReaderWriterLock()
 
   def get_feature_specification(self) -> SpecStruct:
     if self._feature_spec is None:
@@ -304,6 +370,9 @@ class ExportedModelPredictor(AbstractPredictor):
     if os.path.exists(serving_path):
       with open(serving_path, 'rb') as f:
         serving_bytes = f.read()
+    forward = self._forward
+    traceable = self._traceable
+    digest = None
     if serving_bytes is not None:
       # Self-contained path: the serialized StableHLO fn already includes
       # preprocessing; no model object is ever constructed. Successive
@@ -311,46 +380,66 @@ class ExportedModelPredictor(AbstractPredictor):
       # change), so reuse the deserialized fn — and its compile cache —
       # unless the program bytes actually differ.
       digest = hashlib.sha256(serving_bytes).hexdigest()
-      if self._forward is None or digest != self._serving_digest:
+      if forward is None or digest != self._serving_digest:
         from jax import export as jax_export
 
         serving_call = jax_export.deserialize(serving_bytes).call
 
-        def traceable(variables, features):
+        def stablehlo_traceable(variables, features):
           return dict(serving_call(
               exporters_lib.to_plain_tree(variables), dict(features)))
 
-        def forward(variables, features):
-          outputs = traceable(variables, features)
+        def stablehlo_forward(variables, features):
+          outputs = stablehlo_traceable(variables, features)
           return {k: np.asarray(v) for k, v in outputs.items()}
 
-        self._forward = forward
-        self._traceable = traceable
-        self._serving_digest = digest
+        forward, traceable = stablehlo_forward, stablehlo_traceable
     else:
       # Model-class fallback: the jitted forward only depends on the model
       # object — build it once and reuse its compile cache across versions.
       if self._model is None:
         self._model = exporters_lib.load_model_from_export_dir(
             export_dir, self._model_kwargs)
-      if not isinstance(self._forward, _JitForward):
-        self._forward = _JitForward(self._model)
-      self._traceable = self._forward.traceable
-    self._variables = exporters_lib.load_state_from_export_dir(export_dir)
-    self._feature_spec = algebra.filter_required_flat_tensor_spec(feature_spec)
-    self._global_step = global_step
-    self._loaded_dir = export_dir
-    self._parse_fn = None
+      if not isinstance(forward, _JitForward):
+        forward = _JitForward(self._model)
+      traceable = forward.traceable
+    variables = exporters_lib.load_state_from_export_dir(export_dir)
+    feature_spec = algebra.filter_required_flat_tensor_spec(feature_spec)
+    # Publication only — the IO, StableHLO deserialization and orbax
+    # restore above all ran without blocking in-flight predicts; the
+    # whole generation (fn + params + spec + step) swaps as one unit.
+    with self._reload_lock.write_locked():
+      self._forward = forward
+      self._traceable = traceable
+      self._serving_digest = digest
+      self._variables = variables
+      self._feature_spec = feature_spec
+      self._global_step = global_step
+      self._loaded_dir = export_dir
+      self._parse_fn = None
     return True
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
     self.assert_is_loaded()
-    features = _expand_to_spec_rank(features, self._feature_spec)
-    return self._forward(self._variables, features)
+    with self._reload_lock.read_locked():
+      features = _expand_to_spec_rank(features, self._feature_spec)
+      return self._forward(self._variables, features)
 
   def device_serving_fn(self):
     self.assert_is_loaded()
-    return self._traceable, self._variables
+    with self._reload_lock.read_locked():
+      return self._traceable, self._variables
+
+  def stateless_serving_fn(self) -> StatelessServingFn:
+    self.assert_is_loaded()
+    with self._reload_lock.read_locked():
+      program_key = (('stablehlo', self._serving_digest)
+                     if self._serving_digest is not None
+                     else ('jit_forward', id(self._forward)))
+      return StatelessServingFn(
+          fn=self._traceable, params=self._variables,
+          feature_spec=self._feature_spec, version=self._global_step,
+          program_key=program_key)
 
   def predict_example_bytes(self, serialized_examples) -> Dict[str, Any]:
     """Serialized tf.Example bytes → actions (the tf_example receiver).
@@ -360,25 +449,31 @@ class ExportedModelPredictor(AbstractPredictor):
     (``default_export_generator.py:89-138``).
     """
     self.assert_is_loaded()
-    if self._parse_fn is None:
-      # Prefer the TF-free native parser (C++ wire decode + PIL images)
-      # so robot hosts don't need a TF wheel; the TF codec remains the
-      # fallback for sequence/multi-dataset specs.
-      from tensor2robot_tpu.data import native_io
+    # One flat read-lock scope covering parse + predict (the lock is not
+    # reentrant — see utils/concurrency.py — so this does NOT route
+    # through self.predict): the parser is generated from the loaded
+    # generation's spec and must run against that generation's fn/params.
+    with self._reload_lock.read_locked():
+      if self._parse_fn is None:
+        # Prefer the TF-free native parser (C++ wire decode + PIL images)
+        # so robot hosts don't need a TF wheel; the TF codec remains the
+        # fallback for sequence/multi-dataset specs.
+        from tensor2robot_tpu.data import native_io
 
-      native_fn = native_io.make_native_parse_fn(self._feature_spec)
-      if native_fn is not None:
-        self._parse_fn = lambda ex: native_fn(list(ex))[0]
-      else:
-        from tensor2robot_tpu.data import example_codec
+        native_fn = native_io.make_native_parse_fn(self._feature_spec)
+        if native_fn is not None:
+          self._parse_fn = lambda ex: native_fn(list(ex))[0]
+        else:
+          from tensor2robot_tpu.data import example_codec
 
-        tf_fn = example_codec.make_parse_fn(self._feature_spec)
-        self._parse_fn = lambda ex: tf_fn(np.asarray(ex, dtype=object))
-    parsed = self._parse_fn(serialized_examples)
-    if isinstance(parsed, tuple):
-      parsed = parsed[0]
-    features = {k: np.asarray(v) for k, v in parsed.items()}
-    return self.predict(features)
+          tf_fn = example_codec.make_parse_fn(self._feature_spec)
+          self._parse_fn = lambda ex: tf_fn(np.asarray(ex, dtype=object))
+      parsed = self._parse_fn(serialized_examples)
+      if isinstance(parsed, tuple):
+        parsed = parsed[0]
+      features = {k: np.asarray(v) for k, v in parsed.items()}
+      features = _expand_to_spec_rank(features, self._feature_spec)
+      return self._forward(self._variables, features)
 
   def warmup(self) -> int:
     """Replays the export's recorded warmup requests; returns the count.

@@ -29,6 +29,29 @@ from tensor2robot_tpu.predictors.predictors import (AbstractPredictor,
                                                     _expand_to_spec_rank,
                                                     poll_and_load_newest)
 from tensor2robot_tpu.specs import SpecStruct, algebra
+from tensor2robot_tpu.utils.concurrency import ReaderWriterLock
+
+
+def _run_signature(signature, feature_spec: SpecStruct,
+                   features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+  """The stateless-style compute core over a loaded SavedModel signature.
+
+  The TF twin of ``StatelessServingFn.fn``: all model state rides in the
+  ``signature`` handle (TF binds variables into it), so callers snapshot
+  ``(signature, feature_spec)`` once and a concurrent hot reload can
+  never mix generations mid-call.
+  """
+  import tensorflow as tf
+
+  features = _expand_to_spec_rank(features, feature_spec)
+  feeds = {}
+  for key, value in features.items():
+    dtype = None
+    if key in feature_spec:
+      dtype = tf.dtypes.as_dtype(feature_spec[key].dtype.name)
+    feeds[key] = tf.constant(np.asarray(value), dtype=dtype)
+  outputs = signature(**feeds)
+  return {k: np.asarray(v) for k, v in outputs.items()}
 
 
 def _saved_model_dirs(export_root: str):
@@ -69,6 +92,9 @@ class SavedModelPredictor(AbstractPredictor):
     self._feature_spec: Optional[SpecStruct] = None
     self._global_step = -1
     self._loaded_dir: Optional[str] = None
+    # Reload vs in-flight predict exclusion (utils/concurrency.py): the
+    # signature/spec/step group must swap atomically.
+    self._reload_lock = ReaderWriterLock()
 
   def get_feature_specification(self) -> SpecStruct:
     if self._feature_spec is None:
@@ -105,38 +131,33 @@ class SavedModelPredictor(AbstractPredictor):
           f'SavedModel at {export_dir!r} has no signature '
           f'{self._signature_name!r}; available: '
           f'{sorted(loaded.signatures.keys())}')
-    self._loaded_model = loaded
-    self._signature = loaded.signatures[self._signature_name]
-    self._feature_spec = algebra.filter_required_flat_tensor_spec(
-        feature_spec)
-    self._global_step = global_step
-    self._loaded_dir = export_dir
+    # Publication only: tf.saved_model.load ran without blocking predicts.
+    with self._reload_lock.write_locked():
+      self._loaded_model = loaded
+      self._signature = loaded.signatures[self._signature_name]
+      self._feature_spec = algebra.filter_required_flat_tensor_spec(
+          feature_spec)
+      self._global_step = global_step
+      self._loaded_dir = export_dir
     return True
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    import tensorflow as tf
-
     self.assert_is_loaded()
-    features = _expand_to_spec_rank(features, self._feature_spec)
-    feeds = {}
-    for key, value in features.items():
-      dtype = None
-      if key in self._feature_spec:
-        dtype = tf.dtypes.as_dtype(self._feature_spec[key].dtype.name)
-      feeds[key] = tf.constant(np.asarray(value), dtype=dtype)
-    outputs = self._signature(**feeds)
-    return {k: np.asarray(v) for k, v in outputs.items()}
+    with self._reload_lock.read_locked():
+      return _run_signature(self._signature, self._feature_spec, features)
 
   def predict_example_bytes(self, serialized_examples) -> Dict[str, Any]:
     """Serialized tf.Example bytes → outputs via the ``tf_example`` sig."""
     import tensorflow as tf
 
     self.assert_is_loaded()
-    examples_sig = self._loaded_model.signatures.get(
+    with self._reload_lock.read_locked():
+      loaded_model, loaded_dir = self._loaded_model, self._loaded_dir
+    examples_sig = loaded_model.signatures.get(
         savedmodel_lib.TF_EXAMPLE_SIGNATURE)
     if examples_sig is None:
       raise ValueError(
-          f'SavedModel at {self._loaded_dir!r} was exported without the '
+          f'SavedModel at {loaded_dir!r} was exported without the '
           f'{savedmodel_lib.TF_EXAMPLE_SIGNATURE!r} signature.')
     arg_names = sorted(examples_sig.structured_input_signature[1])
     if len(arg_names) != 1:

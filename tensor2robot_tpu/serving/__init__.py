@@ -1,0 +1,20 @@
+"""Batched multi-client serving plane (ROADMAP direction 1).
+
+``batching`` — deadline-aware cross-client batch assembly, bucketed AOT
+dispatch over a stateless predictor core, hot model swap between
+dispatches. ``server`` — the stdlib-HTTP front door. ``loadgen`` — the
+synthetic-client load generator behind the serving bench lines.
+"""
+
+from tensor2robot_tpu.serving.batching import (
+    DynamicBatcher,
+    JitBucketExecutor,
+    OverloadedError,
+    RequestError,
+    ServingError,
+    ServingFuture,
+    bucket_for,
+    default_buckets,
+    pad_to_bucket,
+)
+from tensor2robot_tpu.serving.server import ServingServer

@@ -1,0 +1,184 @@
+"""Synthetic multi-client load generator for the serving plane.
+
+Drives N concurrent closed-loop clients (each waits for its response
+before sending the next request — the robot control-loop pattern) against
+either the in-process batcher (``inproc_submit_fn``: measures the
+batching plane itself) or the HTTP front door (``http_submit_fn``: adds
+the JSON/TCP edge). Latencies are recorded EXACTLY per request (the
+registry's power-of-two histogram is for live SLOs; a bench line wants
+true percentiles) and reduced to the report ``bench.py`` prints as
+``serving_actions_per_sec`` / ``serving_latency_ms_p50/p99``.
+
+Also provides the single-client serial baseline (``serial_baseline``):
+back-to-back ``predictor.predict()`` calls, one example each — the
+throughput a per-robot predictor achieves today, i.e. the denominator of
+the cross-client-batching speedup claim.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+
+class LoadReport(NamedTuple):
+  """One load run, reduced."""
+
+  clients: int
+  requests: int
+  errors: int
+  duration_s: float
+  actions_per_sec: float
+  latency_ms_p50: float
+  latency_ms_p99: float
+  latency_ms_mean: float
+
+  def as_dict(self) -> Dict[str, Any]:
+    return {
+        'clients': self.clients,
+        'requests': self.requests,
+        'errors': self.errors,
+        'duration_s': round(self.duration_s, 3),
+        'actions_per_sec': round(self.actions_per_sec, 2),
+        'latency_ms_p50': round(self.latency_ms_p50, 2),
+        'latency_ms_p99': round(self.latency_ms_p99, 2),
+        'latency_ms_mean': round(self.latency_ms_mean, 2),
+    }
+
+
+def _percentile(sorted_values: List[float], fraction: float) -> float:
+  if not sorted_values:
+    return 0.0
+  index = min(len(sorted_values) - 1,
+              max(0, int(round(fraction * (len(sorted_values) - 1)))))
+  return sorted_values[index]
+
+
+def inproc_submit_fn(batcher, timeout: float = 30.0) -> Callable:
+  """submit(features) -> outputs against the in-process batcher."""
+
+  def submit(features):
+    return batcher.submit(features).result(timeout=timeout)
+
+  return submit
+
+
+def http_submit_fn(host: str, port: int, timeout: float = 30.0) -> Callable:
+  """submit(features) -> outputs over HTTP (per-thread keep-alive conn)."""
+  import http.client
+  import json
+
+  local = threading.local()
+
+  def submit(features):
+    conn = getattr(local, 'conn', None)
+    if conn is None:
+      conn = http.client.HTTPConnection(host, port, timeout=timeout)
+      local.conn = conn
+    body = json.dumps({
+        'features': {k: np.asarray(v).tolist() for k, v in features.items()}
+    })
+    try:
+      conn.request('POST', '/v1/predict', body=body,
+                   headers={'Content-Type': 'application/json'})
+      response = conn.getresponse()
+      payload = json.loads(response.read())
+    except Exception:
+      local.conn = None  # drop the broken keep-alive connection
+      raise
+    if response.status != 200:
+      raise RuntimeError(
+          f'HTTP {response.status}: {payload.get("error", payload)}')
+    return payload['outputs']
+
+  return submit
+
+
+def run_load(submit: Callable,
+             features_fn: Callable[[int], Dict[str, np.ndarray]],
+             num_clients: int,
+             requests_per_client: Optional[int] = None,
+             duration_secs: Optional[float] = None,
+             examples_per_request: int = 1,
+             warmup_requests: int = 1) -> LoadReport:
+  """Runs N closed-loop clients; returns the reduced report.
+
+  ``features_fn(client_index)`` builds that client's request (so clients
+  can send distinct payloads — correctness checks ride the same run).
+  Bound the run with EITHER ``requests_per_client`` or ``duration_secs``.
+  """
+  if (requests_per_client is None) == (duration_secs is None):
+    raise ValueError(
+        'exactly one of requests_per_client / duration_secs required')
+  latencies: List[List[float]] = [[] for _ in range(num_clients)]
+  errors = [0] * num_clients
+  stop_at: Optional[float] = None
+  start_barrier = threading.Barrier(num_clients + 1)
+
+  def client(index: int) -> None:
+    features = features_fn(index)
+    for _ in range(warmup_requests):
+      try:
+        submit(features)
+      except Exception:  # pylint: disable=broad-except
+        pass
+    start_barrier.wait()
+    sent = 0
+    while True:
+      if requests_per_client is not None and sent >= requests_per_client:
+        return
+      if stop_at is not None and time.monotonic() >= stop_at:
+        return
+      t0 = time.monotonic()
+      try:
+        submit(features)
+        latencies[index].append(1e3 * (time.monotonic() - t0))
+      except Exception:  # pylint: disable=broad-except
+        errors[index] += 1
+      sent += 1
+
+  threads = [threading.Thread(target=client, args=(i,), daemon=True)
+             for i in range(num_clients)]
+  for thread in threads:
+    thread.start()
+  start_barrier.wait()  # all clients warmed: the timed window is steady
+  t_start = time.monotonic()
+  if duration_secs is not None:
+    stop_at = t_start + duration_secs
+  for thread in threads:
+    thread.join()
+  duration = max(time.monotonic() - t_start, 1e-9)
+
+  flat = sorted(x for per_client in latencies for x in per_client)
+  total_requests = len(flat)
+  total_errors = sum(errors)
+  return LoadReport(
+      clients=num_clients,
+      requests=total_requests,
+      errors=total_errors,
+      duration_s=duration,
+      actions_per_sec=total_requests * examples_per_request / duration,
+      latency_ms_p50=_percentile(flat, 0.50),
+      latency_ms_p99=_percentile(flat, 0.99),
+      latency_ms_mean=(sum(flat) / total_requests) if total_requests else 0.0,
+  )
+
+
+def serial_baseline(predictor,
+                    features: Dict[str, np.ndarray],
+                    duration_secs: float = 2.0,
+                    warmup_requests: int = 3) -> float:
+  """Single-client serial ``predict()`` throughput (actions/sec): the
+  one-predictor-per-robot operating point cross-client batching is
+  measured against."""
+  for _ in range(warmup_requests):
+    predictor.predict(features)
+  count = 0
+  t0 = time.monotonic()
+  while time.monotonic() - t0 < duration_secs:
+    predictor.predict(features)
+    count += 1
+  return count / max(time.monotonic() - t0, 1e-9)

@@ -1,0 +1,191 @@
+"""HTTP front door for the batched serving plane.
+
+Same dependency discipline as ``observability/metricsz.py``: pure stdlib
+``http.server.ThreadingHTTPServer`` on daemon threads — no web framework,
+no RPC stack. Each connection thread only parses JSON and blocks on a
+:class:`~tensor2robot_tpu.serving.batching.ServingFuture`; ALL device
+work stays on the batcher's single dispatcher thread, so N concurrent
+clients become one padded device dispatch per assembly window.
+
+Endpoints:
+
+* ``POST /v1/predict`` — body ``{"features": {<name>: <nested lists>}}``
+  (a bare feature dict is also accepted). Each feature carries a leading
+  batch dim shared across features; a single example may omit it (the
+  predictor's dim-expansion contract). Reply: ``{"outputs": {...},
+  "model_version": N, "examples": n}``.
+* ``GET /healthz`` — liveness + loaded model version.
+* ``GET /statz`` — the batcher's ``serving`` report (same document the
+  registry's ``/metricsz`` embeds via ``register_report_provider``).
+
+Status codes: 400 malformed request, 404 unknown path, 503 queue full /
+shutting down (back off and retry), 504 request timed out in the plane,
+500 dispatch failure.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.serving import batching as batching_lib
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+  """Thin JSON adapter over the batcher; never touches the device."""
+
+  protocol_version = 'HTTP/1.1'  # keep-alive: clients reuse connections
+
+  def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+    del format, args  # a load test would spam one line per request
+
+  @property
+  def _batcher(self) -> batching_lib.DynamicBatcher:
+    return self.server.batcher  # type: ignore[attr-defined]
+
+  def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+    body = json.dumps(payload).encode()
+    self.send_response(code)
+    self.send_header('Content-Type', 'application/json')
+    self.send_header('Content-Length', str(len(body)))
+    self.end_headers()
+    try:
+      self.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+      pass  # client gave up; the batch result is already accounted
+
+  def do_GET(self):  # noqa: N802 - stdlib naming
+    path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    if path == '/healthz':
+      self._reply(200, {'status': 'ok',
+                        'model_version': self._batcher.model_version})
+    elif path == '/statz':
+      self._reply(200, self._batcher.report())
+    else:
+      self._reply(404, {'error': f'unknown path {path!r}',
+                        'endpoints': ['/v1/predict', '/healthz', '/statz']})
+
+  def do_POST(self):  # noqa: N802 - stdlib naming
+    path = self.path.split('?', 1)[0].rstrip('/')
+    if path != '/v1/predict':
+      self._reply(404, {'error': f'unknown path {path!r}'})
+      return
+    try:
+      length = int(self.headers.get('Content-Length', 0))
+      payload = json.loads(self.rfile.read(length) or b'{}')
+      raw = payload.get('features', payload)
+      if not isinstance(raw, dict) or not raw:
+        raise ValueError('body must carry a non-empty feature dict')
+      features = {k: np.asarray(v) for k, v in raw.items()}
+    except (ValueError, TypeError) as e:
+      self._reply(400, {'error': f'malformed request: {e}'})
+      return
+    try:
+      future = self._batcher.submit(features)
+    except batching_lib.OverloadedError as e:
+      self._reply(503, {'error': str(e)})
+      return
+    except batching_lib.RequestError as e:
+      self._reply(400, {'error': str(e)})
+      return
+    timeout = self.server.request_timeout_secs  # type: ignore[attr-defined]
+    try:
+      outputs = future.result(timeout=timeout)
+    except TimeoutError as e:
+      self._reply(504, {'error': str(e)})
+      return
+    except batching_lib.ServingError as e:
+      self._reply(500, {'error': str(e)})
+      return
+    examples = next(iter(outputs.values())).shape[0] if outputs else 0
+    self._reply(200, {
+        'outputs': {k: np.asarray(v).tolist() for k, v in outputs.items()},
+        'model_version': future.model_version,
+        'examples': int(examples),
+    })
+
+
+class ServingServer:
+  """Batcher + HTTP server lifecycle as one unit.
+
+  ``port=0`` binds an ephemeral port (read ``.port``/``.url`` after
+  :meth:`start`); the bind is loopback by default — serving beyond the
+  host is an operator decision via ``host=``. ``close()`` is orderly:
+  the listener stops, queued requests drain, the last response leaves
+  before threads die.
+  """
+
+  def __init__(self,
+               predictor,
+               port: int = 0,
+               host: str = '127.0.0.1',
+               request_timeout_secs: float = 30.0,
+               compilation_cache_dir: Optional[str] = None,
+               **batcher_kwargs):
+    # Persistent compile cache first: bucket warmup is the serving
+    # plane's restart cost, and a cache hit turns each bucket compile
+    # into a deserialize (utils/compilation_cache.py).
+    from tensor2robot_tpu.utils.compilation_cache import (
+        maybe_enable_compilation_cache)
+
+    maybe_enable_compilation_cache(compilation_cache_dir)
+    self._batcher = batching_lib.DynamicBatcher(predictor, **batcher_kwargs)
+    self._requested = (host, int(port))
+    self._request_timeout_secs = request_timeout_secs
+    self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+    self._thread: Optional[threading.Thread] = None
+
+  @property
+  def batcher(self) -> batching_lib.DynamicBatcher:
+    return self._batcher
+
+  @property
+  def port(self) -> Optional[int]:
+    return None if self._httpd is None else self._httpd.server_address[1]
+
+  @property
+  def url(self) -> Optional[str]:
+    if self._httpd is None:
+      return None
+    host, port = self._httpd.server_address[:2]
+    return f'http://{host}:{port}'
+
+  def start(self) -> 'ServingServer':
+    if self._httpd is not None:
+      return self
+    self._batcher.start()
+    self._httpd = http.server.ThreadingHTTPServer(self._requested, _Handler)
+    self._httpd.daemon_threads = True
+    self._httpd.batcher = self._batcher  # type: ignore[attr-defined]
+    self._httpd.request_timeout_secs = (  # type: ignore[attr-defined]
+        self._request_timeout_secs)
+    self._thread = threading.Thread(
+        target=self._httpd.serve_forever, kwargs={'poll_interval': 0.2},
+        daemon=True, name='t2r-serving-http')
+    self._thread.start()
+    logging.info(
+        'Serving plane listening at %s (max_batch=%d, deadline=%.1fms, '
+        'buckets=%s)', self.url, self._batcher._max_batch,  # pylint: disable=protected-access
+        self._batcher._deadline_s * 1e3, list(self._batcher.buckets))  # pylint: disable=protected-access
+    return self
+
+  def close(self) -> None:
+    if self._httpd is not None:
+      self._httpd.shutdown()
+      self._httpd.server_close()
+      if self._thread is not None:
+        self._thread.join(timeout=10.0)
+      self._httpd = None
+      self._thread = None
+    self._batcher.close()
+
+  def __enter__(self) -> 'ServingServer':
+    return self.start()
+
+  def __exit__(self, *exc) -> None:
+    self.close()

@@ -1,0 +1,81 @@
+"""Persistent XLA compilation cache wiring (restart-goodput slice).
+
+Preemption resilience (PR 1/5) makes restarts *correct*; this makes them
+*cheap*: every restart of the trainer or the serving plane otherwise pays
+full XLA recompilation of the train program / all serving buckets before
+the first useful step. Pointing ``jax_compilation_cache_dir`` at a
+persistent directory lets a restarted process deserialize yesterday's
+executables instead of re-lowering them.
+
+Opt-in via ``TrainerConfig.compilation_cache_dir``, the serving plane's
+``compilation_cache_dir`` knob, or the ``T2R_COMPILATION_CACHE_DIR`` env
+var. The restart payoff is measured by the
+``trainer/restart_to_first_step_seconds`` gauge (set by the trainer at
+its first completed dispatch) and recorded per bench round.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+ENV_VAR = 'T2R_COMPILATION_CACHE_DIR'
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def enabled_dir() -> Optional[str]:
+  """The cache dir this process enabled, or None."""
+  return _enabled_dir
+
+
+def maybe_enable_compilation_cache(
+    cache_dir: Optional[str] = None) -> Optional[str]:
+  """Enables the persistent compilation cache if configured.
+
+  ``cache_dir=None`` consults ``T2R_COMPILATION_CACHE_DIR``; still-None
+  leaves jax's default behavior untouched (in-memory cache only).
+  Idempotent and first-wins: jax reads the config at compile time, so a
+  second caller asking for a DIFFERENT directory gets a warning and the
+  already-enabled one. Never raises — a cache is an optimization and
+  must not take down a training job or a serving host.
+  """
+  global _enabled_dir
+  resolved = cache_dir or os.environ.get(ENV_VAR, '').strip() or None
+  if not resolved:
+    return _enabled_dir
+  with _lock:
+    if _enabled_dir is not None:
+      if os.path.abspath(resolved) != os.path.abspath(_enabled_dir):
+        logging.warning(
+            'Compilation cache already enabled at %r; ignoring request '
+            'for %r.', _enabled_dir, resolved)
+      return _enabled_dir
+    try:
+      import jax
+
+      os.makedirs(resolved, exist_ok=True)
+      jax.config.update('jax_compilation_cache_dir', resolved)
+      # Cache EVERYTHING: the defaults skip fast-compiling programs, but
+      # restart goodput is the sum over all of them (K×M train program +
+      # every serving bucket), and disk is cheap next to a restart.
+      for knob, value in (
+          ('jax_persistent_cache_min_compile_time_secs', 0.0),
+          ('jax_persistent_cache_min_entry_size_bytes', -1),
+      ):
+        try:
+          jax.config.update(knob, value)
+        except Exception:  # pylint: disable=broad-except
+          pass  # knob renamed/absent in this jax: dir alone still caches
+      _enabled_dir = resolved
+      from tensor2robot_tpu.observability import metrics as metrics_lib
+
+      metrics_lib.gauge('compile_cache/enabled').set(1.0)
+      logging.info('Persistent compilation cache enabled at %r', resolved)
+    except Exception as e:  # pylint: disable=broad-except
+      logging.warning('Could not enable compilation cache at %r: %r',
+                      resolved, e)
+    return _enabled_dir

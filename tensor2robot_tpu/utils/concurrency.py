@@ -1,0 +1,76 @@
+"""Concurrency primitives shared by the predictor/serving layer.
+
+:class:`ReaderWriterLock` exists because hot-reloading predictors
+(``predictors/predictors.py``) swap several fields during ``restore()``
+(``_forward``, ``_variables``, ``_feature_spec``, ``_global_step``) while
+robot control loops and the serving plane call ``predict()`` from other
+threads. Without exclusion, a predict can observe the new serving fn with
+the old variables (shape-mismatch crash) or a torn spec. Reads are the hot
+path (one predict per robot action, many per serving dispatch), so they
+share the lock; the reload takes it exclusively.
+
+Writer-preference: once a writer is waiting, NEW readers queue behind it,
+so a sustained predict hammer can never starve a reload (the production
+failure mode: a fleet that keeps acting forever on a stale policy because
+``restore()`` never gets in). Consequence: the lock is NOT reentrant —
+a reader that re-acquires while a writer waits deadlocks. Callers keep
+lock scopes flat (predictors never nest predict inside predict).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class ReaderWriterLock:
+  """Many concurrent readers XOR one writer; writers take priority."""
+
+  def __init__(self):
+    self._cond = threading.Condition()
+    self._active_readers = 0
+    self._writer_active = False
+    self._writers_waiting = 0
+
+  def acquire_read(self) -> None:
+    with self._cond:
+      while self._writer_active or self._writers_waiting:
+        self._cond.wait()
+      self._active_readers += 1
+
+  def release_read(self) -> None:
+    with self._cond:
+      self._active_readers -= 1
+      if self._active_readers == 0:
+        self._cond.notify_all()
+
+  def acquire_write(self) -> None:
+    with self._cond:
+      self._writers_waiting += 1
+      try:
+        while self._writer_active or self._active_readers:
+          self._cond.wait()
+      finally:
+        self._writers_waiting -= 1
+      self._writer_active = True
+
+  def release_write(self) -> None:
+    with self._cond:
+      self._writer_active = False
+      self._cond.notify_all()
+
+  @contextlib.contextmanager
+  def read_locked(self):
+    self.acquire_read()
+    try:
+      yield
+    finally:
+      self.release_read()
+
+  @contextlib.contextmanager
+  def write_locked(self):
+    self.acquire_write()
+    try:
+      yield
+    finally:
+      self.release_write()

@@ -41,17 +41,26 @@ class _MockMLP(nn.Module):
 
 
 class MockT2RModel(ClassificationModel):
-  """Binary classifier over 2-D points; the universal smoke-test model."""
+  """Binary classifier over 2-D points; the universal smoke-test model.
+
+  ``hidden_size`` scales the MLP: the default 16 keeps train-path tests
+  fast; the serving bench uses ~2048 — at that width a batch-1 predict
+  is dominated by weight-streaming/dispatch, so a batch-64 dispatch
+  costs about the same as batch-1 (the per-chip economics of the
+  tunnel-attached critic that cross-client batching exploits).
+  """
 
   def __init__(self,
                device_type: str = DEVICE_TYPE_TPU,
                multi_dataset: bool = False,
+               hidden_size: int = 16,
                **kwargs):
     super().__init__(device_type=device_type, **kwargs)
     self._multi_dataset = multi_dataset
+    self._hidden_size = hidden_size
 
   def create_module(self):
-    return _MockMLP()
+    return _MockMLP(hidden_size=self._hidden_size)
 
   def get_feature_specification(self, mode: str) -> SpecStruct:
     del mode

@@ -66,6 +66,13 @@ _WORKER = textwrap.dedent("""
 
 
 @pytest.mark.slow
+@pytest.mark.skip(
+    reason="this jaxlib's CPU backend cannot run cross-process XLA "
+           'programs — the global-mesh drill dies with "Multiprocess '
+           'computations aren\'t implemented" (ROADMAP carried '
+           'follow-up: re-point at a real pod or a newer jaxlib; the '
+           'control-plane equivalents live in '
+           'tests/test_distributed_resilience.py)')
 def test_two_process_distinct_shards(tmp_path):
   port = socket.socket()
   port.bind(('127.0.0.1', 0))
