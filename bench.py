@@ -531,6 +531,62 @@ def bench_serving_plane(clients_sweep=(1, 8, 16, 32), headline_clients=32,
                     'value': round(head.latency_ms_p99, 2), 'unit': 'ms',
                     'clients': head.clients}))
 
+  # Quantized serving (int8 weight-only, parity-gated): the same sweep
+  # against the quantized plane. The mock is weight-streaming-bound, so
+  # the param-bytes ratio is the mechanism; the throughput delta on CPU
+  # is a functional proxy — the int8-vs-bf16 claim lands on the real
+  # chip (BENCH_r06).
+  import jax.numpy as jnp
+
+  from tensor2robot_tpu import quantize as quant_lib
+
+  full_serving = predictor.stateless_serving_fn()
+  int8_serving = predictor.stateless_serving_fn(quantize='int8')
+  f32_bytes = quant_lib.param_bytes(full_serving.params)
+  bf16_bytes = quant_lib.cast_tree_bytes(full_serving.params, jnp.bfloat16)
+  int8_bytes = quant_lib.param_bytes(int8_serving.params)
+  print(json.dumps({
+      'metric': 'serving_quant_param_bytes_ratio',
+      'value': round(int8_bytes / bf16_bytes, 4),
+      'unit': 'int8/bf16 bytes',
+      'param_bytes_int8': int8_bytes,
+      'param_bytes_bf16': bf16_bytes,
+      'param_bytes_f32': f32_bytes,
+      'note': 'HBM bytes streamed per dispatch (the weight-streaming '
+              'bound); v5e int8 MXU peak is an additional 2x over bf16',
+  }))
+  quant_reports = {}
+  with DynamicBatcher(predictor, max_batch=64, batch_deadline_ms=0.2,
+                      quantize='int8') as batcher:
+    statz = batcher.report()
+    submit = loadgen.inproc_submit_fn(batcher)
+    for clients in clients_sweep:
+      quant_reports[clients] = report = loadgen.run_load(
+          submit, features_fn, num_clients=clients,
+          duration_secs=duration_secs)
+      print(json.dumps({
+          'metric': 'serving_quant_client_sweep',
+          **report.as_dict(),
+      }))
+  qhead = quant_reports[headline_clients]
+  print(json.dumps({
+      'metric': 'serving_quant_actions_per_sec',
+      'value': round(qhead.actions_per_sec, 1),
+      'unit': 'actions/sec',
+      'clients': qhead.clients,
+      'latency_ms_p50': round(qhead.latency_ms_p50, 2),
+      'latency_ms_p99': round(qhead.latency_ms_p99, 2),
+      'errors': qhead.errors,
+      'vs_full_precision': round(qhead.actions_per_sec /
+                                 head.actions_per_sec, 2)
+      if head.actions_per_sec else None,
+      'quantized_active': statz['quantized_active'],
+      'quant_parity_max_abs_err': statz['quant_parity_max_abs_err'],
+      'quant_parity_rejects': statz['quant_parity_rejects'],
+      'note': 'int8 weight-only serving, parity-gated; CPU-mock proxy — '
+              'the int8-vs-bf16 device delta rides BENCH_r06',
+  }))
+
   # The HTTP front door (stdlib ThreadingHTTPServer + JSON): transport
   # overhead rides on top of the batching plane, so this line is about
   # the edge, not the dispatch economics.

@@ -12,7 +12,8 @@ Usage:
   python -m tensor2robot_tpu.bin.run_serving \
       --export_dir /models/m/export/latest_exporter_numpy \
       --port 8000 --max-batch 64 --batch-deadline-ms 5 \
-      --metricsz-port 8001 --compilation-cache-dir /var/cache/t2r-xla
+      --metricsz-port 8001 --compilation-cache-dir /var/cache/t2r-xla \
+      --quantize int8
 
 SIGTERM/SIGINT drain: the HTTP listener stops, queued requests complete,
 then the process exits 0 — a fleet scheduler can roll the serving tier
@@ -61,6 +62,22 @@ def main(argv=None):
                       help='Persistent XLA cache: restarted servers '
                            'deserialize bucket executables instead of '
                            'recompiling (T2R_COMPILATION_CACHE_DIR).')
+  parser.add_argument('--quantize', choices=('off', 'int8', 'fp8'),
+                      default='off',
+                      help='Weight-only quantized serving: int8 (or fp8 '
+                           'where jaxlib supports float8_e4m3fn) params '
+                           'with per-output-channel scales, dequantized '
+                           'inline on-chip. Parity-gated: a generation '
+                           'outside the band serves full precision '
+                           'instead (serving/quant_parity_rejects).')
+  parser.add_argument('--quant-parity-atol', type=float, default=0.05,
+                      help='Absolute term of the quantization parity '
+                           'band checked on calibration batches before '
+                           'a quantized generation may serve.')
+  parser.add_argument('--quant-parity-rtol', type=float, default=0.05,
+                      help='Relative term of the quantization parity '
+                           'band (scaled by the full-precision output '
+                           'magnitude).')
   args = parser.parse_args(argv)
   logging.basicConfig(
       level=logging.INFO,
@@ -88,7 +105,10 @@ def main(argv=None):
       max_batch=args.max_batch,
       batch_deadline_ms=args.batch_deadline_ms,
       max_queue=args.max_queue,
-      reload_interval_secs=reload_interval)
+      reload_interval_secs=reload_interval,
+      quantize=args.quantize,
+      quant_parity_atol=args.quant_parity_atol,
+      quant_parity_rtol=args.quant_parity_rtol)
 
   stop = threading.Event()
 

@@ -131,6 +131,25 @@ def serialize_serving_fn(model, serving_variables,
   return exported.serialize()
 
 
+def serving_program_fingerprint(exported) -> str:
+  """Canonical digest of an ``Exported``'s PROGRAM (not its bytes).
+
+  ``Exported.serialize()`` embeds MLIR ``loc(...)`` debug locations —
+  call-site file:line that drifts between otherwise identical exports —
+  so hashing the raw artifact makes every export version look like a new
+  program and defeats serving-executable cache reuse on weights-only
+  hot swaps. Hashing the location-stripped module text is stable:
+  equal fingerprints <=> same compute program, only weights differ.
+  """
+  import hashlib
+  import re
+
+  text = exported.mlir_module()
+  text = re.sub(r'(?m)^#loc.*$', '', text)  # "#locN = loc(...)" defs
+  text = re.sub(r'loc\([^)]*\)', '', text)  # trailing "loc(#locN)" refs
+  return hashlib.sha256(text.encode()).hexdigest()
+
+
 def write_warmup_requests(export_dir: str,
                           model,
                           batch_size: int = 1,

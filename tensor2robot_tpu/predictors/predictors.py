@@ -95,7 +95,8 @@ class AbstractPredictor(abc.ABC):
     raise NotImplementedError(
         f'{type(self).__name__} does not expose a traceable serving fn.')
 
-  def stateless_serving_fn(self) -> StatelessServingFn:
+  def stateless_serving_fn(
+      self, quantize: Optional[str] = None) -> StatelessServingFn:
     """The loaded model as a :class:`StatelessServingFn` snapshot.
 
     The serving plane's contract: the returned tuple is immutable — a
@@ -105,9 +106,27 @@ class AbstractPredictor(abc.ABC):
     compute path is not a jax function (e.g. the TF SavedModel
     signature); the serving plane then degrades to batched
     ``predict()`` calls.
+
+    ``quantize`` ('int8' / 'fp8') returns the weight-only quantized
+    twin (``tensor2robot_tpu/quantize/``): int8/fp8 param payload with
+    per-output-channel scales, dequantized inline in the jitted
+    program, ``program_key`` extended with the mode so executable
+    caches never alias precision variants. Quantization runs on the
+    host OUTSIDE the reload lock — a concurrent restore is never
+    blocked behind it.
     """
     raise NotImplementedError(
         f'{type(self).__name__} does not expose a stateless serving fn.')
+
+  @staticmethod
+  def _maybe_quantize_serving(serving: StatelessServingFn,
+                              quantize: Optional[str]) -> StatelessServingFn:
+    """Shared quantize hook for the concrete flavors (no-op on None/'off')."""
+    if quantize in (None, '', 'off'):
+      return serving
+    from tensor2robot_tpu.quantize import quantize_serving_fn
+
+    return quantize_serving_fn(serving, mode=quantize)
 
   @property
   @abc.abstractmethod
@@ -255,13 +274,17 @@ class CheckpointPredictor(AbstractPredictor):
     with self._reload_lock.read_locked():
       return self._forward.traceable, self._variables
 
-  def stateless_serving_fn(self) -> StatelessServingFn:
+  def stateless_serving_fn(
+      self, quantize: Optional[str] = None) -> StatelessServingFn:
     self.assert_is_loaded()
     with self._reload_lock.read_locked():
-      return StatelessServingFn(
+      serving = StatelessServingFn(
           fn=self._forward.traceable, params=self._variables,
           feature_spec=self._feature_spec, version=self._global_step,
           program_key=('jit_forward', id(self._forward)))
+    # Host-side quantization outside the lock: it only reads the
+    # immutable snapshot, never predictor state.
+    return self._maybe_quantize_serving(serving, quantize)
 
   @property
   def is_loaded(self) -> bool:
@@ -322,7 +345,15 @@ class ExportedModelPredictor(AbstractPredictor):
     self._feature_spec: Optional[SpecStruct] = None
     self._loaded_dir: Optional[str] = None
     self._parse_fn = None
+    # Two digests: _serving_raw_digest short-circuits reloads whose
+    # artifact BYTES are identical; _serving_digest is the canonical
+    # loc-stripped PROGRAM fingerprint (exporters.
+    # serving_program_fingerprint) — stable across weights-only export
+    # versions, so it keys program identity for serving-executable
+    # cache reuse where the raw bytes cannot (they embed drifting MLIR
+    # debug locations).
     self._serving_digest: Optional[str] = None
+    self._serving_raw_digest: Optional[str] = None
     # Hot reload swaps _forward/_traceable/_variables/_feature_spec as a
     # group; the lock keeps an in-flight predict from mixing generations
     # (new serving fn + old params = shape-mismatch crash).
@@ -373,27 +404,35 @@ class ExportedModelPredictor(AbstractPredictor):
     forward = self._forward
     traceable = self._traceable
     digest = None
+    raw_digest = None
     if serving_bytes is not None:
       # Self-contained path: the serialized StableHLO fn already includes
       # preprocessing; no model object is ever constructed. Successive
       # export versions normally carry the SAME program (only weights
       # change), so reuse the deserialized fn — and its compile cache —
-      # unless the program bytes actually differ.
-      digest = hashlib.sha256(serving_bytes).hexdigest()
-      if forward is None or digest != self._serving_digest:
+      # unless the PROGRAM actually differs. Raw bytes can't decide that
+      # (they embed drifting MLIR debug locations), hence the canonical
+      # fingerprint; identical raw bytes skip the deserialize entirely.
+      raw_digest = hashlib.sha256(serving_bytes).hexdigest()
+      if forward is not None and raw_digest == self._serving_raw_digest:
+        digest = self._serving_digest
+      else:
         from jax import export as jax_export
 
-        serving_call = jax_export.deserialize(serving_bytes).call
+        exported = jax_export.deserialize(serving_bytes)
+        digest = exporters_lib.serving_program_fingerprint(exported)
+        if forward is None or digest != self._serving_digest:
+          serving_call = exported.call
 
-        def stablehlo_traceable(variables, features):
-          return dict(serving_call(
-              exporters_lib.to_plain_tree(variables), dict(features)))
+          def stablehlo_traceable(variables, features):
+            return dict(serving_call(
+                exporters_lib.to_plain_tree(variables), dict(features)))
 
-        def stablehlo_forward(variables, features):
-          outputs = stablehlo_traceable(variables, features)
-          return {k: np.asarray(v) for k, v in outputs.items()}
+          def stablehlo_forward(variables, features):
+            outputs = stablehlo_traceable(variables, features)
+            return {k: np.asarray(v) for k, v in outputs.items()}
 
-        forward, traceable = stablehlo_forward, stablehlo_traceable
+          forward, traceable = stablehlo_forward, stablehlo_traceable
     else:
       # Model-class fallback: the jitted forward only depends on the model
       # object — build it once and reuse its compile cache across versions.
@@ -412,6 +451,7 @@ class ExportedModelPredictor(AbstractPredictor):
       self._forward = forward
       self._traceable = traceable
       self._serving_digest = digest
+      self._serving_raw_digest = raw_digest
       self._variables = variables
       self._feature_spec = feature_spec
       self._global_step = global_step
@@ -430,16 +470,18 @@ class ExportedModelPredictor(AbstractPredictor):
     with self._reload_lock.read_locked():
       return self._traceable, self._variables
 
-  def stateless_serving_fn(self) -> StatelessServingFn:
+  def stateless_serving_fn(
+      self, quantize: Optional[str] = None) -> StatelessServingFn:
     self.assert_is_loaded()
     with self._reload_lock.read_locked():
       program_key = (('stablehlo', self._serving_digest)
                      if self._serving_digest is not None
                      else ('jit_forward', id(self._forward)))
-      return StatelessServingFn(
+      serving = StatelessServingFn(
           fn=self._traceable, params=self._variables,
           feature_spec=self._feature_spec, version=self._global_step,
           program_key=program_key)
+    return self._maybe_quantize_serving(serving, quantize)
 
   def predict_example_bytes(self, serialized_examples) -> Dict[str, Any]:
     """Serialized tf.Example bytes → actions (the tf_example receiver).

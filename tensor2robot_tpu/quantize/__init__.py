@@ -1,0 +1,24 @@
+"""Post-training weight-only quantization (int8 / fp8) for serving."""
+
+from tensor2robot_tpu.quantize.quantization import (FP8, INT8, MODES, OFF,
+                                                    ParityReport,
+                                                    QuantizedTensor,
+                                                    cast_tree_bytes,
+                                                    check_parity,
+                                                    dequantize_array,
+                                                    dequantize_params,
+                                                    fp8_supported,
+                                                    param_bytes,
+                                                    quantize_array,
+                                                    quantize_params,
+                                                    quantize_serving_fn,
+                                                    quantized_leaf_count,
+                                                    should_quantize)
+
+__all__ = [
+    'FP8', 'INT8', 'MODES', 'OFF', 'ParityReport', 'QuantizedTensor',
+    'cast_tree_bytes', 'check_parity', 'dequantize_array',
+    'dequantize_params', 'fp8_supported', 'param_bytes', 'quantize_array',
+    'quantize_params', 'quantize_serving_fn', 'quantized_leaf_count',
+    'should_quantize',
+]

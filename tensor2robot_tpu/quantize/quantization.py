@@ -1,0 +1,340 @@
+"""Post-training weight-only quantization for the serving plane.
+
+The serving economics (PERF_NOTES r6, BENCH_r05): a batch-1 predict on
+the robot-scale critics is **weight-streaming-bound** — the dispatch
+cost is the bytes of parameters read from HBM, not the FLOPs — so a
+batch-64 dispatch costs about what batch-1 does. Quantizing the weight
+tree to int8 (or ``float8_e4m3fn``) quarters/halves the bytes streamed
+per dispatch, which is exactly the serving plane's bottleneck; on v5e
+the int8 MXU peak is additionally 2× bf16. Training parity is never
+touched: quantization happens at serving-fn construction time, after
+the checkpoint/export is loaded.
+
+Design:
+
+* **Weight-only, activations stay bf16/f32.** A quantized leaf is a
+  :class:`QuantizedTensor` — ``(qvalue, scale)`` where ``qvalue`` is the
+  int8/fp8 payload and ``scale`` the per-output-channel symmetric scale
+  (last axis of the weight: flax kernels are ``(in, out)`` /
+  ``(h, w, in, out)``). The serving fn is wrapped so the dequantize
+  ``qvalue.astype(f32) * scale`` happens INLINE in the jitted program:
+  XLA streams int8 bytes from HBM and upcasts in registers, fusing the
+  multiply into the consumer matmul.
+* **Skip-list for quantization-sensitive leaves.** BatchNorm statistics
+  (``batch_stats`` collection), biases, norm scales and any other
+  sub-2D leaf stay full precision — they are a rounding error of the
+  byte budget and carry the model's calibration. Callers add model-
+  specific leaves via ``skip_patterns`` (substring match on the
+  ``jax.tree_util.keystr`` path).
+* **Parity is a gate, not a hope.** :func:`check_parity` runs the
+  quantized and full-precision serving fns on calibration batches and
+  reports the worst per-output error against a declared band — the
+  serving plane refuses to adopt a quantized generation outside the
+  band (``serving/quant_parity_rejects``) and serves full precision
+  instead, mirroring the bf16-band discipline of the training stack.
+
+``QuantizedTensor`` is a NamedTuple, hence automatically a jax pytree
+node: quantized param trees flow through ``tree_map`` / ``device_put`` /
+``jit(...).lower(...)`` untouched, and the bucketed AOT executor caches
+key on the wrapped ``('quant', mode, original_program_key)`` program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+INT8 = 'int8'
+FP8 = 'fp8'
+OFF = 'off'
+MODES = (INT8, FP8)
+
+# int8 symmetric range; fp8 e4m3fn finite max (ml_dtypes.finfo).
+_INT8_BOUND = 127.0
+_FP8_BOUND = 448.0
+
+# Path components that mark a leaf as quantization-sensitive: BN/norm
+# statistics and affine terms. The ndim >= 2 rule already skips all of
+# these in practice (they are per-channel vectors); the explicit list is
+# belt-and-braces against models that reshape them.
+DEFAULT_SKIP_COMPONENTS = frozenset(
+    {'bias', 'scale', 'mean', 'var', 'batch_stats'})
+
+
+class QuantizedTensor(NamedTuple):
+  """A weight leaf as (payload, per-output-channel scale).
+
+  NamedTuple => a jax pytree NODE: ``qvalue`` and ``scale`` are the
+  leaves, so shape/dtype mapping, device placement and AOT lowering all
+  see the int8 payload directly. ``dequantize`` is
+  ``qvalue.astype(scale.dtype) * scale`` (broadcast over the kept last
+  axis).
+  """
+
+  qvalue: Any  # int8 / float8_e4m3fn, original weight shape
+  scale: Any  # float32, shape (1, ..., 1, out_channels)
+
+
+def fp8_supported() -> bool:
+  """Whether this jaxlib/ml_dtypes ships ``float8_e4m3fn``."""
+  try:
+    import jax.numpy as jnp
+
+    np.asarray([0.5], dtype=jnp.float8_e4m3fn)
+    return True
+  except (AttributeError, TypeError):
+    return False
+
+
+def _require_mode(mode: str) -> str:
+  if mode in (None, OFF, ''):
+    raise ValueError('quantization mode is off; nothing to do')
+  if mode not in MODES:
+    raise ValueError(f'unknown quantization mode {mode!r}; '
+                     f'expected one of {MODES + (OFF,)}')
+  if mode == FP8 and not fp8_supported():
+    raise ValueError(
+        'fp8 quantization requested but this jaxlib/ml_dtypes build '
+        'does not support float8_e4m3fn')
+  return mode
+
+
+def channel_scales(weight: np.ndarray, bound: float) -> np.ndarray:
+  """Per-output-channel symmetric scales: amax over every axis except
+  the last, mapped onto ``[-bound, bound]``. Dead channels (all-zero)
+  get scale 1.0 so the dequantized weight is exactly zero."""
+  axes = tuple(range(weight.ndim - 1))
+  amax = np.max(np.abs(weight), axis=axes, keepdims=True)
+  scales = amax.astype(np.float32) / bound
+  return np.where(scales > 0.0, scales, np.float32(1.0))
+
+
+def quantize_array(weight: np.ndarray, mode: str) -> QuantizedTensor:
+  """One weight -> :class:`QuantizedTensor` with per-channel scales."""
+  weight = np.asarray(weight)
+  if mode == INT8:
+    scale = channel_scales(weight, _INT8_BOUND)
+    q = np.clip(np.rint(weight.astype(np.float32) / scale),
+                -_INT8_BOUND, _INT8_BOUND).astype(np.int8)
+  else:
+    _require_mode(mode)
+    import jax.numpy as jnp
+
+    scale = channel_scales(weight, _FP8_BOUND)
+    q = np.asarray(weight.astype(np.float32) / scale,
+                   dtype=jnp.float8_e4m3fn)
+  return QuantizedTensor(qvalue=q, scale=scale)
+
+
+def dequantize_array(qt: QuantizedTensor):
+  """Inverse of :func:`quantize_array`; jnp under a trace (the serving
+  fn path), numpy on concrete host arrays."""
+  qvalue, scale = qt.qvalue, qt.scale
+  if isinstance(qvalue, np.ndarray):
+    return qvalue.astype(np.float32) * np.asarray(scale)
+  return qvalue.astype(scale.dtype) * scale
+
+
+def _path_components(path) -> Tuple[str, ...]:
+  import jax
+
+  out = []
+  for entry in path:
+    if isinstance(entry, jax.tree_util.DictKey):
+      out.append(str(entry.key))
+    elif isinstance(entry, jax.tree_util.SequenceKey):
+      out.append(str(entry.idx))
+    elif isinstance(entry, jax.tree_util.GetAttrKey):
+      out.append(str(entry.name))
+    else:
+      out.append(str(entry))
+  return tuple(out)
+
+
+def should_quantize(path, leaf,
+                    skip_patterns: Sequence[str] = ()) -> bool:
+  """The default leaf policy: floating, >= 2-D (matmul/conv weights —
+  1-D bias/scale/mean/var vectors stay full precision), not under a
+  skip component, not matching a caller pattern."""
+  leaf = np.asarray(leaf) if not hasattr(leaf, 'ndim') else leaf
+  if not np.issubdtype(np.asarray(leaf).dtype, np.floating):
+    return False
+  if np.ndim(leaf) < 2:
+    return False
+  components = _path_components(path)
+  if any(c.lower() in DEFAULT_SKIP_COMPONENTS for c in components):
+    return False
+  path_str = '/'.join(components)
+  return not any(p in path_str for p in skip_patterns)
+
+
+def quantize_params(params,
+                    mode: str = INT8,
+                    skip_patterns: Sequence[str] = (),
+                    predicate: Optional[Callable] = None):
+  """Weight-only quantization of a param pytree.
+
+  Every leaf passing ``predicate`` (default :func:`should_quantize`)
+  becomes a :class:`QuantizedTensor`; skip-list leaves pass through
+  UNTOUCHED (same array object where the input was already a host
+  array). Structure is otherwise preserved, so the tree drops into the
+  same serving fn signature after :func:`dequantize_params`.
+  """
+  _require_mode(mode)
+  import jax
+
+  predicate = predicate or (
+      lambda path, leaf: should_quantize(path, leaf, skip_patterns))
+
+  def convert(path, leaf):
+    if not predicate(path, leaf):
+      return leaf
+    return quantize_array(np.asarray(leaf), mode)
+
+  return jax.tree_util.tree_map_with_path(convert, params)
+
+
+def dequantize_params(params):
+  """Replaces every :class:`QuantizedTensor` node with its dequantized
+  array; traceable (this IS the inline upcast in the jitted serving
+  program — XLA reads the int8 payload from HBM and fuses the scale
+  multiply into the consumer)."""
+  import jax
+
+  return jax.tree_util.tree_map(
+      lambda leaf: dequantize_array(leaf)
+      if isinstance(leaf, QuantizedTensor) else leaf,
+      params,
+      is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def param_bytes(params) -> int:
+  """Total parameter bytes as streamed from HBM per dispatch (quantized
+  leaves count payload + scales)."""
+  import jax
+
+  total = 0
+  for leaf in jax.tree_util.tree_leaves(params):
+    leaf = np.asarray(leaf)
+    total += leaf.size * leaf.dtype.itemsize
+  return int(total)
+
+
+def cast_tree_bytes(params, dtype) -> int:
+  """Bytes the tree WOULD occupy with floating leaves cast to ``dtype``
+  (the bf16-serving denominator of the compression claim)."""
+  import jax
+
+  itemsize = np.dtype(dtype).itemsize
+  total = 0
+  for leaf in jax.tree_util.tree_leaves(params):
+    leaf = np.asarray(leaf)
+    size = leaf.size
+    if np.issubdtype(leaf.dtype, np.floating):
+      total += size * itemsize
+    else:
+      total += size * leaf.dtype.itemsize
+  return int(total)
+
+
+def quantized_leaf_count(params) -> int:
+  import jax
+
+  return sum(
+      1 for leaf in jax.tree_util.tree_leaves(
+          params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+      if isinstance(leaf, QuantizedTensor))
+
+
+def quantize_serving_fn(serving,
+                        mode: str = INT8,
+                        skip_patterns: Sequence[str] = ()):
+  """A ``StatelessServingFn`` -> its weight-quantized twin.
+
+  ``fn`` dequantizes inline then calls the original program (the
+  wrapper is traced into ONE jitted program — there is no separate
+  dequant dispatch); ``params`` is the quantized tree;
+  ``program_key`` becomes ``('quant', mode, original_key)`` so
+  executable caches never alias full-precision and quantized programs,
+  while weights-only hot swaps under the SAME mode still hit.
+  """
+  _require_mode(mode)
+  import jax
+
+  from tensor2robot_tpu.predictors.predictors import StatelessServingFn
+
+  host_params = jax.tree_util.tree_map(np.asarray, serving.params)
+  qparams = quantize_params(host_params, mode=mode,
+                            skip_patterns=skip_patterns)
+  inner = serving.fn
+
+  def quantized_fn(params, features):
+    return inner(dequantize_params(params), features)
+
+  return StatelessServingFn(
+      fn=quantized_fn,
+      params=qparams,
+      feature_spec=serving.feature_spec,
+      version=serving.version,
+      program_key=('quant', mode, serving.program_key))
+
+
+class ParityReport(NamedTuple):
+  """Worst-case quantized-vs-full error over the calibration batches."""
+
+  ok: bool
+  max_abs_err: float
+  max_rel_err: float
+  atol: float
+  rtol: float
+  per_output: Dict[str, float]  # output key -> max abs err
+
+  def describe(self) -> str:
+    status = 'within' if self.ok else 'OUTSIDE'
+    return (f'quantization parity {status} band: max_abs_err='
+            f'{self.max_abs_err:.3e} (atol={self.atol:.1e}), '
+            f'max_rel_err={self.max_rel_err:.3e} (rtol={self.rtol:.1e}), '
+            f'per_output={ {k: round(v, 6) for k, v in self.per_output.items()} }')
+
+
+def check_parity(full_serving,
+                 quant_serving,
+                 atol: float,
+                 rtol: float,
+                 calibration_batches: int = 2,
+                 calibration_batch_size: int = 4,
+                 seed: int = 0) -> ParityReport:
+  """Runs both serving fns on deterministic spec-shaped calibration
+  batches; the band is per output key:
+  ``max|q - f| <= atol + rtol * max|f|``. This is the gate the serving
+  plane applies BEFORE adopting a quantized generation."""
+  import jax
+
+  from tensor2robot_tpu.specs import numpy_gen
+
+  full_fn = jax.jit(full_serving.fn)
+  quant_fn = jax.jit(quant_serving.fn)
+  max_abs = 0.0
+  max_rel = 0.0
+  per_output: Dict[str, float] = {}
+  ok = True
+  for i in range(calibration_batches):
+    batch = dict(numpy_gen.make_random_numpy(
+        full_serving.feature_spec, batch_size=calibration_batch_size,
+        seed=seed + i))
+    full_out = full_fn(full_serving.params, batch)
+    quant_out = quant_fn(quant_serving.params, batch)
+    for key in full_out:
+      f = np.asarray(full_out[key], np.float32)
+      q = np.asarray(quant_out[key], np.float32)
+      abs_err = float(np.max(np.abs(q - f))) if f.size else 0.0
+      scale = float(np.max(np.abs(f))) if f.size else 0.0
+      per_output[key] = max(per_output.get(key, 0.0), abs_err)
+      max_abs = max(max_abs, abs_err)
+      if scale > 0.0:
+        max_rel = max(max_rel, abs_err / scale)
+      if abs_err > atol + rtol * scale:
+        ok = False
+  return ParityReport(ok=ok, max_abs_err=max_abs, max_rel_err=max_rel,
+                      atol=atol, rtol=rtol, per_output=per_output)

@@ -36,11 +36,27 @@ Design points:
   queue and wait, so the GIL-heavy JSON/HTTP edges scale with threads
   while the compute path stays single-file (no executor lock needed).
 
+* **Quantized serving behind a parity gate.** ``quantize='int8'`` (or
+  ``'fp8'``) serves the weight-only quantized twin of the stateless fn
+  (``tensor2robot_tpu/quantize/``): int8 payload + per-output-channel
+  scales streamed from HBM, dequantized inline in the jitted program.
+  Batch-1 predict on robot-scale critics is weight-streaming-bound
+  (PERF_NOTES r6), so the ~4× byte cut is the serving plane's highest-
+  leverage optimisation. Adoption is GATED: the quantized fn must match
+  the full-precision fn within ``quant_parity_atol/rtol`` on
+  calibration batches, else the plane refuses it and serves full
+  precision (``serving/quant_parity_rejects``). Quantization +
+  parity checks run off-thread (startup / reload prep, like bucket
+  warmup); executable caches key on ``('quant', mode, program_key)``
+  so weights-only hot swaps still reuse compiled buckets and the
+  zero-recompile guarantee is preserved.
+
 SLO metrics live in the process registry under ``serving/`` and are
 published through ``/metricsz`` via ``register_report_provider('serving',
 ...)``: request/action counters, batch-size + request-latency histograms
 (p50/p99), a rolling ``serving/actions_per_sec`` gauge, queue depth,
-swap/compile counters.
+swap/compile counters, and the quantization block (``serving/param_bytes``
+gauge, ``serving/quant/*`` parity + compression gauges).
 """
 
 from __future__ import annotations
@@ -168,7 +184,17 @@ class JitBucketExecutor:
     self.program_key = serving.program_key
     self.version = serving.version
     self.params_ref = serving.params  # identity marker for swap detection
+    # Under quantization the served params are a DERIVED tree; the
+    # batcher re-points these at the predictor's source generation so
+    # reload polling compares against what restore() actually produces.
+    self.source_params_ref = serving.params
+    self.source_program_key = serving.program_key
     host_params = to_plain_tree(serving.params)
+    # HBM bytes streamed per dispatch (the quantization target metric;
+    # QuantizedTensor nodes count payload + scales).
+    self.param_bytes = int(sum(
+        np.asarray(leaf).size * np.asarray(leaf).dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(host_params)))
     self._param_shapes = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
         host_params)
@@ -249,6 +275,7 @@ class PredictCallableExecutor:
     self.program_key = ('predict_callable', id(predictor))
     self.version = predictor.model_version
     self.params_ref = None
+    self.param_bytes = 0
 
   def warm(self) -> None:
     pass
@@ -279,10 +306,25 @@ class DynamicBatcher:
                max_queue: int = 1024,
                buckets: Optional[Sequence[int]] = None,
                reload_interval_secs: Optional[float] = None,
+               quantize: str = 'off',
+               quant_parity_atol: float = 0.05,
+               quant_parity_rtol: float = 0.05,
+               quant_calibration_batches: int = 2,
+               quant_calibration_batch_size: int = 4,
+               quant_skip_patterns: Sequence[str] = (),
                clock: Callable[[], float] = time.monotonic):
     if max_batch < 1:
       raise ValueError(f'max_batch must be >= 1, got {max_batch}')
+    if quantize not in (None, '', 'off', 'int8', 'fp8'):
+      raise ValueError(f"quantize must be one of 'off'/'int8'/'fp8', "
+                       f'got {quantize!r}')
     self._predictor = predictor
+    self._quantize = quantize if quantize not in (None, '') else 'off'
+    self._quant_parity_atol = float(quant_parity_atol)
+    self._quant_parity_rtol = float(quant_parity_rtol)
+    self._quant_calibration_batches = int(quant_calibration_batches)
+    self._quant_calibration_batch_size = int(quant_calibration_batch_size)
+    self._quant_skip_patterns = tuple(quant_skip_patterns)
     self._max_batch = int(max_batch)
     self._deadline_s = float(batch_deadline_ms) / 1e3
     self._max_queue = int(max_queue)
@@ -322,6 +364,15 @@ class DynamicBatcher:
     self._m_queue_depth = s.gauge('queue_depth')
     self._m_actions_per_sec = s.gauge('actions_per_sec')
     self._m_version = s.gauge('model_version')
+    self._m_param_bytes = s.gauge('param_bytes')
+    self._m_quant_rejects = s.counter('quant_parity_rejects')
+    self._m_quant_errors = s.counter('quant_errors')
+    qs = metrics_lib.scope('serving/quant')
+    self._m_quant_active = qs.gauge('active')
+    self._m_quant_bytes_full = qs.gauge('param_bytes_full')
+    self._m_quant_bytes_ratio = qs.gauge('param_bytes_ratio')
+    self._m_quant_abs_err = qs.gauge('parity_max_abs_err')
+    self._m_quant_rel_err = qs.gauge('parity_max_rel_err')
 
   # ------------------------------------------------------------- lifecycle
 
@@ -331,10 +382,13 @@ class DynamicBatcher:
     if self._dispatcher is not None:
       return self
     self._predictor.assert_is_loaded()
+    if self._quantize == 'off':
+      self._m_quant_active.set(0.0)  # registry is process-global
     self._model = self._build_executor(reuse_from=None)
     self._model.warm()
     self._feature_spec = self._predictor.get_feature_specification()
     self._m_version.set(float(self._model.version))
+    self._m_param_bytes.set(float(self._model.param_bytes))
     self._dispatcher = threading.Thread(
         target=self._dispatch_loop, daemon=True, name='t2r-serving-dispatch')
     self._dispatcher.start()
@@ -488,6 +542,7 @@ class DynamicBatcher:
         self._model = pending
         self._m_swaps.inc()
         self._m_version.set(float(pending.version))
+        self._m_param_bytes.set(float(pending.param_bytes))
         logging.info('Serving hot-swapped to model version %d',
                      pending.version)
       self._execute(batch)
@@ -549,13 +604,70 @@ class DynamicBatcher:
 
   def _build_executor(self, reuse_from):
     try:
-      serving = self._predictor.stateless_serving_fn()
+      source = self._predictor.stateless_serving_fn()
     except NotImplementedError:
       return PredictCallableExecutor(self._predictor)
+    serving = self._quantize_gate(source)
     compiled = (reuse_from.compatible_cache(serving)
                 if reuse_from is not None else None)
     executor = JitBucketExecutor(serving, self._buckets, compiled=compiled)
+    # Reload polling compares against the predictor's OWN generation,
+    # not the derived quantized tree (see _same_generation).
+    executor.source_params_ref = source.params
+    executor.source_program_key = source.program_key
     return executor
+
+  def _quantize_gate(self, serving):
+    """Weight-only quantization behind the parity gate.
+
+    Runs on the PREPARING thread (startup or reload poller, never the
+    dispatcher): quantize the snapshot, check it against the full-
+    precision fn on calibration batches, and only then let it near the
+    executor. A band violation refuses the quantized generation
+    (``serving/quant_parity_rejects``) and serves full precision; a
+    prep failure (e.g. fp8 on a jaxlib without the dtype) does the same
+    via ``serving/quant_errors``. Either way serving NEVER degrades
+    below the full-precision path.
+    """
+    mode = self._quantize
+    if mode == 'off':
+      return serving
+    from tensor2robot_tpu import quantize as quant_lib
+
+    try:
+      quantized = quant_lib.quantize_serving_fn(
+          serving, mode=mode, skip_patterns=self._quant_skip_patterns)
+      report = quant_lib.check_parity(
+          serving, quantized,
+          atol=self._quant_parity_atol, rtol=self._quant_parity_rtol,
+          calibration_batches=self._quant_calibration_batches,
+          calibration_batch_size=self._quant_calibration_batch_size)
+      full_bytes = quant_lib.param_bytes(serving.params)
+    except Exception as e:  # pylint: disable=broad-except
+      self._m_quant_errors.inc()
+      self._m_quant_active.set(0.0)
+      logging.warning(
+          'Quantized (%s) serving prep failed (%r); serving full '
+          'precision.', mode, e)
+      return serving
+    self._m_quant_abs_err.set(report.max_abs_err)
+    self._m_quant_rel_err.set(report.max_rel_err)
+    self._m_quant_bytes_full.set(float(full_bytes))
+    if not report.ok:
+      self._m_quant_rejects.inc()
+      self._m_quant_active.set(0.0)
+      logging.warning(
+          'Quantized (%s) generation REJECTED by the parity gate: %s; '
+          'serving full precision.', mode, report.describe())
+      return serving
+    quant_bytes = quant_lib.param_bytes(quantized.params)
+    self._m_quant_bytes_ratio.set(quant_bytes / max(full_bytes, 1))
+    self._m_quant_active.set(1.0)
+    logging.info(
+        'Quantized (%s) serving adopted: %s; param bytes %d -> %d '
+        '(%.3fx).', mode, report.describe(), full_bytes, quant_bytes,
+        quant_bytes / max(full_bytes, 1))
+    return quantized
 
   def maybe_reload(self) -> bool:
     """One reload poll: restore the predictor, and if a NEW generation
@@ -589,8 +701,11 @@ class DynamicBatcher:
       serving = self._predictor.stateless_serving_fn()
     except NotImplementedError:
       return False
-    return (serving.params is current.params_ref and
-            serving.program_key == current.program_key)
+    # Compare against the SOURCE generation: under quantization the
+    # executor serves a derived tree whose identity the predictor never
+    # hands out again — matching on it would re-quantize every poll.
+    return (serving.params is current.source_params_ref and
+            serving.program_key == current.source_program_key)
 
   def _reload_loop(self) -> None:
     while not self._reload_stop.wait(self._reload_interval):
@@ -620,4 +735,17 @@ class DynamicBatcher:
         'model_swaps': snap.get('serving/model_swaps', 0),
         'reload_errors': snap.get('serving/reload_errors', 0),
         'bucket_compiles': snap.get('serving/bucket_compiles', 0),
+        'quantize': self._quantize,
+        'quantized_active': bool(snap.get('serving/quant/active', 0.0)),
+        'param_bytes': int(snap.get('serving/param_bytes', 0.0)),
+        'quant_parity_rejects': snap.get('serving/quant_parity_rejects', 0),
+        'quant_errors': snap.get('serving/quant_errors', 0),
+        'quant_param_bytes_full': int(
+            snap.get('serving/quant/param_bytes_full', 0.0)),
+        'quant_param_bytes_ratio': snap.get(
+            'serving/quant/param_bytes_ratio', 0.0),
+        'quant_parity_max_abs_err': snap.get(
+            'serving/quant/parity_max_abs_err', 0.0),
+        'quant_parity_max_rel_err': snap.get(
+            'serving/quant/parity_max_rel_err', 0.0),
     }

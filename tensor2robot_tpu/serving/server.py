@@ -118,6 +118,13 @@ class ServingServer:
   host is an operator decision via ``host=``. ``close()`` is orderly:
   the listener stops, queued requests drain, the last response leaves
   before threads die.
+
+  Batcher knobs (``max_batch``, ``batch_deadline_ms``, ``max_queue``,
+  ``reload_interval_secs``, ``quantize='int8'``/``'fp8'`` + its
+  ``quant_parity_*`` band — see :class:`~tensor2robot_tpu.serving.
+  batching.DynamicBatcher`) pass through ``**batcher_kwargs``; the
+  ``/statz`` report includes the quantization block (mode, active,
+  ``param_bytes``, parity errors, byte ratio).
   """
 
   def __init__(self,

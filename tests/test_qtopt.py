@@ -224,11 +224,15 @@ class TestPooledBatchNormRelu:
 
     go = jax.grad(loss(orig), argnums=(0, 1))(vo, x)
     gn = jax.grad(loss(pooled), argnums=(0, 1))(vn, x)
+    # The rewrite is the same FUNCTION but not the same reduction order:
+    # XLA reassociates the bias-grad sum (over pre- vs post-pool extents),
+    # so ~1e3-magnitude grads land within f32 ulp-noise of each other
+    # (observed max rel err 6e-7) — a relative band, not bitwise.
     np.testing.assert_allclose(np.asarray(go[1]), np.asarray(gn[1]),
-                               atol=1e-4)
+                               rtol=1e-5, atol=1e-4)
     np.testing.assert_allclose(
         np.asarray(go[0]['params']['BatchNorm_0']['bias']),
-        np.asarray(gn[0]['params']['bn']['bias']), atol=1e-4)
+        np.asarray(gn[0]['params']['bn']['bias']), rtol=1e-5, atol=1e-4)
 
     yo2 = orig.apply(
         {'params': vo['params'], 'batch_stats': so['batch_stats']}, x, False)

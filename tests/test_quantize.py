@@ -1,0 +1,406 @@
+"""Quantized serving tests: round-trip invariants, per-channel scale
+shapes, skip-list, byte budgets, the parity gate (pass + reject drill),
+quantized hot swap under load, and zero-recompile pinning with the
+quantized executor.
+
+Marker: ``quant`` (tier-1; ``tools/run_tier1.sh -m quant`` selects).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import export as export_lib
+from tensor2robot_tpu import quantize as quant_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.serving import batching as batching_lib
+from tensor2robot_tpu.serving import loadgen
+from tensor2robot_tpu.train import Trainer, TrainerConfig
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+pytestmark = pytest.mark.quant
+
+
+def _loaded_mock_predictor(hidden_size=64):
+  predictor = CheckpointPredictor(
+      MockT2RModel(device_type='tpu', hidden_size=hidden_size),
+      model_dir='/nonexistent')
+  predictor.init_randomly()
+  return predictor
+
+
+def _features(value: float, n: int = 1):
+  return {'measured_position': np.full((n, 2), value, np.float32)}
+
+
+def _sample_tree(seed=0):
+  rng = np.random.RandomState(seed)
+  return {
+      'params': {
+          'Dense_0': {
+              'kernel': rng.randn(16, 8).astype(np.float32),
+              'bias': rng.randn(8).astype(np.float32),
+          },
+          'Conv_0': {'kernel': rng.randn(3, 3, 4, 8).astype(np.float32)},
+          'BatchNorm_0': {
+              'scale': rng.rand(8).astype(np.float32) + 0.5,
+              'bias': rng.randn(8).astype(np.float32),
+          },
+      },
+      'batch_stats': {
+          'BatchNorm_0': {
+              'mean': rng.randn(8).astype(np.float32),
+              'var': rng.rand(8).astype(np.float32) + 0.1,
+          }
+      },
+  }
+
+
+# ------------------------------------------------------------ core invariants
+
+
+class TestQuantizeCore:
+
+  def test_per_channel_scale_shapes(self):
+    qt = quant_lib.quantize_params(_sample_tree(), 'int8')
+    dense = qt['params']['Dense_0']['kernel']
+    conv = qt['params']['Conv_0']['kernel']
+    assert isinstance(dense, quant_lib.QuantizedTensor)
+    assert dense.qvalue.dtype == np.int8
+    assert dense.qvalue.shape == (16, 8)
+    assert dense.scale.shape == (1, 8)  # per-OUTPUT-channel
+    assert conv.qvalue.shape == (3, 3, 4, 8)
+    assert conv.scale.shape == (1, 1, 1, 8)
+    assert conv.scale.dtype == np.float32
+
+  def test_skip_list_leaves_untouched(self):
+    tree = _sample_tree()
+    qt = quant_lib.quantize_params(tree, 'int8')
+    # Biases, norm scales and BN statistics pass through as the SAME
+    # host arrays — full precision, zero copies.
+    assert qt['params']['Dense_0']['bias'] is tree['params']['Dense_0']['bias']
+    assert (qt['params']['BatchNorm_0']['scale']
+            is tree['params']['BatchNorm_0']['scale'])
+    assert (qt['batch_stats']['BatchNorm_0']['mean']
+            is tree['batch_stats']['BatchNorm_0']['mean'])
+    assert quant_lib.quantized_leaf_count(qt) == 2  # the two kernels
+
+  def test_skip_patterns_extend_the_list(self):
+    tree = _sample_tree()
+    qt = quant_lib.quantize_params(tree, 'int8',
+                                   skip_patterns=('Conv_0',))
+    assert (qt['params']['Conv_0']['kernel']
+            is tree['params']['Conv_0']['kernel'])
+    assert isinstance(qt['params']['Dense_0']['kernel'],
+                      quant_lib.QuantizedTensor)
+
+  def test_roundtrip_error_bounded_by_half_step(self):
+    tree = _sample_tree()
+    w = tree['params']['Dense_0']['kernel']
+    qt = quant_lib.quantize_array(w, 'int8')
+    deq = quant_lib.dequantize_array(qt)
+    # Symmetric rounding: per-channel error <= scale/2 (+ f32 noise).
+    bound = qt.scale / 2.0 + 1e-6
+    assert np.all(np.abs(deq - w) <= bound)
+
+  def test_dead_channel_dequantizes_to_exact_zero(self):
+    w = np.zeros((4, 3), np.float32)
+    w[:, 0] = np.linspace(-1, 1, 4)
+    qt = quant_lib.quantize_array(w, 'int8')
+    deq = quant_lib.dequantize_array(qt)
+    np.testing.assert_array_equal(deq[:, 1:], 0.0)
+    assert qt.scale[0, 1] == 1.0  # no divide-by-zero scale
+
+  def test_traced_dequantize_matches_host(self):
+    import jax
+
+    tree = _sample_tree()
+    qt = quant_lib.quantize_params(tree, 'int8')
+    host = quant_lib.dequantize_params(qt)
+    traced = jax.jit(quant_lib.dequantize_params)(qt)
+    np.testing.assert_allclose(
+        np.asarray(traced['params']['Dense_0']['kernel']),
+        host['params']['Dense_0']['kernel'], rtol=1e-6)
+
+  def test_unknown_mode_rejected(self):
+    with pytest.raises(ValueError, match='unknown quantization mode'):
+      quant_lib.quantize_params(_sample_tree(), 'int4')
+    with pytest.raises(ValueError):
+      batching_lib.DynamicBatcher(predictor=None, quantize='int4')
+
+  @pytest.mark.skipif(not quant_lib.fp8_supported(),
+                      reason='jaxlib without float8_e4m3fn')
+  def test_fp8_roundtrip(self):
+    import jax.numpy as jnp
+
+    w = _sample_tree()['params']['Dense_0']['kernel']
+    qt = quant_lib.quantize_array(w, 'fp8')
+    assert qt.qvalue.dtype == jnp.float8_e4m3fn
+    deq = quant_lib.dequantize_array(qt)
+    # e4m3: 3 mantissa bits => worst relative step 2^-3 at the bin edge.
+    amax = np.max(np.abs(w), axis=0)
+    assert np.all(np.abs(deq - w) <= 0.125 * amax[None, :] + 1e-6)
+
+
+# --------------------------------------------------------------- byte budget
+
+
+def test_int8_bytes_beat_f32_and_bf16_on_bench_model():
+  """The compression claim on the BENCH model (2048-hidden mock, the
+  weight-streaming-bound configuration bench.py serves)."""
+  import jax.numpy as jnp
+
+  predictor = _loaded_mock_predictor(hidden_size=2048)
+  serving = predictor.stateless_serving_fn()
+  qserving = predictor.stateless_serving_fn(quantize='int8')
+  f32_bytes = quant_lib.param_bytes(serving.params)
+  bf16_bytes = quant_lib.cast_tree_bytes(serving.params, jnp.bfloat16)
+  int8_bytes = quant_lib.param_bytes(qserving.params)
+  assert int8_bytes <= 0.27 * f32_bytes, (int8_bytes, f32_bytes)
+  assert int8_bytes <= 0.52 * bf16_bytes, (int8_bytes, bf16_bytes)
+
+
+# ----------------------------------------------------------- parity + gating
+
+
+class TestParityGate:
+
+  def test_mock_model_parity_within_band(self):
+    predictor = _loaded_mock_predictor()
+    full = predictor.stateless_serving_fn()
+    quant = predictor.stateless_serving_fn(quantize='int8')
+    assert quant.program_key == ('quant', 'int8', full.program_key)
+    assert quant.version == full.version
+    report = quant_lib.check_parity(full, quant, atol=0.05, rtol=0.05)
+    assert report.ok, report.describe()
+    assert report.max_abs_err < 0.05
+    assert 'a_predicted' in report.per_output
+
+  def test_qtopt_parity_within_band(self):
+    """The grasping critic (small conv config): int8 Q-values inside
+    the declared band of the full-precision serving fn."""
+    from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
+
+    model = GraspingModelWrapper(
+        device_type='cpu', input_shape=(96, 112, 3), target_shape=(80, 80),
+        num_convs=(2, 2, 1))
+    predictor = CheckpointPredictor(model, model_dir='/nonexistent')
+    predictor.init_randomly()
+    full = predictor.stateless_serving_fn()
+    quant = predictor.stateless_serving_fn(quantize='int8')
+    report = quant_lib.check_parity(
+        full, quant, atol=0.05, rtol=0.05,
+        calibration_batches=1, calibration_batch_size=2)
+    assert report.ok, report.describe()
+    assert 'q_predicted' in report.per_output
+
+  def test_band_violation_rejects_and_serves_full_precision(self):
+    """The fallback drill: an impossible band (atol=rtol=0) must refuse
+    the quantized generation — the plane serves full precision, counts
+    the reject, and answers bit-matched to predict()."""
+    predictor = _loaded_mock_predictor()
+    rejects = metrics_lib.counter('serving/quant_parity_rejects')
+    r0 = rejects.value
+    with batching_lib.DynamicBatcher(
+        predictor, max_batch=4, batch_deadline_ms=1.0, quantize='int8',
+        quant_parity_atol=0.0, quant_parity_rtol=0.0) as batcher:
+      out = batcher.submit(_features(0.4, n=2)).result(30.0)
+      want = predictor.predict(_features(0.4, n=2))
+      np.testing.assert_allclose(out['a_predicted'], want['a_predicted'],
+                                 rtol=2e-5)
+      report = batcher.report()
+    assert rejects.value == r0 + 1
+    assert report['quantize'] == 'int8'
+    assert report['quantized_active'] is False
+    assert report['quant_parity_rejects'] >= 1
+    # The gauge reflects the FULL-precision tree actually being served.
+    assert report['param_bytes'] == report['quant_param_bytes_full']
+
+  def test_quantized_batcher_within_band_end_to_end(self):
+    predictor = _loaded_mock_predictor()
+    with batching_lib.DynamicBatcher(
+        predictor, max_batch=8, batch_deadline_ms=1.0,
+        quantize='int8') as batcher:
+      out = batcher.submit(_features(0.2, n=3)).result(30.0)
+      want = predictor.predict(_features(0.2, n=3))
+      # Within the serving band, NOT bit-equal (that's the point).
+      np.testing.assert_allclose(out['a_predicted'], want['a_predicted'],
+                                 atol=0.05)
+      report = batcher.report()
+    assert report['quantized_active'] is True
+    assert 0 < report['param_bytes'] < report['quant_param_bytes_full']
+    assert 0.0 < report['quant_param_bytes_ratio'] < 0.45
+    assert report['quant_parity_max_abs_err'] < 0.05
+
+  def test_statz_reports_quantization_block_over_http(self):
+    """Acceptance: ``serving/param_bytes`` + the quant block ride the
+    HTTP ``/statz`` endpoint (the same document /metricsz embeds)."""
+    import json
+    import urllib.request
+
+    from tensor2robot_tpu.serving import server as server_lib
+
+    predictor = _loaded_mock_predictor()
+    rejects0 = metrics_lib.counter('serving/quant_parity_rejects').value
+    with server_lib.ServingServer(
+        predictor, max_batch=4, batch_deadline_ms=1.0,
+        quantize='int8') as server:
+      with urllib.request.urlopen(server.url + '/statz', timeout=30) as r:
+        statz = json.loads(r.read())
+    assert statz['quantize'] == 'int8'
+    assert statz['quantized_active'] is True
+    assert 0 < statz['param_bytes'] < statz['quant_param_bytes_full']
+    assert 0.0 < statz['quant_param_bytes_ratio'] < 0.45
+    # Counter is process-global: this server added no rejects.
+    assert statz['quant_parity_rejects'] == rejects0
+
+  @pytest.mark.skipif(not quant_lib.fp8_supported(),
+                      reason='jaxlib without float8_e4m3fn')
+  def test_fp8_serving_within_loosened_band(self):
+    predictor = _loaded_mock_predictor()
+    with batching_lib.DynamicBatcher(
+        predictor, max_batch=4, batch_deadline_ms=1.0, quantize='fp8',
+        quant_parity_atol=0.2, quant_parity_rtol=0.2) as batcher:
+      out = batcher.submit(_features(0.3)).result(30.0)
+      want = predictor.predict(_features(0.3))
+      np.testing.assert_allclose(out['a_predicted'], want['a_predicted'],
+                                 atol=0.2)
+      assert batcher.report()['quantized_active'] is True
+
+
+# ------------------------------------------- executor cache + zero recompiles
+
+
+def test_zero_recompiles_quantized_client_sweep():
+  """The PR-6 zero-recompile guarantee holds with the quantized
+  executor: warm all buckets, vary concurrency 1 -> 12 -> 5 -> 1, the
+  compile counter stays EXACTLY at warmup."""
+  predictor = _loaded_mock_predictor()
+  compiles = metrics_lib.counter('serving/bucket_compiles')
+  with batching_lib.DynamicBatcher(
+      predictor, max_batch=16, batch_deadline_ms=0.5,
+      quantize='int8') as batcher:
+    assert batcher.report()['quantized_active'] is True
+    warm = compiles.value
+    submit = loadgen.inproc_submit_fn(batcher, timeout=30.0)
+    for clients in (1, 12, 5, 1):
+      report = loadgen.run_load(
+          submit, lambda i: _features(0.01 * (i + 1)),
+          num_clients=clients, requests_per_client=8, warmup_requests=0)
+      assert report.errors == 0, report
+    assert compiles.value == warm  # ZERO recompiles after warmup
+
+
+def test_quantized_cache_keys_separate_precision_variants():
+  """Full-precision and quantized programs must never alias in the
+  executable cache; two quantized generations of the same program DO
+  share it (the weights-only hot-swap case)."""
+  predictor = _loaded_mock_predictor()
+  buckets = (1, 2)
+  full = predictor.stateless_serving_fn()
+  quant_a = predictor.stateless_serving_fn(quantize='int8')
+  executor = batching_lib.JitBucketExecutor(quant_a, buckets)
+  executor.warm()
+  # Same program + same (quantized) param shapes -> cache handed over.
+  quant_b = quant_lib.quantize_serving_fn(full, mode='int8')
+  assert executor.compatible_cache(quant_b)
+  # Full-precision program: different key, no cache.
+  assert executor.compatible_cache(full) is None
+
+
+def test_hot_swap_under_load_with_quantization(tmp_path):
+  """Sustained 4-client load + a new export with quantization ON:
+  zero dropped requests, the swap lands, and the weights-only swap
+  re-quantizes WITHOUT recompiling any bucket (cache hit pinned)."""
+  model = MockT2RModel(device_type='tpu')
+  config = TrainerConfig(
+      model_dir=str(tmp_path / 'm'), max_train_steps=5,
+      save_interval_steps=5, eval_interval_steps=0, log_interval_steps=0,
+      async_checkpoints=False)
+  trainer = Trainer(model, config)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+
+  from tensor2robot_tpu.predictors import ExportedModelPredictor
+
+  root = str(tmp_path / 'export')
+  exporter = export_lib.ModelExporter()
+  exporter.export(model, trainer.state, root, version=1)
+  predictor = ExportedModelPredictor(root)
+  assert predictor.restore()
+
+  compiles = metrics_lib.counter('serving/bucket_compiles')
+  swaps = metrics_lib.counter('serving/model_swaps')
+  swaps0 = swaps.value
+  with batching_lib.DynamicBatcher(
+      predictor, max_batch=8, batch_deadline_ms=1.0,
+      reload_interval_secs=0.05, quantize='int8') as batcher:
+    assert batcher.model_version == 5
+    assert batcher.report()['quantized_active'] is True
+    warm = compiles.value
+    result = {}
+
+    def load():
+      result['report'] = loadgen.run_load(
+          loadgen.inproc_submit_fn(batcher, timeout=30.0),
+          lambda i: _features(0.01 * (i + 1)),
+          num_clients=4, duration_secs=3.0)
+
+    thread = threading.Thread(target=load, daemon=True)
+    thread.start()
+    time.sleep(0.4)  # traffic flowing against v1
+    exporter.export(
+        model, trainer.state.replace(step=trainer.state.step + 100),
+        root, version=2)
+    deadline = time.time() + 10.0
+    while batcher.model_version != 105 and time.time() < deadline:
+      time.sleep(0.05)
+    assert batcher.model_version == 105  # swapped while under load
+    thread.join(timeout=30.0)
+    report = result['report']
+    assert report.errors == 0, report  # zero dropped/failed requests
+    assert swaps.value >= swaps0 + 1
+    # Weights-only swap under the SAME quant program: every bucket
+    # executable was inherited — no compile escaped the warmup.
+    assert compiles.value == warm
+    assert batcher.report()['quantized_active'] is True
+
+
+def test_callable_predictor_ignores_quantize_mode():
+  """Predictors without a stateless jax core degrade to whole-batch
+  predict() regardless of the quantize knob — no crash, no gate."""
+  from tensor2robot_tpu.predictors import AbstractPredictor
+  from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+  class _Callable(AbstractPredictor):
+
+    def predict(self, features):
+      return {'doubled': np.asarray(features['x']) * 2.0}
+
+    def get_feature_specification(self):
+      spec = SpecStruct()
+      spec['x'] = TensorSpec(shape=(2,), dtype=np.float32, name='x')
+      return spec
+
+    def restore(self):
+      return True
+
+    @property
+    def is_loaded(self):
+      return True
+
+    @property
+    def global_step(self):
+      return 1
+
+  with batching_lib.DynamicBatcher(
+      _Callable(), max_batch=4, batch_deadline_ms=1.0,
+      quantize='int8') as batcher:
+    out = batcher.submit({'x': np.full((1, 2), 3.0, np.float32)})
+    np.testing.assert_array_equal(out.result(10.0)['doubled'],
+                                  [[6.0, 6.0]])

@@ -313,6 +313,31 @@ class TestHotSwap:
       assert out['a_predicted'].shape == (1,)
 
 
+def test_program_key_stable_across_weights_only_exports(tmp_path):
+  """Two export versions of the same model are the same PROGRAM: the
+  canonical fingerprint (loc-stripped StableHLO — raw artifact bytes
+  embed drifting MLIR debug locations) must match, so the bucketed
+  executor's compiled cache survives a weights-only hot swap."""
+  trainer, model = _trained_trainer(tmp_path, steps=2)
+  root = str(tmp_path / 'export')
+  exporter = export_lib.ModelExporter()
+  exporter.export(model, trainer.state, root, version=1)
+  predictor = ExportedModelPredictor(root)
+  assert predictor.restore()
+  serving_v1 = predictor.stateless_serving_fn()
+  exporter.export(
+      model, trainer.state.replace(step=trainer.state.step + 7),
+      root, version=2)
+  assert predictor.restore()
+  serving_v2 = predictor.stateless_serving_fn()
+  assert serving_v2.version == serving_v1.version + 7
+  assert serving_v1.program_key == serving_v2.program_key
+  assert serving_v1.params is not serving_v2.params
+  executor = batching_lib.JitBucketExecutor(serving_v1, (1, 2))
+  executor.warm()
+  assert executor.compatible_cache(serving_v2)
+
+
 # ------------------------------------------------ reload/predict race guard
 
 
