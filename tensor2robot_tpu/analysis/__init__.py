@@ -1,0 +1,30 @@
+"""AST-based static-analysis suite (stdlib-only, zero runtime cost).
+
+Four rule families gate tier-1 through ``tools/analyze.py`` and
+``tests/test_static_analysis.py``:
+
+* ``lock-discipline`` — ``# GUARDED_BY(lock)`` / ``# HOLDS(lock)``
+  annotations on shared state + lock-ordering cycle detection.
+* ``jit-hazard`` — host side effects / tracer leaks / raw numpy / rng
+  key reuse inside jit-traced functions.
+* ``recompile-hazard`` — unstable jit arguments and weak-keyed
+  executor caches.
+* ``dead-code`` — unused imports, locals, private globals.
+
+Waivers are inline ``# ANALYSIS_OK(<rule>): <reason>`` — the reason is
+mandatory. See README "Static analysis" for the workflow.
+"""
+
+from tensor2robot_tpu.analysis.core import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    ModuleInfo,
+    Program,
+    baseline_key,
+    build_program,
+    findings_to_baseline,
+    load_baseline,
+    load_module,
+    load_source,
+    run_checkers,
+)

@@ -1,0 +1,363 @@
+"""Shared machinery for the static-analysis suite.
+
+The suite is the correctness-tooling analogue of ``observability/``:
+pure stdlib (``ast`` + ``tokenize``), importable on any host, zero
+runtime cost — it reads source, never executes it. Every checker is a
+function ``check(module: ModuleInfo, program: Program) -> [Finding]``;
+this module owns everything the checkers share:
+
+* :class:`ModuleInfo` — one parsed source file: AST (parent-linked),
+  per-line comment map, and the three annotation kinds extracted from
+  comments (``GUARDED_BY``, ``HOLDS``, ``ANALYSIS_OK`` waivers).
+* :class:`Program` — the whole analyzed file set, so cross-module
+  resolution (imported classes, lock-ordering edges across files) has
+  one place to look things up.
+* Waiver semantics — ``# ANALYSIS_OK(<rule>): <reason>`` on the finding
+  line or the line directly above. The reason is REQUIRED: a bare
+  suppress is itself reported (rule ``waiver-discipline``).
+* Baseline io — ``analysis_baseline.json`` records the waived findings
+  (rule/check/path/symbol/reason, no line numbers so unrelated edits
+  don't churn it). The gate fails on any unwaived finding and on any
+  waived finding missing from the baseline, so the file can only shrink
+  (fixing code) or change under review (adding a waiver edits it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    'Finding', 'ModuleInfo', 'Program', 'load_module', 'load_source',
+    'build_program', 'run_checkers', 'load_baseline', 'baseline_key',
+    'findings_to_baseline', 'ALL_RULES',
+]
+
+# Rule families (each checker owns one; waivers may name the family or
+# 'family:check' for a specific sub-rule).
+ALL_RULES = ('lock-discipline', 'jit-hazard', 'recompile-hazard',
+             'dead-code', 'waiver-discipline')
+
+_GUARDED_BY_RE = re.compile(r'GUARDED_BY\(\s*([^)]+?)\s*\)')
+_HOLDS_RE = re.compile(r'HOLDS\(\s*([^)]+?)\s*\)')
+_ANALYSIS_OK_RE = re.compile(r'ANALYSIS_OK\(\s*([^)]+?)\s*\)\s*:?\s*(.*)')
+
+
+@dataclasses.dataclass
+class Finding:
+  """One checker hit. ``waived`` findings don't fail the gate but must
+  appear in the baseline (with their inline justification)."""
+
+  rule: str            # family, e.g. 'lock-discipline'
+  check: str           # sub-rule, e.g. 'unguarded-read'
+  path: str            # repo-relative source path
+  line: int
+  message: str
+  symbol: str = ''     # qualified context, e.g. 'DynamicBatcher.submit'
+  waived: bool = False
+  waiver_reason: str = ''
+
+  def location(self) -> str:
+    return f'{self.path}:{self.line}'
+
+  def as_dict(self) -> dict:
+    return dataclasses.asdict(self)
+
+
+def baseline_key(finding: Finding) -> Tuple[str, str, str, str]:
+  """Line-number-free identity used by the baseline (stable across
+  unrelated edits to the same file)."""
+  return (finding.rule, finding.check, finding.path, finding.symbol)
+
+
+class ModuleInfo:
+  """One parsed module: AST + comments + annotations."""
+
+  def __init__(self, path: str, rel_path: str, source: str):
+    self.path = path
+    self.rel_path = rel_path
+    self.source = source
+    self.lines = source.split('\n')
+    self.tree = ast.parse(source, filename=path)
+    # Parent links: checkers need lexical context (enclosing class/def).
+    for node in ast.walk(self.tree):
+      for child in ast.iter_child_nodes(node):
+        child._t2r_parent = node  # type: ignore[attr-defined]
+    # Dotted module name relative to the package root, used for
+    # canonical lock/function ids ('serving.batching', 'tools.analyze').
+    self.name = _module_name(rel_path)
+    self.comments: Dict[int, str] = {}
+    for tok in _safe_tokens(source):
+      if tok.type == tokenize.COMMENT:
+        self.comments[tok.start[0]] = tok.string
+    # line -> [(rule, reason)]
+    self.waivers: Dict[int, List[Tuple[str, str]]] = {}
+    # line -> [lock expression text]
+    self.guarded_by: Dict[int, List[str]] = {}
+    self.holds: Dict[int, List[str]] = {}
+    for line, comment in self.comments.items():
+      for match in _GUARDED_BY_RE.finditer(comment):
+        self.guarded_by.setdefault(line, []).append(match.group(1).strip())
+      for match in _HOLDS_RE.finditer(comment):
+        self.holds.setdefault(line, []).append(match.group(1).strip())
+      match = _ANALYSIS_OK_RE.search(comment)
+      if match:
+        self.waivers.setdefault(line, []).append(
+            (match.group(1).strip(), match.group(2).strip()))
+
+  def parent(self, node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, '_t2r_parent', None)
+
+  def enclosing(self, node: ast.AST, kinds) -> Optional[ast.AST]:
+    cur = self.parent(node)
+    while cur is not None and not isinstance(cur, kinds):
+      cur = self.parent(cur)
+    return cur
+
+  def is_comment_line(self, line: int) -> bool:
+    """True when ``line`` holds ONLY a comment (no code) — the form an
+    annotation may take when it won't fit inline."""
+    if not 1 <= line <= len(self.lines):
+      return False
+    return self.lines[line - 1].lstrip().startswith('#')
+
+  def waiver_for(self, rule: str, check: str,
+                 line: int) -> Optional[Tuple[str, str]]:
+    """The (rule, reason) waiver covering ``rule``/``check`` at ``line``
+    — same line, or a pure-comment line directly above (both count as
+    inline; an annotation attached to ANOTHER statement never bleeds)."""
+    candidates = [line]
+    # Walk up through a contiguous pure-comment block: a waiver wrapped
+    # over several comment lines still counts as inline.
+    cand = line - 1
+    while self.is_comment_line(cand):
+      candidates.append(cand)
+      cand -= 1
+    for cand in candidates:
+      for waived_rule, reason in self.waivers.get(cand, ()):
+        if waived_rule in (rule, f'{rule}:{check}', check, '*'):
+          return waived_rule, reason
+    return None
+
+
+def _module_name(rel_path: str) -> str:
+  name = rel_path[:-3] if rel_path.endswith('.py') else rel_path
+  parts = [p for p in name.replace(os.sep, '/').split('/') if p]
+  if parts and parts[0] == 'tensor2robot_tpu':
+    parts = parts[1:]
+  if parts and parts[-1] == '__init__':
+    parts = parts[:-1] or ['__init__']
+  return '.'.join(parts) or '<module>'
+
+
+def _safe_tokens(source: str):
+  try:
+    yield from tokenize.generate_tokens(io.StringIO(source).readline)
+  except (tokenize.TokenError, IndentationError):
+    return
+
+
+class Program:
+  """The analyzed file set + cross-module lookup tables."""
+
+  def __init__(self, modules: List[ModuleInfo]):
+    self.modules = modules
+    self.by_name: Dict[str, ModuleInfo] = {m.name: m for m in modules}
+    self.by_rel_path: Dict[str, ModuleInfo] = {
+        m.rel_path: m for m in modules}
+    # 'modname.ClassName' -> ast.ClassDef, for imported-class resolution.
+    self.classes: Dict[str, ast.ClassDef] = {}
+    for mod in modules:
+      for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+          self.classes[f'{mod.name}.{node.name}'] = node
+
+
+def load_source(source: str, rel_path: str = '<memory>.py') -> ModuleInfo:
+  """Builds a ModuleInfo from an in-memory snippet (fixture tests)."""
+  return ModuleInfo(rel_path, rel_path, source)
+
+
+def load_module(path: str, root: str) -> Optional[ModuleInfo]:
+  rel = os.path.relpath(path, root)
+  try:
+    with open(path, encoding='utf-8') as f:
+      source = f.read()
+    return ModuleInfo(path, rel, source)
+  except (OSError, SyntaxError, ValueError):
+    return None
+
+
+def iter_python_files(paths: Iterable[str], root: str) -> List[str]:
+  out = []
+  for p in paths:
+    full = p if os.path.isabs(p) else os.path.join(root, p)
+    if os.path.isdir(full):
+      for dirpath, dirnames, filenames in os.walk(full):
+        dirnames[:] = [d for d in dirnames if d != '__pycache__']
+        for fn in sorted(filenames):
+          if fn.endswith('.py'):
+            out.append(os.path.join(dirpath, fn))
+    elif full.endswith('.py') and os.path.exists(full):
+      out.append(full)
+  return sorted(set(out))
+
+
+def build_program(paths: Iterable[str], root: str) -> Program:
+  modules = []
+  for path in iter_python_files(paths, root):
+    mod = load_module(path, root)
+    if mod is not None:
+      modules.append(mod)
+  return Program(modules)
+
+
+def apply_waivers(module: ModuleInfo,
+                  findings: List[Finding]) -> List[Finding]:
+  """Marks findings covered by an inline ANALYSIS_OK; reports empty
+  justifications as their own finding (a bare suppress never passes)."""
+  out = []
+  for finding in findings:
+    waiver = module.waiver_for(finding.rule, finding.check, finding.line)
+    if waiver is not None:
+      rule, reason = waiver
+      if not reason:
+        out.append(Finding(
+            rule='waiver-discipline', check='missing-justification',
+            path=finding.path, line=finding.line,
+            symbol=finding.symbol,
+            message=(f'ANALYSIS_OK({rule}) has no justification; waivers '
+                     'must say WHY the access is safe')))
+      finding.waived = True
+      finding.waiver_reason = reason
+    out.append(finding)
+  return out
+
+
+def run_checkers(program: Program, checkers=None) -> List[Finding]:
+  """Runs every checker over every module + the program-level passes."""
+  from tensor2robot_tpu.analysis import dead_code
+  from tensor2robot_tpu.analysis import jit_hazards
+  from tensor2robot_tpu.analysis import lock_discipline
+  from tensor2robot_tpu.analysis import recompile_hazards
+
+  if checkers is None:
+    checkers = (lock_discipline.check, jit_hazards.check,
+                recompile_hazards.check, dead_code.check)
+  findings: List[Finding] = []
+  for module in program.modules:
+    for checker in checkers:
+      findings.extend(apply_waivers(module, checker(module, program)))
+  if checkers and any(c.__module__.endswith('lock_discipline')
+                      for c in checkers):
+    ordering = lock_discipline.check_lock_ordering(program)
+    by_path = program.by_rel_path
+    for finding in ordering:
+      mod = by_path.get(finding.path)
+      if mod is not None:
+        findings.extend(apply_waivers(mod, [finding]))
+      else:
+        findings.append(finding)
+  findings.sort(key=lambda f: (f.path, f.line, f.rule, f.check))
+  deduped: List[Finding] = []
+  seen = set()
+  for f in findings:
+    key = (f.rule, f.check, f.path, f.line, f.symbol, f.message)
+    if key not in seen:
+      seen.add(key)
+      deduped.append(f)
+  return deduped
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str, str], dict]:
+  if not os.path.exists(path):
+    return {}
+  with open(path, encoding='utf-8') as f:
+    data = json.load(f)
+  out = {}
+  for entry in data.get('waived_findings', []):
+    key = (entry['rule'], entry['check'], entry['path'],
+           entry.get('symbol', ''))
+    out[key] = entry
+  return out
+
+
+def findings_to_baseline(findings: List[Finding]) -> dict:
+  entries = {}
+  for f in findings:
+    if not f.waived:
+      continue
+    key = baseline_key(f)
+    entries[key] = {
+        'rule': f.rule, 'check': f.check, 'path': f.path,
+        'symbol': f.symbol, 'reason': f.waiver_reason,
+    }
+  return {
+      'comment': (
+          'Waived static-analysis findings (tools/analyze.py). Every '
+          'entry has an inline ANALYSIS_OK justification at the finding '
+          'site; this file may only shrink, or change under review when '
+          'a new waiver is added.'),
+      'waived_findings': [entries[k] for k in sorted(entries)],
+  }
+
+
+# ------------------------------------------------------- shared AST helpers
+
+
+def expr_text(node: ast.AST) -> Optional[str]:
+  """'self._lock' / '_LOCK' / 'a.b.c' for Name/Attribute chains."""
+  if isinstance(node, ast.Name):
+    return node.id
+  if isinstance(node, ast.Attribute):
+    base = expr_text(node.value)
+    return None if base is None else f'{base}.{node.attr}'
+  return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+  return expr_text(node.func)
+
+
+def walk_scope(root: ast.AST):
+  """Like ``ast.walk`` but does NOT descend into nested function
+  definitions or lambdas (they are separate scopes, analyzed on their
+  own; ``ast.walk`` cannot prune). The nested def node itself is still
+  yielded so callers can see it."""
+  stack = [root]
+  while stack:
+    node = stack.pop()
+    yield node
+    for child in ast.iter_child_nodes(node):
+      if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+        yield child
+        continue
+      stack.append(child)
+
+
+def func_defs(tree: ast.AST):
+  for node in ast.walk(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      yield node
+
+
+def qualname(module: ModuleInfo, node: ast.AST) -> str:
+  """Dotted lexical path of a def/class within its module."""
+  parts = []
+  cur: Optional[ast.AST] = node
+  while cur is not None and not isinstance(cur, ast.Module):
+    if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)):
+      parts.append(cur.name)
+    cur = module.parent(cur)
+  return '.'.join(reversed(parts))

@@ -1,0 +1,152 @@
+"""Dead-code checker: unused imports and unused bindings.
+
+The trivial fourth checker that keeps the tree honest between PRs:
+
+* ``unused-import`` — a name bound by ``import``/``from ... import``
+  and never referenced in the module. ``__init__.py`` files follow the
+  re-export convention: imports there count as intentional exports
+  unless the file declares ``__all__`` (then membership decides).
+* ``unused-local`` — a function-local ``name = <pure expr>`` never read
+  afterwards (anywhere in the function, nested defs included). Only
+  side-effect-free right-hand sides are flagged, so ``_ = fn()`` idioms
+  and deliberate drains never fire; underscore-prefixed names are
+  exempt by convention.
+* ``unused-private-global`` — a module-level ``_NAME = <pure expr>``
+  (constants only, not defs/classes) never referenced in its module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'dead-code'
+
+_PURE_NODES = (ast.Constant, ast.Name, ast.Attribute, ast.Tuple, ast.List,
+               ast.Dict, ast.Set, ast.BinOp, ast.UnaryOp, ast.Compare,
+               ast.BoolOp, ast.IfExp, ast.JoinedStr, ast.FormattedValue)
+
+
+def _is_pure(node: ast.AST) -> bool:
+  # Non-expression helper nodes (Load/Store ctx, operators) are inert;
+  # only expression kinds decide purity.
+  return all(isinstance(sub, _PURE_NODES) or not isinstance(sub, ast.expr)
+             for sub in ast.walk(node))
+
+
+def _used_names(tree: ast.AST) -> Set[str]:
+  used: Set[str] = set()
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+      used.add(node.id)
+    elif isinstance(node, ast.Attribute):
+      text = core.expr_text(node)
+      if text:
+        used.add(text.split('.', 1)[0])
+  return used
+
+
+def _declared_all(tree: ast.Module) -> Tuple[bool, Set[str]]:
+  for node in tree.body:
+    if isinstance(node, ast.Assign):
+      for target in node.targets:
+        if isinstance(target, ast.Name) and target.id == '__all__':
+          names = set()
+          if isinstance(node.value, (ast.List, ast.Tuple)):
+            for elt in node.value.elts:
+              if isinstance(elt, ast.Constant) and isinstance(
+                  elt.value, str):
+                names.add(elt.value)
+          return True, names
+  return False, set()
+
+
+def _inside_classdef(module: core.ModuleInfo, node: ast.AST,
+                     fn: ast.AST) -> bool:
+  """True when ``node`` sits in a ClassDef nested inside ``fn`` —
+  class attributes are API surface, not function locals."""
+  cur = module.parent(node)
+  while cur is not None and cur is not fn:
+    if isinstance(cur, ast.ClassDef):
+      return True
+    cur = module.parent(cur)
+  return False
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+  tree = module.tree
+  used = _used_names(tree)
+  has_all, all_names = _declared_all(tree)
+  is_package_init = module.rel_path.endswith('__init__.py')
+
+  # ---------------------------------------------------------- imports
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Import):
+      bindings = [(alias.asname or alias.name.split('.')[0],
+                   alias.name) for alias in node.names]
+    elif isinstance(node, ast.ImportFrom):
+      if node.module == '__future__':
+        continue
+      bindings = [(alias.asname or alias.name, alias.name)
+                  for alias in node.names if alias.name != '*']
+    else:
+      continue
+    for bound, original in bindings:
+      if bound in used or bound in all_names:
+        continue
+      if is_package_init and not has_all:
+        continue  # re-export convention
+      findings.append(core.Finding(
+          rule=RULE, check='unused-import', path=module.rel_path,
+          line=node.lineno, symbol=bound,
+          message=f'import {original!r} (as {bound!r}) is never used'))
+
+  # ---------------------------------------------------- private globals
+  for node in tree.body:
+    if not isinstance(node, ast.Assign) or node.value is None:
+      continue
+    if not _is_pure(node.value):
+      continue
+    for target in node.targets:
+      if (isinstance(target, ast.Name) and target.id.startswith('_') and
+          not target.id.startswith('__') and target.id not in used and
+          target.id not in all_names):
+        findings.append(core.Finding(
+            rule=RULE, check='unused-private-global',
+            path=module.rel_path, line=node.lineno, symbol=target.id,
+            message=f'private module global {target.id!r} is never read'))
+
+  # ----------------------------------------------------------- locals
+  for fn in core.func_defs(tree):
+    reads: Set[str] = set()
+    global_decl: Set[str] = set()
+    stores: Dict[str, List[ast.Assign]] = {}
+    for node in ast.walk(fn):
+      if isinstance(node, (ast.Global, ast.Nonlocal)):
+        global_decl.update(node.names)
+      elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+        reads.add(node.id)
+      elif isinstance(node, ast.Assign):
+        if (len(node.targets) == 1 and
+            isinstance(node.targets[0], ast.Name) and
+            _is_pure(node.value) and
+            not _inside_classdef(module, node, fn)):
+          stores.setdefault(node.targets[0].id, []).append(node)
+    for name, nodes in stores.items():
+      if (name in reads or name in global_decl or
+          name.startswith('_') or name == 'self'):
+        continue
+      # Augmented or multiple-assignment names may feed later passes;
+      # only a name NEVER loaded in the whole def is dead.
+      for node in nodes:
+        findings.append(core.Finding(
+            rule=RULE, check='unused-local', path=module.rel_path,
+            line=node.lineno, symbol=f'{core.qualname(module, fn)}.{name}',
+            message=(f'local {name!r} is assigned a side-effect-free '
+                     'value and never read')))
+  return findings

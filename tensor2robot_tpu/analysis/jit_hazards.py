@@ -1,0 +1,333 @@
+"""Jit tracing-hazard checker.
+
+Finds functions reachable from ``jax.jit`` / ``pjit`` / ``lax.scan``
+(also ``while_loop``/``cond``/``fori_loop``) call sites — including
+factory methods whose RETURN is jitted (``jax.jit(self._step_body())``
+marks ``_step_body`` and every def nested in it) — and flags host-world
+operations that either silently freeze at trace time or crash under a
+tracer:
+
+* ``host-side-effect`` — registry counter/gauge/histogram calls,
+  ``time.*``, ``logging.*``, ``print``/``open``, ``os``/``io``/``sys``
+  calls, tracing spans. Inside a traced function these run ONCE at
+  trace time (metrics silently stop counting — the PR-2 failure shape)
+  or not at all on retrace.
+* ``tracer-leak`` — ``float()`` / ``int()`` / ``bool()`` / ``.item()``
+  / ``.tolist()`` on a tracer-typed name, and bare ``if tracer:`` tests
+  (TracerBoolConversionError at trace time). "Tracer-typed" is a
+  per-function taint: the traced function's parameters and anything
+  assigned from them.
+* ``numpy-on-tracer`` — raw ``np.*`` calls on traced values (XLA can't
+  stage them; they concretize or crash). Shape/dtype queries
+  (``np.shape``/``np.ndim``/``np.result_type``) are exempt.
+* ``rng-key-reuse`` — the same key name passed to two ``random.*``
+  consumers with no intervening ``split``/``fold_in`` rebinding
+  (branches are analyzed separately; loop bodies twice, so reuse
+  ACROSS iterations is caught).
+
+Resolution is per-module and name-based (bare names and
+``self._method`` only) — cross-module jit targets are out of scope by
+design; the checker must stay zero-false-positive enough to gate tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'jit-hazard'
+
+_TRACE_ENTRY_CALLS = {
+    'jax.jit', 'jax.pjit', 'jit', 'pjit',
+    'jax.lax.scan', 'lax.scan', 'jax.lax.while_loop', 'lax.while_loop',
+    'jax.lax.cond', 'lax.cond', 'jax.lax.fori_loop', 'lax.fori_loop',
+}
+_HOST_MODULE_ROOTS = {'time', 'logging', 'os', 'io', 'sys', 'shutil',
+                      'tracing', 'metrics', 'metrics_lib', 'tracing_lib'}
+_HOST_BUILTINS = {'print', 'open', 'input'}
+_METRIC_METHODS = {'inc', 'observe', 'set', 'add'}
+_NUMPY_EXEMPT = {'shape', 'ndim', 'result_type', 'dtype'}
+_RNG_NON_CONSUMERS = {'PRNGKey', 'key'}
+
+
+def _leaf(name: str) -> str:
+  return name.rsplit('.', 1)[-1]
+
+
+class _DefIndex:
+  """Name-based def lookup within one module."""
+
+  def __init__(self, module: core.ModuleInfo):
+    self.module = module
+    self.defs: List[ast.FunctionDef] = list(core.func_defs(module.tree))
+    self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for fn in self.defs:
+      self.by_name.setdefault(fn.name, []).append(fn)
+
+  def resolve(self, name: str, from_node: ast.AST
+              ) -> Optional[ast.FunctionDef]:
+    """Bare ``f`` or ``self.m`` -> a local def, nearest-scope first."""
+    if name.startswith('self.'):
+      name = name[5:]
+    if '.' in name:
+      return None
+    candidates = self.by_name.get(name)
+    if not candidates:
+      return None
+    if len(candidates) == 1:
+      return candidates[0]
+    # Prefer a candidate sharing an enclosing scope with the reference.
+    cur = self.module.parent(from_node)
+    while cur is not None:
+      for cand in candidates:
+        if self.module.parent(cand) is cur:
+          return cand
+      cur = self.module.parent(cur)
+    return candidates[0]
+
+
+def _jit_targets(module: core.ModuleInfo, index: _DefIndex
+                 ) -> Set[ast.FunctionDef]:
+  """Defs traced by jit/pjit/scan: direct args, factory returns,
+  decorated defs — plus everything nested inside any of them."""
+  roots: Set[ast.FunctionDef] = set()
+
+  def mark_expr(expr: ast.AST, site: ast.AST):
+    if isinstance(expr, ast.Lambda):
+      return  # lambda bodies are walked by their enclosing def's pass
+    text = core.expr_text(expr)
+    if text is not None:
+      target = index.resolve(text, site)
+      if target is not None:
+        roots.add(target)
+      return
+    if isinstance(expr, ast.Call):
+      # jax.jit(self._step_body()): the FACTORY's returned closure is
+      # traced — mark the factory; its nested defs follow below.
+      name = core.call_name(expr)
+      if name is not None:
+        target = index.resolve(name, site)
+        if target is not None:
+          roots.add(target)
+
+  for node in ast.walk(module.tree):
+    if isinstance(node, ast.Call):
+      name = core.call_name(node)
+      if name in _TRACE_ENTRY_CALLS and node.args:
+        mark_expr(node.args[0], node)
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      for dec in node.decorator_list:
+        dec_name = core.expr_text(dec)
+        if dec_name in _TRACE_ENTRY_CALLS:
+          roots.add(node)
+        elif isinstance(dec, ast.Call):
+          dname = core.call_name(dec)
+          if dname in _TRACE_ENTRY_CALLS:
+            roots.add(node)
+          elif dname in ('functools.partial', 'partial') and dec.args:
+            inner = core.expr_text(dec.args[0])
+            if inner in _TRACE_ENTRY_CALLS:
+              roots.add(node)
+
+  # Reachability: local calls from traced defs + nested defs.
+  reachable: Set[ast.FunctionDef] = set()
+  frontier = list(roots)
+  while frontier:
+    fn = frontier.pop()
+    if fn in reachable:
+      continue
+    reachable.add(fn)
+    for node in ast.walk(fn):
+      if (node is not fn and
+          isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        if node not in reachable:
+          frontier.append(node)
+      elif isinstance(node, ast.Call):
+        name = core.call_name(node)
+        if name is None:
+          continue
+        if name.startswith('self.') or '.' not in name:
+          target = index.resolve(name, node)
+          if target is not None and target not in reachable:
+            frontier.append(target)
+  return reachable
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Set[str]:
+  """Params + names assigned from them (two propagation passes)."""
+  args = fn.args
+  tainted: Set[str] = set()
+  for a in (list(args.posonlyargs) + list(args.args) +
+            list(args.kwonlyargs) +
+            ([args.vararg] if args.vararg else []) +
+            ([args.kwarg] if args.kwarg else [])):
+    if a.arg != 'self':
+      tainted.add(a.arg)
+  for _ in range(2):
+    for node in core.walk_scope(fn):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+          node is not fn):
+        continue
+      if isinstance(node, ast.Assign):
+        rhs_names = {n.id for n in ast.walk(node.value)
+                     if isinstance(n, ast.Name)}
+        if rhs_names & tainted:
+          for target in node.targets:
+            for n in ast.walk(target):
+              if isinstance(n, ast.Name):
+                tainted.add(n.id)
+  return tainted
+
+
+def _is_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+  return any(isinstance(n, ast.Name) and n.id in tainted
+             for n in ast.walk(expr))
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  index = _DefIndex(module)
+  reachable = _jit_targets(module, index)
+  findings: List[core.Finding] = []
+  for fn in sorted(reachable, key=lambda f: f.lineno):
+    symbol = core.qualname(module, fn)
+    tainted = _tainted_names(fn)
+
+    def flag(check: str, node: ast.AST, message: str, symbol=symbol):
+      findings.append(core.Finding(
+          rule=RULE, check=check, path=module.rel_path,
+          line=node.lineno, symbol=symbol, message=message))
+
+    for node in core.walk_scope(fn):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+          node is not fn):
+        continue  # nested defs are themselves in `reachable`
+      if isinstance(node, ast.Call):
+        name = core.call_name(node)
+        if name is None:
+          continue
+        root = name.split('.', 1)[0]
+        receiver = name.rpartition('.')[0]
+        leaf = _leaf(name)
+        if (root in _HOST_MODULE_ROOTS or name in _HOST_BUILTINS or
+            ('._m_' in f'.{name}' and leaf in _METRIC_METHODS)):
+          flag('host-side-effect', node,
+               f'host side effect {name}(...) inside a jit-traced '
+               f'function: runs once at trace time, never per step')
+        elif name in ('float', 'int', 'bool') and node.args and _is_tainted(
+            node.args[0], tainted):
+          flag('tracer-leak', node,
+               f'{name}() on a traced value forces host concretization '
+               '(TracerConversionError under jit)')
+        elif (leaf in ('item', 'tolist') and
+              isinstance(node.func, ast.Attribute) and
+              _is_tainted(node.func.value, tainted)):
+          flag('tracer-leak', node,
+               f'.{leaf}() on a traced value forces host concretization')
+        elif root in ('np', 'numpy') and leaf not in _NUMPY_EXEMPT and any(
+            _is_tainted(a, tainted) for a in node.args):
+          flag('numpy-on-tracer', node,
+               f'raw numpy call {name}(...) on a traced value: XLA '
+               'cannot stage it (concretizes or crashes); use jnp')
+      elif isinstance(node, ast.If):
+        test = node.test
+        if isinstance(test, ast.Name) and test.id in tainted:
+          flag('tracer-leak', node,
+               f"'if {test.id}:' coerces a traced value to bool at "
+               'trace time; use lax.cond / jnp.where')
+    findings.extend(_rng_reuse(module, fn, symbol))
+  return findings
+
+
+# ------------------------------------------------------------- rng reuse
+
+
+def _rng_reuse(module: core.ModuleInfo, fn: ast.FunctionDef,
+               symbol: str) -> List[core.Finding]:
+  findings: List[core.Finding] = []
+
+  def run(stmts, consumed: Set[str]) -> Set[str]:
+    for stmt in stmts:
+      consumed = run_stmt(stmt, consumed)
+    return consumed
+
+  def note_call(node: ast.Call, consumed: Set[str]) -> Set[str]:
+    name = core.call_name(node)
+    if name is None:
+      return consumed
+    parts = name.split('.')
+    is_random = 'random' in parts[:-1] or (
+        len(parts) == 1 and parts[0] in ('split', 'fold_in'))
+    if not is_random or parts[-1] in _RNG_NON_CONSUMERS:
+      return consumed
+    if not node.args:
+      return consumed
+    key = node.args[0]
+    if isinstance(key, ast.Name):
+      if key.id in consumed:
+        findings.append(core.Finding(
+            rule=RULE, check='rng-key-reuse', path=module.rel_path,
+            line=node.lineno, symbol=symbol,
+            message=(f'rng key {key.id!r} consumed again by '
+                     f'{name}(...) without an intervening split/'
+                     'fold_in rebinding: correlated randomness')))
+      else:
+        consumed = consumed | {key.id}
+    return consumed
+
+  def run_expr(node: ast.AST, consumed: Set[str]) -> Set[str]:
+    for sub in ast.walk(node):
+      if isinstance(sub, ast.Call):
+        consumed = note_call(sub, consumed)
+    return consumed
+
+  def run_stmt(stmt: ast.stmt, consumed: Set[str]) -> Set[str]:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+      return consumed  # nested defs analyzed on their own
+    if isinstance(stmt, ast.Assign):
+      consumed = run_expr(stmt.value, consumed)
+      for target in stmt.targets:
+        for n in ast.walk(target):
+          if isinstance(n, ast.Name):
+            consumed = consumed - {n.id}
+      return consumed
+    if isinstance(stmt, ast.If):
+      consumed_test = run_expr(stmt.test, consumed)
+      then = run(stmt.body, set(consumed_test))
+      other = run(stmt.orelse, set(consumed_test))
+      return then | other
+    if isinstance(stmt, (ast.For, ast.While)):
+      if isinstance(stmt, ast.For):
+        consumed = run_expr(stmt.iter, consumed)
+      else:
+        consumed = run_expr(stmt.test, consumed)
+      # Twice: catches a key consumed afresh every iteration.
+      consumed = run(stmt.body, consumed)
+      consumed = run(stmt.body, consumed)
+      return run(stmt.orelse, consumed)
+    if isinstance(stmt, (ast.With,)):
+      for item in stmt.items:
+        consumed = run_expr(item.context_expr, consumed)
+      return run(stmt.body, consumed)
+    if isinstance(stmt, ast.Try):
+      consumed = run(stmt.body, consumed)
+      for handler in stmt.handlers:
+        consumed = run(handler.body, set(consumed))
+      consumed = run(stmt.orelse, consumed)
+      return run(stmt.finalbody, consumed)
+    if isinstance(stmt, (ast.Return, ast.Expr)):
+      value = stmt.value
+      if value is not None:
+        consumed = run_expr(value, consumed)
+      return consumed
+    for node in ast.iter_child_nodes(stmt):
+      if isinstance(node, ast.expr):
+        consumed = run_expr(node, consumed)
+    return consumed
+
+  run(fn.body, set())
+  return findings

@@ -1,0 +1,585 @@
+"""Lock-discipline checker: GUARDED_BY / HOLDS + lock-ordering cycles.
+
+Annotation convention (comments, so zero runtime cost):
+
+* ``self._pending = deque()  # GUARDED_BY(self._cond)`` — every later
+  read/write of ``self._pending`` in this class must happen inside
+  ``with self._cond:`` (or a method annotated ``# HOLDS(self._cond)``).
+  Module globals annotate the same way: ``_LIB = None  # GUARDED_BY(_LOCK)``.
+* ``def _percentile_locked(self, q):  # HOLDS(self._lock)`` — documents
+  (and makes checkable) a helper whose CALLERS own the lock.
+* ``with lock.read_locked():`` / ``write_locked()`` both count as
+  holding ``lock`` (the ReaderWriterLock surface).
+* ``self._c = threading.Condition(self._l)`` aliases ``self._c`` to
+  ``self._l`` automatically — holding either satisfies guards on both
+  (a Condition shares its caller-supplied lock).
+
+``__init__``/``__del__`` bodies are exempt at their own scope
+(construction happens-before publication; the finalizer is
+single-threaded) — but functions NESTED inside them (worker closures)
+are checked: they run on other threads.
+
+Lock ordering builds a cross-class "acquired-while-holding" graph:
+``with B:`` lexically inside ``with A:`` adds edge A→B, and a call made
+while holding A adds A→(every lock the callee may transitively
+acquire). Locks are merged per class attribute (``module.Class._lock``),
+so two instances of one class share a node — the conservative choice
+for the dispatcher↔reload-poller↔RW-lock shapes in serving. Any cycle
+(including a self-edge on a non-reentrant lock: a helper re-acquiring
+the lock its caller holds) is reported once per cycle. RLocks are
+exempt from self-edges; ``X.read_locked()``/``X.write_locked()`` model
+the ReaderWriterLock as the single lock ``X`` — its internal Condition
+use does not span the yield of the ``*_locked`` contextmanagers, so no
+false edge leaks out of ``utils/concurrency.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'lock-discipline'
+
+_LOCK_CTORS = {
+    'Lock': 'lock', 'RLock': 'rlock', 'Condition': 'condition',
+    'Semaphore': 'semaphore', 'BoundedSemaphore': 'semaphore',
+    'ReaderWriterLock': 'rw',
+}
+_RW_METHODS = ('read_locked', 'write_locked', 'locked')
+
+
+def _lock_ctor_kind(node: ast.AST) -> Optional[str]:
+  """'lock'/'rlock'/'condition'/... when ``node`` constructs a lock."""
+  if not isinstance(node, ast.Call):
+    return None
+  name = core.call_name(node)
+  if name is None:
+    return None
+  leaf = name.rsplit('.', 1)[-1]
+  return _LOCK_CTORS.get(leaf)
+
+
+class _ClassModel:
+  """Guards/aliases/lock kinds for one class (or the module scope)."""
+
+  def __init__(self):
+    self.guards: Dict[str, str] = {}      # attr/global name -> lock text
+    self.aliases: Dict[str, str] = {}     # lock text -> lock text
+    self.lock_kinds: Dict[str, str] = {}  # lock text -> kind
+
+  def canonical(self, text: str) -> str:
+    seen = set()
+    while text in self.aliases and text not in seen:
+      seen.add(text)
+      text = self.aliases[text]
+    return text
+
+
+def _annotation_lines(module: core.ModuleInfo, node: ast.stmt) -> List[str]:
+  """GUARDED_BY lock texts attached to this statement (any line of the
+  statement, or a pure-comment line directly above — an annotation
+  inlined on a PRECEDING statement never bleeds onto this one)."""
+  out = []
+  end = getattr(node, 'end_lineno', node.lineno) or node.lineno
+  if module.is_comment_line(node.lineno - 1):
+    out.extend(module.guarded_by.get(node.lineno - 1, ()))
+  for line in range(node.lineno, end + 1):
+    out.extend(module.guarded_by.get(line, ()))
+  return out
+
+
+def _build_model(module: core.ModuleInfo, scope: ast.AST,
+                 class_name: Optional[str]) -> _ClassModel:
+  """Scans a class (every method) or the module top level for guard
+  annotations, lock constructions, and Condition aliases."""
+  model = _ClassModel()
+  for node in ast.walk(scope):
+    if isinstance(node, ast.ClassDef) and node is not scope:
+      continue  # inner classes build their own model
+    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+      continue
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    value = node.value
+    texts = []
+    for target in targets:
+      text = core.expr_text(target)
+      if text is None:
+        continue
+      if class_name is not None and not text.startswith('self.'):
+        continue
+      if class_name is None and '.' in text:
+        continue
+      texts.append(text)
+    if not texts or value is None:
+      continue
+    kind = _lock_ctor_kind(value)
+    if kind is not None:
+      for text in texts:
+        model.lock_kinds[text] = kind
+      if kind == 'condition' and value.args:
+        backing = core.expr_text(value.args[0])
+        if backing is not None:
+          for text in texts:
+            model.aliases[text] = backing
+    for lock_text in _annotation_lines(module, node):
+      for text in texts:
+        attr = text[len('self.'):] if text.startswith('self.') else text
+        model.guards[attr] = lock_text
+  return model
+
+
+def _with_lock_texts(item: ast.withitem) -> Optional[str]:
+  """The lock expression a withitem holds, or None (not lock-shaped)."""
+  ctx = item.context_expr
+  text = core.expr_text(ctx)
+  if text is not None:
+    return text
+  if isinstance(ctx, ast.Call):
+    name = core.call_name(ctx)
+    if name is not None:
+      base, _, leaf = name.rpartition('.')
+      if leaf in _RW_METHODS and base:
+        return base
+  return None
+
+
+def _holds_for_def(module: core.ModuleInfo,
+                   node: ast.FunctionDef) -> List[str]:
+  lines = [node.lineno]
+  if module.is_comment_line(node.lineno - 1):
+    lines.append(node.lineno - 1)
+  lines.extend(d.lineno for d in node.decorator_list)
+  body_first = node.body[0].lineno if node.body else node.lineno
+  lines.extend(range(node.lineno, body_first + 1))
+  out = []
+  for line in lines:
+    out.extend(module.holds.get(line, ()))
+  return out
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+  """Names bound locally in ``fn`` (assignments/args, minus globals)."""
+  names: Set[str] = set()
+  globals_decl: Set[str] = set()
+  args = fn.args
+  for a in (list(args.posonlyargs) + list(args.args) +
+            list(args.kwonlyargs) +
+            ([args.vararg] if args.vararg else []) +
+            ([args.kwarg] if args.kwarg else [])):
+    names.add(a.arg)
+  for node in ast.walk(fn):
+    if isinstance(node, (ast.Global, ast.Nonlocal)):
+      globals_decl.update(node.names)
+    elif isinstance(node, ast.Name) and isinstance(
+        node.ctx, (ast.Store, ast.Del)):
+      names.add(node.id)
+  return names - globals_decl
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  """The per-module GUARDED_BY discipline pass."""
+  del program
+  findings: List[core.Finding] = []
+  module_model = _build_model(module, module.tree, None)
+
+  def visit_scope(fn: ast.FunctionDef, cls: Optional[ast.ClassDef],
+                  class_model: Optional[_ClassModel]) -> None:
+    exempt = cls is not None and fn.name in ('__init__', '__del__')
+    held: Set[str] = set()
+    for text in _holds_for_def(module, fn):
+      model = class_model or module_model
+      held.add(model.canonical(text))
+    locals_ = _local_names(fn)
+
+    def access_ok(model: _ClassModel, lock_text: str) -> bool:
+      return model.canonical(lock_text) in held
+
+    def flag(node: ast.AST, name: str, lock_text: str, write: bool):
+      findings.append(core.Finding(
+          rule=RULE,
+          check='unguarded-write' if write else 'unguarded-read',
+          path=module.rel_path, line=node.lineno,
+          symbol=core.qualname(module, fn),
+          message=(f"{'write to' if write else 'read of'} {name!r} "
+                   f'(GUARDED_BY {lock_text}) outside '
+                   f"'with {lock_text}:' and without HOLDS({lock_text})")))
+
+    def walk(node: ast.AST):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # Nested defs run later, on whatever thread calls them: they
+        # get a fresh walk with their own (empty + HOLDS) held set.
+        visit_scope(node, cls, class_model)
+        return
+      if isinstance(node, ast.Lambda):
+        return
+      if isinstance(node, ast.With):
+        acquired = []
+        for item in node.items:
+          text = _with_lock_texts(item)
+          if text is not None:
+            model = class_model or module_model
+            acquired.append(model.canonical(text))
+          if item.optional_vars is not None:
+            walk(item.optional_vars)
+          walk(item.context_expr)
+        held.update(acquired)
+        for stmt in node.body:
+          walk(stmt)
+        for text in acquired:
+          held.discard(text)
+        return
+      if not exempt:
+        if (isinstance(node, ast.Attribute) and
+            isinstance(node.value, ast.Name) and
+            node.value.id == 'self' and class_model is not None and
+            node.attr in class_model.guards):
+          lock_text = class_model.guards[node.attr]
+          if not access_ok(class_model, lock_text):
+            flag(node, node.attr, lock_text,
+                 isinstance(node.ctx, (ast.Store, ast.Del)))
+        elif (isinstance(node, ast.Name) and
+              node.id in module_model.guards and
+              node.id not in locals_):
+          lock_text = module_model.guards[node.id]
+          if not access_ok(module_model, lock_text):
+            flag(node, node.id, lock_text,
+                 isinstance(node.ctx, (ast.Store, ast.Del)))
+      for child in ast.iter_child_nodes(node):
+        walk(child)
+
+    for stmt in fn.body:
+      walk(stmt)
+
+  def visit_container(container: ast.AST, cls: Optional[ast.ClassDef],
+                      class_model: Optional[_ClassModel]):
+    for node in container.body:  # type: ignore[attr-defined]
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        visit_scope(node, cls, class_model)
+      elif isinstance(node, ast.ClassDef):
+        visit_container(node, node, _build_model(module, node, node.name))
+
+  visit_container(module.tree, None, None)
+  return findings
+
+
+# ------------------------------------------------------------ lock ordering
+
+
+class _FuncModel:
+  """Per-def facts for the cross-module ordering graph."""
+
+  def __init__(self, fid: str, node: ast.FunctionDef,
+               module: core.ModuleInfo, cls: Optional[ast.ClassDef]):
+    self.fid = fid
+    self.node = node
+    self.module = module
+    self.cls = cls
+    self.is_contextmanager = any(
+        core.expr_text(d) in ('contextlib.contextmanager',
+                              'contextmanager')
+        for d in node.decorator_list)
+    self.acquired_direct: Set[str] = set()
+    self.yield_held: Set[str] = set()
+    # (held-at-call, callee text, receiver text, line)
+    self.calls: List[Tuple[frozenset, str, Optional[str], int]] = []
+    # (holder, acquired, line) lexical with-in-with edges
+    self.edges: List[Tuple[str, str, int]] = []
+
+
+class _Orderer:
+  """Builds the acquired-while-holding graph over the whole program."""
+
+  def __init__(self, program: core.Program):
+    self.program = program
+    self.funcs: Dict[str, _FuncModel] = {}
+    self.lock_kinds: Dict[str, str] = {}
+    self.class_models: Dict[str, _ClassModel] = {}
+    self.imports: Dict[str, Dict[str, str]] = {}   # mod -> alias -> target
+    self.attr_types: Dict[str, str] = {}  # 'mod.Class.attr' -> class qid
+    for module in program.modules:
+      self._scan_module(module)
+    self._fixpoint = {}
+
+  # ---------------------------------------------------------- scanning
+
+  def _scan_module(self, module: core.ModuleInfo) -> None:
+    imports: Dict[str, str] = {}
+    for node in ast.walk(module.tree):
+      if isinstance(node, ast.Import):
+        for alias in node.names:
+          name = core._module_name(alias.name.replace('.', '/') + '.py')
+          imports[alias.asname or alias.name.split('.')[0]] = name
+      elif isinstance(node, ast.ImportFrom) and node.module:
+        src = core._module_name(node.module.replace('.', '/') + '.py')
+        for alias in node.names:
+          imports[alias.asname or alias.name] = f'{src}.{alias.name}'
+    self.imports[module.name] = imports
+
+    module_model = _build_model(module, module.tree, None)
+    self.class_models[f'{module.name}.'] = module_model
+    for text, kind in module_model.lock_kinds.items():
+      self.lock_kinds[f'{module.name}.{module_model.canonical(text)}'] = kind
+
+    def scan_defs(container, cls: Optional[ast.ClassDef]):
+      for node in container.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+          self._scan_def(module, node, cls)
+          for inner in ast.walk(node):
+            if inner is not node and isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+              self._scan_def(module, inner, cls)
+        elif isinstance(node, ast.ClassDef):
+          model = _build_model(module, node, node.name)
+          self.class_models[f'{module.name}.{node.name}'] = model
+          for text, kind in model.lock_kinds.items():
+            canon = self._canonical_lock(module, node, text, model)
+            if canon:
+              self.lock_kinds[canon] = kind
+          scan_defs(node, node)
+
+    scan_defs(module.tree, None)
+    # self._x = ClassName(...) attribute types, for receiver resolution.
+    for cls_node in [n for n in module.tree.body
+                     if isinstance(n, ast.ClassDef)]:
+      for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign):
+          continue
+        ctor = node.value
+        if not isinstance(ctor, ast.Call):
+          continue
+        cls_qid = self._resolve_class(module, core.call_name(ctor))
+        if cls_qid is None:
+          continue
+        for target in node.targets:
+          text = core.expr_text(target)
+          if text and text.startswith('self.'):
+            key = f'{module.name}.{cls_node.name}.{text[5:]}'
+            self.attr_types[key] = cls_qid
+
+  def _resolve_class(self, module: core.ModuleInfo,
+                     name: Optional[str]) -> Optional[str]:
+    if name is None:
+      return None
+    leaf = name.rsplit('.', 1)[-1]
+    imports = self.imports.get(module.name, {})
+    for cand in (f'{module.name}.{name}', imports.get(name, ''),
+                 f"{imports.get(name.split('.')[0], '')}."
+                 f"{'.'.join(name.split('.')[1:])}" if '.' in name else '',
+                 f'{module.name}.{leaf}'):
+      if cand and cand in self.program.classes:
+        return cand
+    return None
+
+  def _canonical_lock(self, module: core.ModuleInfo,
+                      cls: Optional[ast.ClassDef], text: str,
+                      model: Optional[_ClassModel] = None) -> Optional[str]:
+    if model is not None:
+      text = model.canonical(text)
+    if text.startswith('self.'):
+      if cls is None:
+        return None
+      return f'{module.name}.{cls.name}.{text[5:]}'
+    return f'{module.name}.{text}'
+
+  def _scan_def(self, module: core.ModuleInfo, fn: ast.FunctionDef,
+                cls: Optional[ast.ClassDef]) -> None:
+    fid = f'{module.name}.{core.qualname(module, fn)}'
+    if fid in self.funcs:
+      return
+    model = _FuncModel(fid, fn, module, cls)
+    self.funcs[fid] = model
+    class_model = self.class_models.get(
+        f'{module.name}.{cls.name}' if cls else f'{module.name}.')
+    held0 = set()
+    for text in _holds_for_def(module, fn):
+      canon = self._canonical_lock(module, cls, text, class_model)
+      if canon:
+        held0.add(canon)
+
+    def walk(node: ast.AST, held: frozenset, in_yield_scope: List[str]):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)) and node is not fn:
+        return  # nested defs are scanned as their own functions
+      if isinstance(node, ast.With):
+        acquired = []
+        for item in node.items:
+          walk(item.context_expr, held, in_yield_scope)
+          text = _with_lock_texts(item)
+          if text is None:
+            continue
+          canon = self._canonical_lock(module, cls, text, class_model)
+          if canon is None:
+            continue
+          acquired.append(canon)
+          model.acquired_direct.add(canon)
+          for holder in held:
+            model.edges.append((holder, canon, node.lineno))
+        inner = frozenset(held | set(acquired))
+        for stmt in node.body:
+          walk(stmt, inner, in_yield_scope + acquired)
+        return
+      if isinstance(node, (ast.Yield, ast.YieldFrom)):
+        model.yield_held.update(in_yield_scope)
+      if isinstance(node, ast.Call):
+        name = core.call_name(node)
+        if name is not None:
+          receiver = name.rpartition('.')[0] or None
+          model.calls.append((held, name, receiver, node.lineno))
+      for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+          continue
+        walk(child, held, in_yield_scope)
+
+    for stmt in fn.body:
+      walk(stmt, frozenset(held0), [])
+
+  # -------------------------------------------------------- resolution
+
+  def resolve_call(self, caller: _FuncModel,
+                   name: str) -> Optional[str]:
+    module = caller.module
+    base, _, leaf = name.rpartition('.')
+    if leaf in _RW_METHODS:
+      return None  # modeled as acquiring the receiver lock itself
+    if not base:
+      cand = f'{module.name}.{name}'
+      if cand in self.funcs:
+        return cand
+      cls_qid = self._resolve_class(module, name)
+      if cls_qid is not None:
+        return f'{cls_qid}.__init__'
+      return None
+    if base == 'self' and caller.cls is not None:
+      cand = f'{module.name}.{caller.cls.name}.{leaf}'
+      return cand if cand in self.funcs else None
+    imports = self.imports.get(module.name, {})
+    if base in imports:
+      cand = f'{imports[base]}.{leaf}'
+      return cand if cand in self.funcs else None
+    if base.startswith('self.') and caller.cls is not None:
+      attr_key = f'{module.name}.{caller.cls.name}.{base[5:]}'
+      cls_qid = self.attr_types.get(attr_key)
+      if cls_qid is not None:
+        cand = f'{cls_qid}.{leaf}'
+        return cand if cand in self.funcs else None
+    return None
+
+  def transitive_acquires(self, fid: str,
+                          stack: Optional[Set[str]] = None) -> Set[str]:
+    if fid in self._fixpoint:
+      return self._fixpoint[fid]
+    stack = stack or set()
+    if fid in stack:
+      return set()
+    stack.add(fid)
+    model = self.funcs.get(fid)
+    if model is None:
+      return set()
+    out = set(model.acquired_direct)
+    for _, name, receiver, _ in model.calls:
+      callee = self.resolve_call(model, name)
+      if callee is not None:
+        out |= self.transitive_acquires(callee, stack)
+      elif name.rpartition('.')[2] in _RW_METHODS and receiver:
+        canon = self._canonical_lock(
+            model.module, model.cls, receiver,
+            self.class_models.get(
+                f'{model.module.name}.{model.cls.name}'
+                if model.cls else f'{model.module.name}.'))
+        if canon:
+          out.add(canon)
+    stack.discard(fid)
+    self._fixpoint[fid] = out
+    return out
+
+  # ------------------------------------------------------------ edges
+
+  def build_edges(self) -> List[Tuple[str, str, str, int]]:
+    edges: List[Tuple[str, str, str, int]] = []
+    for model in self.funcs.values():
+      for holder, acquired, line in model.edges:
+        edges.append((holder, acquired, model.module.rel_path, line))
+      for held, name, _, line in model.calls:
+        if not held:
+          continue
+        callee = self.resolve_call(model, name)
+        if callee is None:
+          continue
+        for acquired in self.transitive_acquires(callee):
+          for holder in held:
+            edges.append((holder, acquired, model.module.rel_path, line))
+    return edges
+
+
+def check_lock_ordering(program: core.Program) -> List[core.Finding]:
+  """Program-level pass: cycles (incl. self-edges) in the lock graph."""
+  orderer = _Orderer(program)
+  edges = orderer.build_edges()
+  graph: Dict[str, Set[str]] = {}
+  locations: Dict[Tuple[str, str], Tuple[str, int]] = {}
+  findings: List[core.Finding] = []
+  reported_self: Set[str] = set()
+  for holder, acquired, path, line in edges:
+    if holder == acquired:
+      kind = orderer.lock_kinds.get(holder, 'lock')
+      if kind != 'rlock' and holder not in reported_self:
+        reported_self.add(holder)
+        findings.append(core.Finding(
+            rule=RULE, check='lock-ordering-cycle', path=path, line=line,
+            symbol=holder,
+            message=(f'non-reentrant lock {holder} may be re-acquired '
+                     'while already held (self-deadlock)')))
+      continue
+    graph.setdefault(holder, set()).add(acquired)
+    locations.setdefault((holder, acquired), (path, line))
+  for cycle in _cycles(graph):
+    pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+    path, line = locations.get(pairs[0], ('<program>', 0))
+    order = ' -> '.join(cycle + [cycle[0]])
+    findings.append(core.Finding(
+        rule=RULE, check='lock-ordering-cycle', path=path, line=line,
+        symbol=' / '.join(sorted(cycle)),
+        message=(f'lock-ordering cycle: {order} (threads taking these '
+                 'locks in different orders can deadlock)')))
+  return findings
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+  """Tarjan SCCs of size >= 2, each a potential deadlock."""
+  index: Dict[str, int] = {}
+  low: Dict[str, int] = {}
+  on_stack: Set[str] = set()
+  stack: List[str] = []
+  sccs: List[List[str]] = []
+  counter = [0]
+
+  def strongconnect(v: str):
+    index[v] = low[v] = counter[0]
+    counter[0] += 1
+    stack.append(v)
+    on_stack.add(v)
+    for w in graph.get(v, ()):
+      if w not in index:
+        strongconnect(w)
+        low[v] = min(low[v], low[w])
+      elif w in on_stack:
+        low[v] = min(low[v], index[w])
+    if low[v] == index[v]:
+      scc = []
+      while True:
+        w = stack.pop()
+        on_stack.discard(w)
+        scc.append(w)
+        if w == v:
+          break
+      if len(scc) > 1:
+        sccs.append(sorted(scc))
+
+  for v in sorted(set(graph) | {w for ws in graph.values() for w in ws}):
+    if v not in index:
+      strongconnect(v)
+  return sccs

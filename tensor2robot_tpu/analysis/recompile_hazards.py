@@ -1,0 +1,171 @@
+"""Recompile-hazard checker.
+
+Steady-state serving and training must never re-trace: one stray
+retrace per dispatch erases every win the AOT bucket executors and the
+K×M fused step bought. Two finding families:
+
+* ``unstable-jit-arg`` — call sites of a KNOWN-jitted callable (a name
+  bound from ``jax.jit(...)``/``pjit(...)``, a ``@jit``-decorated def,
+  or a name bound from a local factory that returns a jit) whose
+  arguments include Python scalar literals, dict/list/set displays or
+  comprehensions: every distinct value/shape is a fresh cache entry
+  (weak-typed scalars re-specialize; container literals rebuild pytree
+  shapes per call). Also ``jax.jit(f)(...)`` called inline — wrapping
+  per call defeats jit's cache when ``f`` is a lambda/closure — and
+  ``jax.jit(lambda ...)``, which can NEVER hit the cache twice.
+* ``weak-keyed-cache`` — executor/program caches keyed on identity or
+  drifting fingerprints: subscript stores whose key contains ``id(...)``
+  (ids are recycled after GC and drift across reloads — the shape of
+  the PR-7 program-key bug that silently defeated executable-cache
+  reuse), and ``functools.lru_cache`` on methods (keys on ``self``,
+  pinning every instance forever and splitting the cache per instance).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'recompile-hazard'
+
+_JIT_WRAPPERS = {'jax.jit', 'jit', 'jax.pjit', 'pjit'}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+  return (isinstance(node, ast.Call) and
+          core.call_name(node) in _JIT_WRAPPERS)
+
+
+def _returns_jit(fn: ast.FunctionDef) -> bool:
+  """Does this local factory return a jitted callable?"""
+  for node in ast.walk(fn):
+    if isinstance(node, ast.Return) and node.value is not None:
+      if _is_jit_call(node.value):
+        return True
+  return False
+
+
+def _jitted_names(module: core.ModuleInfo) -> Set[str]:
+  """Names/attrs bound to jitted callables within this module."""
+  factories: Set[str] = set()
+  for fn in core.func_defs(module.tree):
+    if _returns_jit(fn):
+      factories.add(fn.name)
+  jitted: Set[str] = set()
+  for node in ast.walk(module.tree):
+    if isinstance(node, ast.Assign):
+      value = node.value
+      bind = False
+      if _is_jit_call(value):
+        bind = True
+      elif isinstance(value, ast.Call):
+        name = core.call_name(value)
+        if name is not None and (name in factories or
+                                 name.rsplit('.', 1)[-1] in factories):
+          bind = True
+      if bind:
+        for target in node.targets:
+          text = core.expr_text(target)
+          if text is not None:
+            jitted.add(text)
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      for dec in node.decorator_list:
+        dec_text = core.expr_text(dec)
+        dec_call = core.call_name(dec) if isinstance(dec, ast.Call) else None
+        partial_inner = None
+        if isinstance(dec, ast.Call) and dec_call in (
+            'functools.partial', 'partial') and dec.args:
+          partial_inner = core.expr_text(dec.args[0])
+        if (dec_text in _JIT_WRAPPERS or dec_call in _JIT_WRAPPERS or
+            partial_inner in _JIT_WRAPPERS):
+          jitted.add(node.name)
+  return jitted
+
+
+def _unstable_arg(arg: ast.AST) -> Optional[str]:
+  if isinstance(arg, ast.Constant) and isinstance(
+      arg.value, (bool, int, float)):
+    return (f'Python scalar literal {arg.value!r} (weak-typed: each '
+            'distinct value/dtype promotion re-specializes the trace)')
+  if isinstance(arg, (ast.Dict, ast.DictComp)):
+    return 'dict display (pytree structure rebuilt per call site)'
+  if isinstance(arg, (ast.List, ast.ListComp, ast.Set, ast.SetComp)):
+    return 'list/set display (varying length retraces per shape)'
+  return None
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+  jitted = _jitted_names(module)
+
+  def symbol_of(node: ast.AST) -> str:
+    enclosing = module.enclosing(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return core.qualname(module, enclosing) if enclosing else ''
+
+  for node in ast.walk(module.tree):
+    if isinstance(node, ast.Call):
+      name = core.call_name(node)
+      # jax.jit(lambda ...) can never hit the trace cache twice.
+      if name in _JIT_WRAPPERS and node.args and isinstance(
+          node.args[0], ast.Lambda):
+        findings.append(core.Finding(
+            rule=RULE, check='unstable-jit-arg', path=module.rel_path,
+            line=node.lineno, symbol=symbol_of(node),
+            message=('jit(lambda ...): the lambda object is fresh per '
+                     'evaluation, so the compiled program can never be '
+                     'reused — name the function and jit it once')))
+      # jax.jit(f)(args): a fresh wrapper per call.
+      if _is_jit_call(node.func):
+        findings.append(core.Finding(
+            rule=RULE, check='unstable-jit-arg', path=module.rel_path,
+            line=node.lineno, symbol=symbol_of(node),
+            message=('inline jax.jit(f)(...) call: wrap once at setup '
+                     'and reuse the jitted callable — per-call wrapping '
+                     'defeats the trace cache for closures/lambdas')))
+      if name in jitted:
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+          why = _unstable_arg(arg)
+          if why is not None:
+            findings.append(core.Finding(
+                rule=RULE, check='unstable-jit-arg',
+                path=module.rel_path, line=node.lineno,
+                symbol=symbol_of(node),
+                message=(f'non-static argument to jitted {name}(...): '
+                         f'{why}')))
+      # Identity-keyed caches: cache[id(x)] = ... or keys containing id().
+      if (name == 'id' and
+          isinstance(module.parent(node), ast.Subscript)):
+        sub = module.parent(node)
+        if isinstance(sub.ctx, ast.Store):
+          findings.append(core.Finding(
+              rule=RULE, check='weak-keyed-cache', path=module.rel_path,
+              line=node.lineno, symbol=symbol_of(node),
+              message=('cache keyed on id(...): ids are recycled after '
+                       'GC and drift across reloads, so entries alias '
+                       'or silently never match (the PR-7 program-key '
+                       'failure shape) — key on stable content instead')))
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      args = node.args.posonlyargs + node.args.args
+      is_method = bool(args) and args[0].arg in ('self', 'cls')
+      if not is_method:
+        continue
+      for dec in node.decorator_list:
+        dec_name = (core.expr_text(dec) or
+                    (core.call_name(dec)
+                     if isinstance(dec, ast.Call) else None))
+        if dec_name in ('functools.lru_cache', 'lru_cache',
+                        'functools.cache', 'cache'):
+          findings.append(core.Finding(
+              rule=RULE, check='weak-keyed-cache', path=module.rel_path,
+              line=node.lineno,
+              symbol=core.qualname(module, node),
+              message=('lru_cache on a method keys on self: every '
+                       'instance is pinned forever and a reloaded '
+                       'instance never hits the old entries — cache on '
+                       'stable identity, or module level')))
+  return findings

@@ -28,10 +28,10 @@ import io
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-_REGISTRY: Dict[str, Callable] = {}
-_BINDINGS: Dict[Tuple[str, str], Dict[str, Any]] = {}  # (scope,name) → params
-_MACROS: Dict[str, Any] = {}
-_OPERATIVE: Dict[str, Dict[str, Any]] = {}
+_REGISTRY: Dict[str, Callable] = {}  # GUARDED_BY(_LOCK)
+_BINDINGS: Dict[Tuple[str, str], Dict[str, Any]] = {}  # (scope,name) → params  # GUARDED_BY(_LOCK)
+_MACROS: Dict[str, Any] = {}  # GUARDED_BY(_LOCK)
+_OPERATIVE: Dict[str, Dict[str, Any]] = {}  # GUARDED_BY(_LOCK)
 _LOCK = threading.RLock()
 _SCOPE_STACK = threading.local()
 

@@ -241,15 +241,20 @@ class ParallelBatchEngine:
     self._records = iter(records)
     self._parse_fn = parse_fn
     self._batch_size = int(batch_size)
-    self._num_workers = max(0, int(num_workers))
+    # Serial-vs-pipeline is a MODE, fixed at construction; the mutable
+    # worker-pool size below never crosses it (re-autotune bounds are
+    # [1, ring_depth-1]), so mode checks need no lock.
+    self._serial = max(0, int(num_workers)) == 0
+    self._num_workers = max(0, int(num_workers))  # GUARDED_BY(self._workers_lock)
     self.delivered = 0
-    self._closed = False
+    self._workers_lock = threading.Lock()
+    self._closed = False  # GUARDED_BY(self._workers_lock)
     self._metrics = metrics_lib.scope('data/engine')
     self._m_tickets = self._metrics.counter('tickets')
     self._m_batches = self._metrics.counter('batches')
     self._m_reorder_depth = self._metrics.gauge('reorder_depth')
     self._m_wait = self._metrics.histogram('reorder_wait_ms')
-    if self._num_workers == 0:
+    if self._serial:
       self._pending: List[bytes] = []
       return
 
@@ -263,8 +268,7 @@ class ParallelBatchEngine:
     self._cpus = cpus
     self._reautotune_enabled = bool(reautotune)
     self._max_workers = self._ring_depth - 1
-    self._worker_seq = self._num_workers
-    self._workers_lock = threading.Lock()
+    self._worker_seq = self._num_workers  # GUARDED_BY(self._workers_lock)
     self._lease_lock = threading.Lock()
     self._lease_cond = threading.Condition(self._lease_lock)
     self._lease_timeout = float(lease_timeout)
@@ -276,22 +280,22 @@ class ParallelBatchEngine:
     self._m_reauto_windows = self._metrics.counter('reautotune/windows')
     self._m_reauto_changes = self._metrics.counter('reautotune/changes')
     self._m_reauto_target = self._metrics.gauge('reautotune/target_workers')
-    self.decision_history: List[dict] = []
+    self.decision_history: List[dict] = []  # GUARDED_BY(self._workers_lock)
     # Outstanding-ticket bound: acquired per issued ticket, released when
     # the consumer is done with the batch (delivery, or — in ring mode —
     # the explicit release that frees the slot for reuse).
     self._sem = threading.Semaphore(self._ring_depth)
     self._ticket_q: 'queue_lib.Queue' = queue_lib.Queue()
     self._cond = threading.Condition()
-    self._results: dict = {}          # seq -> batch | _Failure
-    self._next_seq = 0
-    self._end_seq: Optional[int] = None  # first seq never produced
+    self._results: dict = {}  # seq -> batch | _Failure  # GUARDED_BY(self._cond)
+    self._next_seq = 0  # GUARDED_BY(self._cond)
+    self._end_seq: Optional[int] = None  # first seq never produced  # GUARDED_BY(self._cond)
     self._stop = threading.Event()
 
     self._reuse = bool(reuse_buffers)
     self._free_slots: 'queue_lib.Queue' = queue_lib.Queue()
-    self._slot_of: dict = {}          # seq -> slot id (ring mode)
-    self._lease_order: List[int] = []  # delivered-not-released slots, FIFO
+    self._slot_of: dict = {}  # seq -> slot id (ring mode)  # GUARDED_BY(self._cond)
+    self._lease_order: List[int] = []  # delivered-not-released slots, FIFO  # GUARDED_BY(self._lease_cond)
     if self._reuse:
       make_buffers = getattr(parse_fn, 'make_image_buffers', None)
       if make_buffers is None:
@@ -305,7 +309,7 @@ class ParallelBatchEngine:
         for i in range(self._ring_depth):
           self._free_slots.put(i)
 
-    self._threads = [
+    self._threads = [  # GUARDED_BY(self._workers_lock)
         threading.Thread(target=self._issue_tickets, daemon=True,
                          name='t2r-engine-tickets')
     ]
@@ -410,15 +414,17 @@ class ParallelBatchEngine:
       return
     input_bound = metrics_lib.gauge('trainer/input_bound_fraction').value
     cpus = available_cpus() if self._cpus is None else int(self._cpus)
+    with self._workers_lock:
+      current = self._num_workers
     if input_bound < _COMPUTE_BOUND_FRACTION and starve_delta == 0:
       target = 1  # compute-bound: extra pipeline threads only contend
     elif input_bound >= _INPUT_BOUND_FRACTION or starve_delta > 0:
       target = min(max(cpus - 1, 1), _INPUT_BOUND_MAX_WORKERS)
     else:
-      target = self._num_workers
+      target = current
     target = max(1, min(target, self._max_workers))
     self._m_reauto_target.set(target)
-    if target != self._num_workers:
+    if target != current:
       self._set_num_workers(target, input_bound, starve_delta)
 
   def _set_num_workers(self, target: int, input_bound: float,
@@ -431,6 +437,8 @@ class ParallelBatchEngine:
     the work it already accepted.
     """
     with self._workers_lock:
+      if self._closed:
+        return  # close() already snapshotted the pool: no new threads
       old = self._num_workers
       if target == old:
         return
@@ -445,12 +453,12 @@ class ParallelBatchEngine:
         for _ in range(old - target):
           self._ticket_q.put(self._RETIRE)
       self._num_workers = target
+      decision = {'window': self._last_window, 'from': old, 'to': target,
+                  'input_bound_fraction': round(float(input_bound), 4),
+                  'starvation': int(starvation)}
+      self.decision_history.append(decision)
     self._m_workers.set(target)
     self._m_reauto_changes.inc()
-    decision = {'window': self._last_window, 'from': old, 'to': target,
-                'input_bound_fraction': round(float(input_bound), 4),
-                'starvation': int(starvation)}
-    self.decision_history.append(decision)
     logging.info('Input engine re-autotune: %s', decision)
 
   # ------------------------------------------------------------ consumer
@@ -459,7 +467,7 @@ class ParallelBatchEngine:
     return self
 
   def __next__(self) -> Any:
-    if self._num_workers == 0:
+    if self._serial:
       return self._serial_next()
     self._maybe_reautotune()
     if self._reuse:
@@ -529,7 +537,7 @@ class ParallelBatchEngine:
     ``reuse_buffers``. Thread-safe: the trainer's placement stage
     releases from its own thread while the fetch stage consumes.
     """
-    if self._num_workers == 0 or not self._reuse:
+    if self._serial or not self._reuse:
       return
     with self._lease_cond:
       if not self._lease_order:
@@ -543,10 +551,19 @@ class ParallelBatchEngine:
 
   def close(self, timeout: float = 5.0) -> None:
     """Stops the pipeline threads (idempotent)."""
-    if self._num_workers == 0 or self._closed:
+    with self._workers_lock:
+      if self._serial or self._closed:
+        self._closed = True
+        return
       self._closed = True
-      return
-    self._closed = True
+      # Snapshot pool state under the lock: a concurrent mid-run grow
+      # (_set_num_workers, driven from the consumer thread) appends to
+      # _threads while this method would otherwise iterate it — a
+      # RuntimeError plus unjoined workers (found by the lock-discipline
+      # checker, PR 8). After _closed flips, _set_num_workers is a
+      # no-op, so the snapshot is complete.
+      threads = list(self._threads)
+      workers = self._num_workers
     self._stop.set()
     with self._cond:
       # A next() after close must observe end-of-stream, not block
@@ -556,13 +573,13 @@ class ParallelBatchEngine:
       self._cond.notify_all()
     # Unblock workers waiting on tickets/slots and the issuer waiting on
     # the semaphore (it polls with a timeout).
-    for _ in range(self._num_workers):
+    for _ in range(workers):
       self._ticket_q.put(self._DONE)
     if self._reuse:
-      for _ in range(self._num_workers):
+      for _ in range(workers):
         self._free_slots.put(0)
     deadline = time.monotonic() + timeout
-    for t in self._threads:
+    for t in threads:
       t.join(max(0.0, deadline - time.monotonic()))
       if t.is_alive():
         logging.warning(

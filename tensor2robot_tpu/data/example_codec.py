@@ -27,8 +27,7 @@ Parsing semantics preserved from the reference:
 
 from __future__ import annotations
 
-import collections
-from typing import Dict, Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

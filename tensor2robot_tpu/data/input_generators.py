@@ -13,7 +13,7 @@ from __future__ import annotations
 import abc
 import json
 import os
-from typing import Callable, Dict, Iterator, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, Iterator, Optional, Tuple, Union
 
 import numpy as np
 
@@ -526,9 +526,9 @@ class _CheckpointableEngineIterator:
     self._generator = generator
     self._mode = mode
     self._batch_size = batch_size
-    self._delivered = 0
+    self._delivered = 0  # GUARDED_BY(self._lock)
     self._lock = threading.Lock()
-    self._engine = generator._build_batches(mode, batch_size)  # pylint: disable=protected-access
+    self._engine = generator._build_batches(mode, batch_size)  # pylint: disable=protected-access  # GUARDED_BY(self._lock)
 
   def __iter__(self):
     return self
@@ -542,6 +542,10 @@ class _CheckpointableEngineIterator:
   def release(self) -> None:
     """Ring-buffer lease release, delegated to the engine (the trainer
     detects this hook on its input iterator — see ``Trainer.train``)."""
+    # ANALYSIS_OK(lock-discipline): taking the position lock here would
+    # deadlock — __next__ holds it while blocked on the ring waiting for
+    # THIS release (placement thread). The engine ref only changes in
+    # restore(), which runs before the consuming threads start.
     self._engine.release()
 
   def save(self, path_prefix: str) -> str:
@@ -571,6 +575,8 @@ class _CheckpointableEngineIterator:
           self._mode, self._batch_size, skip_batches=self._delivered)
 
   def close(self) -> None:
+    # ANALYSIS_OK(lock-discipline): same no-lock contract as release();
+    # close is idempotent and the engine ref is stable once consuming.
     self._engine.close()
 
 

@@ -22,7 +22,6 @@ from typing import Iterator, List, Optional, Sequence
 from tensor2robot_tpu import native
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
-from tensor2robot_tpu.utils import retry as retry_lib
 
 # Per-record counters batch locally and flush every N records: one lock
 # acquire per record would tax the multi-GB/s interleave reader; one per
@@ -471,7 +470,7 @@ def _decode_image_batch(raws, spec, workers: int, key=None, out=None):
   return out
 
 
-_DECODE_POOLS: dict = {}  # max_workers → ThreadPoolExecutor
+_DECODE_POOLS: dict = {}  # max_workers → ThreadPoolExecutor  # GUARDED_BY(_DECODE_POOL_LOCK)
 _DECODE_POOL_LOCK = threading.Lock()
 
 

@@ -11,9 +11,8 @@ reference ran in ``dataset.map`` happens *on device inside the jitted step*
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, Optional, Tuple, Union
+from typing import Callable, Dict, Iterator, Optional, Union
 
-import numpy as np
 
 from tensor2robot_tpu import modes
 from tensor2robot_tpu.data import example_codec, records
@@ -323,8 +322,8 @@ class CheckpointableNumpyIterator:
     import threading
 
     tf = _tf()
-    self._iterator = iter(dataset)
-    self._checkpoint = tf.train.Checkpoint(iterator=self._iterator)
+    self._iterator = iter(dataset)  # GUARDED_BY(self._lock)
+    self._checkpoint = tf.train.Checkpoint(iterator=self._iterator)  # GUARDED_BY(self._lock)
     self._has_labels = has_labels
     # save/restore vs a concurrent next() (the trainer's prefetch worker
     # advances this iterator from its own thread) is undefined in

@@ -8,7 +8,7 @@ embedding loss over (inference, condition) episode embeddings.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

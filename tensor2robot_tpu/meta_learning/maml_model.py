@@ -21,7 +21,6 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 
 from tensor2robot_tpu.meta_learning import maml_inner_loop, meta_tfdata
 from tensor2robot_tpu.meta_learning.preprocessors import (

@@ -9,7 +9,7 @@ serialized bytes.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import Sequence, Union
 
 ExampleLike = Union[bytes, object]
 

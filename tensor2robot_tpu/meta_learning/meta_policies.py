@@ -9,7 +9,6 @@ adaptation inside its forward pass.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

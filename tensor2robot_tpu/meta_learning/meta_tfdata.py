@@ -14,10 +14,8 @@ Capability-equivalent of ``/root/reference/meta_learning/meta_tfdata.py``:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Callable
 
-import jax.numpy as jnp
-import numpy as np
 
 from tensor2robot_tpu.specs import SpecStruct, algebra
 

@@ -17,7 +17,6 @@ Capability-equivalent of ``/root/reference/meta_learning/preprocessors.py``:
 
 from __future__ import annotations
 
-from typing import Optional
 
 from tensor2robot_tpu.meta_learning import meta_tfdata
 from tensor2robot_tpu.preprocessors.base import AbstractPreprocessor

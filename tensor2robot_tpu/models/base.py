@@ -32,7 +32,6 @@ import abc
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple, Type
 
 import jax
-import numpy as np
 
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.preprocessors import (

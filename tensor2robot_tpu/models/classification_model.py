@@ -8,13 +8,11 @@ pure jnp.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from tensor2robot_tpu.models.base import FlaxModel
-from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.specs import SpecStruct
 
 

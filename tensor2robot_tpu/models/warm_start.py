@@ -18,7 +18,6 @@ import logging
 import os
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
-import jax
 import numpy as np
 from flax import traverse_util
 

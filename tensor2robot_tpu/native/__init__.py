@@ -25,10 +25,10 @@ from typing import Optional
 _SRC = os.path.join(os.path.dirname(__file__), 'record_io.cpp')
 _JPEG_SRC = os.path.join(os.path.dirname(__file__), 'jpeg_decode.cpp')
 _LOCK = threading.Lock()
-_LIB: Optional[ctypes.CDLL] = None
-_TRIED = False
-_JPEG_LIB: Optional[ctypes.CDLL] = None
-_JPEG_TRIED = False
+_LIB: Optional[ctypes.CDLL] = None  # GUARDED_BY(_LOCK)
+_TRIED = False  # GUARDED_BY(_LOCK)
+_JPEG_LIB: Optional[ctypes.CDLL] = None  # GUARDED_BY(_LOCK)
+_JPEG_TRIED = False  # GUARDED_BY(_LOCK)
 
 
 def _build_dir() -> str:

@@ -38,7 +38,7 @@ import math
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'Registry', 'Scope', 'counter',
@@ -56,7 +56,7 @@ class Counter:
   def __init__(self, name: str):
     self.name = name
     self._lock = threading.Lock()
-    self._value = 0
+    self._value = 0  # GUARDED_BY(self._lock)
 
   def inc(self, n: int = 1) -> None:
     with self._lock:
@@ -79,7 +79,7 @@ class Gauge:
   def __init__(self, name: str):
     self.name = name
     self._lock = threading.Lock()
-    self._value = 0.0
+    self._value = 0.0  # GUARDED_BY(self._lock)
 
   def set(self, value: float) -> None:
     with self._lock:
@@ -113,11 +113,11 @@ class Histogram:
   def __init__(self, name: str):
     self.name = name
     self._lock = threading.Lock()
-    self._count = 0
-    self._sum = 0.0
-    self._min = math.inf
-    self._max = -math.inf
-    self._buckets: Dict[int, int] = {}
+    self._count = 0  # GUARDED_BY(self._lock)
+    self._sum = 0.0  # GUARDED_BY(self._lock)
+    self._min = math.inf  # GUARDED_BY(self._lock)
+    self._max = -math.inf  # GUARDED_BY(self._lock)
+    self._buckets: Dict[int, int] = {}  # GUARDED_BY(self._lock)
 
   def observe(self, value: float) -> None:
     value = float(value)
@@ -133,7 +133,7 @@ class Histogram:
       e = math.frexp(value)[1] if value > 0.0 else -1075
       self._buckets[e] = self._buckets.get(e, 0) + 1
 
-  def _percentile_locked(self, fraction: float) -> float:
+  def _percentile_locked(self, fraction: float) -> float:  # HOLDS(self._lock)
     if self._count == 0:
       return 0.0
     target = fraction * self._count
@@ -184,8 +184,8 @@ class Registry:
 
   def __init__(self):
     self._lock = threading.Lock()
-    self._metrics: Dict[str, object] = {}
-    self._start_time = time.time()
+    self._metrics: Dict[str, object] = {}  # GUARDED_BY(self._lock)
+    self._start_time = time.time()  # GUARDED_BY(self._lock)
 
   def _get(self, name: str, cls):
     with self._lock:
@@ -260,10 +260,12 @@ class Registry:
     ``/metricsz`` and ``dump_report`` reflect the whole job without this
     module importing anything beyond stdlib.
     """
+    with self._lock:
+      start_time = self._start_time
     out: Dict[str, object] = {
         'kind': 'metrics_report',
         'pid': os.getpid(),
-        'uptime_sec': round(time.time() - self._start_time, 3),
+        'uptime_sec': round(time.time() - start_time, 3),
         'metrics': self.snapshot(),
     }
     with _providers_lock:
@@ -322,7 +324,7 @@ class Scope:
 # Named extra sections merged into every report() — see Registry.report.
 # Process-global like the registry itself; guarded by its own lock so
 # providers can (un)register from any thread.
-_report_providers: Dict[str, object] = {}
+_report_providers: Dict[str, object] = {}  # GUARDED_BY(_providers_lock)
 _providers_lock = threading.Lock()
 
 

@@ -113,13 +113,14 @@ class MetricsServer:
     self.close()
 
 
-_GLOBAL: Optional[MetricsServer] = None
+_GLOBAL: Optional[MetricsServer] = None  # GUARDED_BY(_GLOBAL_LOCK)
 _GLOBAL_LOCK = threading.Lock()
 
 
 def global_server() -> Optional[MetricsServer]:
   """The process-wide server started by :func:`maybe_start`, if any."""
-  return _GLOBAL
+  with _GLOBAL_LOCK:
+    return _GLOBAL
 
 
 def maybe_start(port: Optional[int] = None) -> Optional[MetricsServer]:

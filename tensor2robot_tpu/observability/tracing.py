@@ -50,9 +50,9 @@ __all__ = [
 _EPOCH = time.perf_counter()
 
 _lock = threading.Lock()
-_events: Optional[List[dict]] = None  # None = capture off
-_events_cap = 0
-_dropped = 0
+_events: Optional[List[dict]] = None  # None = capture off  # GUARDED_BY(_lock)
+_events_cap = 0  # GUARDED_BY(_lock)
+_dropped = 0  # GUARDED_BY(_lock)
 
 
 _ANNOTATION_CLS = None  # lazily resolved; False = unavailable
@@ -117,6 +117,8 @@ class span:  # noqa: N801 - context manager used as a function
       self._ann.__exit__(None, None, None)
       self._ann = None
     metrics.histogram(self._name + '_ms').observe((t1 - self._t0) * 1e3)
+    # ANALYSIS_OK(lock-discipline): racy fast-path probe on the hot
+    # span exit; _record_event re-checks under the lock before writing.
     if _events is not None:
       _record_event(self._name, self._t0, t1)
     return False
@@ -160,6 +162,8 @@ def stop_capture() -> List[dict]:
 
 
 def capturing() -> bool:
+  # ANALYSIS_OK(lock-discipline): advisory single-read probe; callers
+  # must not (and do not) make correctness decisions on it.
   return _events is not None
 
 
@@ -176,15 +180,16 @@ def capture(max_events: int = 200_000) -> Iterator[List[dict]]:
 
 def chrome_trace(events: Optional[List[dict]] = None) -> Dict[str, object]:
   """Wraps events as a Chrome-trace JSON object (Perfetto-loadable)."""
-  if events is None:
-    with _lock:
+  with _lock:
+    if events is None:
       events = list(_events) if _events is not None else []
+    dropped = _dropped
   return {
       'traceEvents': events,
       'displayTimeUnit': 'ms',
       'metadata': {
           'producer': 'tensor2robot_tpu.observability.tracing',
-          'dropped_events': _dropped,
+          'dropped_events': dropped,
       },
   }
 

@@ -22,7 +22,7 @@ runs this same module and the mesh spans all devices.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np

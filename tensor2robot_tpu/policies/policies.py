@@ -12,7 +12,7 @@ is a single device call per iteration.
 from __future__ import annotations
 
 import abc
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Optional
 
 import numpy as np
 

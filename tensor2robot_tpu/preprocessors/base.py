@@ -21,7 +21,6 @@ from __future__ import annotations
 import abc
 from typing import Callable, Optional, Tuple
 
-from tensor2robot_tpu import modes
 from tensor2robot_tpu.specs import SpecStruct, algebra
 
 SpecGetter = Callable[[str], SpecStruct]

@@ -14,7 +14,7 @@ All functions take images as float arrays in [0, 1] with shape
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -9,15 +9,14 @@ goal image gets its own encoder, and training enforces
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tensor2robot_tpu.models.base import AbstractT2RModel, merge_variables
+from tensor2robot_tpu.models.base import AbstractT2RModel
 from tensor2robot_tpu.modes import ModeKeys
-from tensor2robot_tpu.preprocessors import image_transformations
 from tensor2robot_tpu.preprocessors.base import SpecTransformationPreprocessor
 from tensor2robot_tpu.research.grasp2vec import losses, networks
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec

@@ -8,7 +8,7 @@ accuracy. tf-slim's metric-learning losses are re-derived in jnp.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -2,7 +2,8 @@
 
 Capability-equivalent of
 ``/root/reference/research/grasp2vec/visualization.py`` — in particular
-``_GetSoftMaxResponse``: correlate a goal embedding against a spatial
+its ``_GetSoftMaxResponse`` (here :func:`get_softmax_response`):
+correlate a goal embedding against a spatial
 feature map and return the soft-argmax response (the instance-localization
 mechanism evaluated in the paper).
 """
@@ -43,7 +44,3 @@ def heatmap_keypoints(goal_embedding: jnp.ndarray,
   heatmap = jnp.einsum('bhwc,bc->bhw', scene_spatial, goal_embedding)
   points, _ = spatial_softmax(heatmap[..., None])
   return points
-
-
-# Reference-name alias.
-_GetSoftMaxResponse = get_softmax_response

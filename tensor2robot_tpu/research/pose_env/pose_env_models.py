@@ -13,7 +13,6 @@ Capability-equivalent of
 
 from __future__ import annotations
 
-from typing import Optional
 
 import flax.linen as nn
 import jax

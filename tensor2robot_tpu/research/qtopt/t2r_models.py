@@ -15,7 +15,6 @@ Capability-equivalent of ``/root/reference/research/qtopt/t2r_models.py``:
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +27,7 @@ from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.preprocessors import image_transformations
 from tensor2robot_tpu.preprocessors.base import SpecTransformationPreprocessor
 from tensor2robot_tpu.research.qtopt import networks, optimizer_builder
-from tensor2robot_tpu.specs import SpecStruct, TensorSpec, algebra
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 
 INPUT_SHAPE = (512, 640, 3)
 TARGET_SHAPE = (472, 472)

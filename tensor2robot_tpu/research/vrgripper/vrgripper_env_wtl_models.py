@@ -18,16 +18,15 @@ Capability-equivalent of
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tensor2robot_tpu.layers import mdn as mdn_lib
 from tensor2robot_tpu.layers import tec, vision_layers
-from tensor2robot_tpu.meta_learning import meta_tfdata, preprocessors
+from tensor2robot_tpu.meta_learning import preprocessors
 from tensor2robot_tpu.models.base import FlaxModel
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (

@@ -71,8 +71,6 @@ import numpy as np
 
 from tensor2robot_tpu.observability import metrics as metrics_lib
 
-_NANOS_PER_MS = 1e6
-
 
 class ServingError(Exception):
   """Base class for serving-plane failures."""
@@ -338,10 +336,14 @@ class DynamicBatcher:
     self._clock = clock
 
     self._cond = threading.Condition()
-    self._pending: collections.deque = collections.deque()
-    self._closed = False
-    self._model = None  # executor of the serving generation
-    self._pending_model = None  # prepared by reload, adopted by dispatcher
+    self._pending: collections.deque = collections.deque()  # GUARDED_BY(self._cond)
+    self._closed = False  # GUARDED_BY(self._cond)
+    # Model-generation handoff state. Three threads touch these: the
+    # reload poller stages, the dispatcher adopts, clients read the
+    # live version — all under the one condition lock (uncontended in
+    # steady state: the dispatcher touches it once per dispatch).
+    self._model = None  # GUARDED_BY(self._cond)
+    self._pending_model = None  # GUARDED_BY(self._cond)
     self._feature_spec = None
     self._dispatcher: Optional[threading.Thread] = None
     self._reloader: Optional[threading.Thread] = None
@@ -384,11 +386,13 @@ class DynamicBatcher:
     self._predictor.assert_is_loaded()
     if self._quantize == 'off':
       self._m_quant_active.set(0.0)  # registry is process-global
-    self._model = self._build_executor(reuse_from=None)
-    self._model.warm()
+    model = self._build_executor(reuse_from=None)
+    model.warm()
+    with self._cond:
+      self._model = model
     self._feature_spec = self._predictor.get_feature_specification()
-    self._m_version.set(float(self._model.version))
-    self._m_param_bytes.set(float(self._model.param_bytes))
+    self._m_version.set(float(model.version))
+    self._m_param_bytes.set(float(model.param_bytes))
     self._dispatcher = threading.Thread(
         target=self._dispatch_loop, daemon=True, name='t2r-serving-dispatch')
     self._dispatcher.start()
@@ -429,7 +433,8 @@ class DynamicBatcher:
 
   @property
   def model_version(self) -> int:
-    model = self._model
+    with self._cond:
+      model = self._model
     return -1 if model is None else int(model.version)
 
   @property
@@ -530,16 +535,31 @@ class DynamicBatcher:
       self._m_queue_depth.set(float(len(self._pending)))
       return batch
 
+  def _adopt_pending_model(self):
+    """Atomically takes a staged generation and makes it live.
+
+    Read-and-clear MUST be one critical section: the reload poller can
+    stage a newer generation between a bare read and a later clear, and
+    that staging would be silently dropped (the plane then serves the
+    old model until the next poll happens to catch the version skew —
+    found by the lock-discipline checker, PR 8).
+    """
+    with self._cond:
+      pending = self._pending_model
+      if pending is None:
+        return None
+      self._pending_model = None
+      self._model = pending
+    return pending
+
   def _dispatch_loop(self) -> None:
     while True:
       batch = self._assemble()
       if batch is None:
         return
       # Hot swap point: strictly BETWEEN dispatches, never under one.
-      pending = self._pending_model
+      pending = self._adopt_pending_model()
       if pending is not None:
-        self._pending_model = None
-        self._model = pending
         self._m_swaps.inc()
         self._m_version.set(float(pending.version))
         self._m_param_bytes.set(float(pending.param_bytes))
@@ -549,7 +569,8 @@ class DynamicBatcher:
 
   def _execute(self, batch: List[_Request]) -> None:
     total = sum(r.n for r in batch)
-    model = self._model
+    with self._cond:
+      model = self._model
     t0 = self._clock()
     try:
       if len(batch) == 1:
@@ -679,13 +700,15 @@ class DynamicBatcher:
     try:
       if not self._predictor.restore():
         return False
-      current = self._pending_model or self._model
+      with self._cond:
+        current = self._pending_model or self._model
       if (int(self._predictor.model_version) == current.version and
           self._same_generation(current)):
         return False
       new_model = self._build_executor(reuse_from=current)
       new_model.warm()  # compile before adoption: swap cost ~pointer swap
-      self._pending_model = new_model
+      with self._cond:
+        self._pending_model = new_model
       return True
     except Exception as e:  # pylint: disable=broad-except
       self._m_reload_errors.inc()

@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import collections
 from collections import abc as collections_abc
-from typing import Any, Mapping, Optional, Union
+from typing import Any, Mapping, Union
 
 import numpy as np
 
 from tensor2robot_tpu.specs.spec_struct import SpecStruct
-from tensor2robot_tpu.specs.tensor_spec import TensorSpec, as_dtype
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
 
 _SEP = '/'
 

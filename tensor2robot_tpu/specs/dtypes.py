@@ -10,7 +10,6 @@ code and exported artifacts in float32.
 
 from __future__ import annotations
 
-from typing import Any
 
 import numpy as np
 

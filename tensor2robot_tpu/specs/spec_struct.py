@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import collections
 from collections import abc as collections_abc
-from typing import Any, Iterator, Optional
+from typing import Any, Iterator
 
 import numpy as np
 

@@ -36,7 +36,6 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.observability import metrics as metrics_lib

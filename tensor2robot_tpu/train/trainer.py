@@ -38,7 +38,7 @@ from tensor2robot_tpu.observability import memory as memory_lib
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
-from tensor2robot_tpu.specs import SpecStruct, algebra
+from tensor2robot_tpu.specs import SpecStruct
 from tensor2robot_tpu.train import checkpoints as ckpt_lib
 from tensor2robot_tpu.train import distributed_resilience as dist_lib
 from tensor2robot_tpu.train import resilience
@@ -768,10 +768,10 @@ class Trainer:
     self._eval_step_fn = None
     # Auto (compiler-chosen) input-layout executable; built lazily from
     # the first host batch's avals (see _maybe_build_auto_step).
-    self._auto_step = None
-    self._batch_formats = None
-    self._auto_batch_avals = None
-    self._auto_disabled = not config.resolved_auto_input_layouts()
+    self._auto_step = None  # GUARDED_BY(self._auto_build_lock)
+    self._batch_formats = None  # GUARDED_BY(self._auto_build_lock)
+    self._auto_batch_avals = None  # GUARDED_BY(self._auto_build_lock)
+    self._auto_disabled = not config.resolved_auto_input_layouts()  # GUARDED_BY(self._auto_build_lock)
     self._auto_build_lock = threading.Lock()
     # Step the current dispatch started from; callbacks use crossed() so
     # their interval semantics survive steps_per_dispatch > 1.
@@ -1022,9 +1022,12 @@ class Trainer:
     jitted step. Thread-safe: the prefetcher's worker may be the first
     caller.
     """
-    if self._auto_step is not None:
+    # Double-checked fast path: both fields are written exactly once,
+    # under the build lock; a racing reader that sees a stale None just
+    # falls through to the locked re-check below.
+    if self._auto_step is not None:  # ANALYSIS_OK(lock-discipline): published-once ref; locked re-check follows
       return True
-    if self._auto_disabled or self._state is None:
+    if self._auto_disabled or self._state is None:  # ANALYSIS_OK(lock-discipline): same double-checked fast path
       return False
     with self._auto_build_lock:
       if self._auto_step is not None:
@@ -1074,9 +1077,11 @@ class Trainer:
     (e.g. a ragged final batch from an external iterator) must fall
     back to the jitted step, which retraces transparently.
     """
+    # ANALYSIS_OK(lock-discipline): immutable tuple once published under
+    # the build lock; a stale None here means "fall back to jitted".
     if self._auto_batch_avals is None:
       return False
-    treedef, avals = self._auto_batch_avals
+    treedef, avals = self._auto_batch_avals  # ANALYSIS_OK(lock-discipline): published-once immutable tuple
     leaves, td = jax.tree_util.tree_flatten(batch)
     return td == treedef and all(
         tuple(np.shape(x)) == shape and np.result_type(x) == dtype
@@ -1204,6 +1209,9 @@ class Trainer:
       use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
                   self._batch_matches_auto(batch))
       placed = mesh_lib.shard_batch(
+          # ANALYSIS_OK(lock-discipline): use_auto=True implies the build
+          # lock published _batch_formats before _maybe_build_auto_step
+          # returned (happens-before via the lock release).
           batch, self._mesh, self._batch_formats if use_auto else None,
           stacked=self._loop_k > 1)
       place_ms = (time.perf_counter() - t0) * 1e3
@@ -1302,6 +1310,8 @@ class Trainer:
         with tracing.span('trainer/wait_batch'):
           (features, labels), use_auto = next(batches)
         t_wait1 = time.perf_counter()
+        # ANALYSIS_OK(lock-discipline): published-once executable; the
+        # use_auto flag travelled with the batch from under the lock.
         step_fn = (self._auto_step if use_auto and self._auto_step is not None
                    else self._train_step_fn)
         with tracing.span('trainer/dispatch'):

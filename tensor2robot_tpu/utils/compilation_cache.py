@@ -24,12 +24,13 @@ from typing import Optional
 ENV_VAR = 'T2R_COMPILATION_CACHE_DIR'
 
 _lock = threading.Lock()
-_enabled_dir: Optional[str] = None
+_enabled_dir: Optional[str] = None  # GUARDED_BY(_lock)
 
 
 def enabled_dir() -> Optional[str]:
   """The cache dir this process enabled, or None."""
-  return _enabled_dir
+  with _lock:
+    return _enabled_dir
 
 
 def maybe_enable_compilation_cache(
@@ -46,7 +47,8 @@ def maybe_enable_compilation_cache(
   global _enabled_dir
   resolved = cache_dir or os.environ.get(ENV_VAR, '').strip() or None
   if not resolved:
-    return _enabled_dir
+    with _lock:
+      return _enabled_dir
   with _lock:
     if _enabled_dir is not None:
       if os.path.abspath(resolved) != os.path.abspath(_enabled_dir):

@@ -28,9 +28,9 @@ class ReaderWriterLock:
 
   def __init__(self):
     self._cond = threading.Condition()
-    self._active_readers = 0
-    self._writer_active = False
-    self._writers_waiting = 0
+    self._active_readers = 0  # GUARDED_BY(self._cond)
+    self._writer_active = False  # GUARDED_BY(self._cond)
+    self._writers_waiting = 0  # GUARDED_BY(self._cond)
 
   def acquire_read(self) -> None:
     with self._cond:

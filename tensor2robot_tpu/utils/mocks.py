@@ -9,10 +9,8 @@ robot dependency.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 

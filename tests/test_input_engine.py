@@ -1004,3 +1004,66 @@ class TestPlacementStage:
     for a, b in zip(jax.tree_util.tree_leaves(results['inline']),
                     jax.tree_util.tree_leaves(results['staged'])):
       np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestCloseVsResizeRace:
+  """Regression: close() vs a mid-run re-autotune grow.
+
+  PR 8's lock-discipline checker flagged close() iterating ``_threads``
+  and reading ``_num_workers`` without ``_workers_lock`` while the
+  consumer-side re-autotune path appends new worker threads — a
+  'list changed size during iteration' RuntimeError plus workers that
+  were never joined or retired. close() now snapshots the pool under
+  the lock and flips ``_closed`` first, making any later grow a no-op.
+  """
+
+  def _engine(self, workers=1, ring=8):
+    def records():
+      i = 0
+      while True:
+        yield f'rec-{i}'.encode()
+        i += 1
+
+    return engine_lib.ParallelBatchEngine(
+        records(), lambda recs: list(recs), batch_size=2,
+        num_workers=workers, ring_depth=ring)
+
+  def test_grow_after_close_is_noop(self):
+    eng = self._engine()
+    assert next(eng)  # pipeline is live
+    eng.close()
+    with eng._workers_lock:
+      n_threads = len(eng._threads)
+    eng._set_num_workers(4, input_bound=0.9, starvation=1)
+    with eng._workers_lock:
+      assert len(eng._threads) == n_threads, 'grow after close spawned'
+      assert not eng.decision_history, 'closed engine recorded a resize'
+
+  def test_concurrent_close_and_grow_never_raises(self):
+    for _ in range(15):
+      eng = self._engine(workers=1, ring=8)
+      next(eng)
+      errors = []
+      barrier = threading.Barrier(2)
+
+      def grower(eng=eng, errors=errors, barrier=barrier):
+        try:
+          barrier.wait(timeout=5)
+          for target in (2, 3, 4, 5, 6, 7):
+            eng._set_num_workers(target, input_bound=0.9, starvation=1)
+        except Exception as e:  # pragma: no cover - the regression
+          errors.append(e)
+
+      t = threading.Thread(target=grower)
+      t.start()
+      barrier.wait(timeout=5)
+      eng.close()  # pre-fix: RuntimeError iterating a growing list
+      t.join(timeout=10)
+      assert not t.is_alive()
+      assert not errors, errors
+      with eng._workers_lock:
+        threads = list(eng._threads)
+      deadline = time.monotonic() + 5
+      for thread in threads:
+        thread.join(max(0.0, deadline - time.monotonic()))
+      assert not any(th.is_alive() for th in threads)

@@ -430,7 +430,8 @@ def test_stateless_serving_fn_matches_predict():
   import jax
 
   batch = _features(0.25, n=3)
-  out = jax.jit(serving.fn)(serving.params, batch)
+  jitted_fn = jax.jit(serving.fn)
+  out = jitted_fn(serving.params, batch)
   want = predictor.predict(batch)
   np.testing.assert_allclose(np.asarray(out['a_predicted']),
                              want['a_predicted'], rtol=2e-5)
@@ -558,3 +559,64 @@ def test_restart_to_first_step_gauge(tmp_path):
   value = gauge.value
   _trained_trainer(tmp_path / 'second', steps=2)
   assert gauge.value == value
+
+
+class TestModelHandoffAtomicity:
+  """Regression: the reload→dispatcher generation handoff is atomic.
+
+  PR 8's lock-discipline checker flagged the dispatcher's bare
+  read-then-clear of ``_pending_model``: a generation staged by the
+  reload poller between those two steps was silently dropped (the plane
+  kept serving the old weights until a later poll noticed the version
+  skew). The handoff now lives in ``_adopt_pending_model`` under the
+  batcher's condition lock; these tests pin the atomic contract.
+  """
+
+  def _bare_batcher(self):
+    # No start(): the handoff state machine is exercised directly.
+    return batching_lib.DynamicBatcher(predictor=object())
+
+  def test_adopt_returns_staged_and_clears(self):
+    batcher = self._bare_batcher()
+    staged = object()
+    with batcher._cond:
+      batcher._pending_model = staged
+    assert batcher._adopt_pending_model() is staged
+    assert batcher._model is staged
+    assert batcher._pending_model is None
+    assert batcher._adopt_pending_model() is None  # nothing staged
+
+  def test_no_staged_generation_is_ever_lost(self):
+    batcher = self._bare_batcher()
+    n_stage = 400
+    adopted = []
+    done = threading.Event()
+
+    def reloader():
+      # The poller's publish step (maybe_reload's tail), hammered.
+      for i in range(n_stage):
+        with batcher._cond:
+          batcher._pending_model = ('gen', i)
+      done.set()
+
+    def dispatcher():
+      while not done.is_set() or batcher._pending_model is not None:
+        model = batcher._adopt_pending_model()
+        if model is not None:
+          adopted.append(model)
+
+    threads = [threading.Thread(target=reloader),
+               threading.Thread(target=dispatcher)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=30)
+      assert not t.is_alive()
+    # Overwritten stagings are legal (a newer generation replaces an
+    # un-adopted older one) — but the LAST staged generation must never
+    # be dropped, and adoption order must be monotonic.
+    assert adopted, 'dispatcher never adopted anything'
+    assert adopted[-1] == ('gen', n_stage - 1)
+    indices = [i for _, i in adopted]
+    assert indices == sorted(indices)
+    assert batcher._model == ('gen', n_stage - 1)

@@ -1,0 +1,698 @@
+"""Static-analysis suite tests: per-rule fixtures + the tier-1 gate.
+
+Every rule family gets a fires-on-known-bad and a stays-quiet-on-
+known-good fixture, the waiver machinery is pinned, the ReaderWriterLock
+ordering model is pinned against false cycles, and the gate test runs
+the whole suite over ``tensor2robot_tpu/`` against the checked-in
+``analysis_baseline.json`` (zero unwaived findings, baseline equality —
+the file may only shrink or change under review).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tensor2robot_tpu import analysis
+from tensor2robot_tpu.analysis import lock_discipline
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(source, path='fixture/mod.py', checkers=None):
+  module = analysis.load_source(textwrap.dedent(source), path)
+  program = analysis.Program([module])
+  findings = analysis.run_checkers(program, checkers)
+  return findings
+
+
+def _unwaived(findings, rule=None):
+  return [f for f in findings if not f.waived and
+          (rule is None or f.rule == rule)]
+
+
+def _checks(findings):
+  return sorted({(f.rule, f.check) for f in findings if not f.waived})
+
+
+# ===================================================== lock discipline
+
+
+LOCK_BAD = '''
+import threading
+
+class Queue:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._items = []  # GUARDED_BY(self._lock)
+    self._depth = 0  # GUARDED_BY(self._lock)
+
+  def push(self, x):
+    with self._lock:
+      self._items.append(x)
+    self._depth += 1      # BAD: write outside the lock
+
+  def peek(self):
+    return self._items[-1]  # BAD: read outside the lock
+'''
+
+LOCK_GOOD = '''
+import threading
+
+class Queue:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._cond = threading.Condition(self._lock)
+    self._items = []  # GUARDED_BY(self._lock)
+    self._closing = False  # GUARDED_BY(self._cond)
+
+  def push(self, x):
+    with self._lock:
+      self._items.append(x)
+      self._cond.notify_all()
+
+  def drain(self):
+    # Condition(self._lock) aliases: holding the condition IS holding
+    # the lock, in either direction.
+    with self._cond:
+      self._closing = True
+      return list(self._items)
+
+  def _peek_locked(self):  # HOLDS(self._lock)
+    return self._items[-1]
+
+  def pop(self):
+    with self._lock:
+      return self._peek_locked()
+'''
+
+LOCK_NESTED_DEF = '''
+import threading
+
+class Prefetcher:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._staged = []  # GUARDED_BY(self._lock)
+
+    def worker():
+      self._staged.append(1)  # BAD: runs on a thread, no lock
+
+    self._thread = threading.Thread(target=worker)
+'''
+
+MODULE_GLOBAL_BAD = '''
+import threading
+
+_LOCK = threading.Lock()
+_CACHE = {}  # GUARDED_BY(_LOCK)
+
+def get(name):
+  return _CACHE.get(name)  # BAD: module global outside _LOCK
+
+def put(name, value):
+  with _LOCK:
+    _CACHE[name] = value
+'''
+
+
+class TestLockDiscipline:
+
+  def test_fires_on_unguarded_access(self):
+    findings = _unwaived(_analyze(LOCK_BAD), 'lock-discipline')
+    checks = {f.check for f in findings}
+    assert checks == {'unguarded-read', 'unguarded-write'}
+    symbols = {f.symbol for f in findings}
+    assert symbols == {'Queue.push', 'Queue.peek'}
+
+  def test_quiet_on_locked_holds_and_condition_alias(self):
+    assert _unwaived(_analyze(LOCK_GOOD), 'lock-discipline') == []
+
+  def test_init_exempt_but_nested_defs_checked(self):
+    findings = _unwaived(_analyze(LOCK_NESTED_DEF), 'lock-discipline')
+    assert len(findings) == 1
+    # Mutation through a method is a READ of the guarded reference.
+    assert findings[0].check == 'unguarded-read'
+    assert 'worker' in findings[0].symbol
+
+  def test_module_global_guards(self):
+    findings = _unwaived(_analyze(MODULE_GLOBAL_BAD), 'lock-discipline')
+    assert [f.symbol for f in findings] == ['get']
+
+  def test_waiver_silences_and_requires_reason(self):
+    waived = LOCK_BAD.replace(
+        'self._depth += 1      # BAD: write outside the lock',
+        'self._depth += 1  # ANALYSIS_OK(lock-discipline): stat only',
+    ).replace(
+        'return self._items[-1]  # BAD: read outside the lock',
+        'return self._items[-1]  # ANALYSIS_OK(lock-discipline)')
+    findings = _analyze(waived)
+    # The justified waiver silences; the bare one still fails the gate.
+    assert _unwaived(findings, 'lock-discipline') == []
+    bare = _unwaived(findings, 'waiver-discipline')
+    assert len(bare) == 1
+    assert bare[0].check == 'missing-justification'
+
+  def test_waiver_does_not_bleed_from_previous_line(self):
+    bled = LOCK_BAD.replace(
+        'self._depth += 1      # BAD: write outside the lock',
+        'self._depth += 1  # ANALYSIS_OK(lock-discipline): stat only\n'
+        '    self._depth += 1')
+    findings = _unwaived(_analyze(bled), 'lock-discipline')
+    # The second (unannotated) write is still caught.
+    assert any(f.check == 'unguarded-write' for f in findings)
+
+
+# ======================================================= lock ordering
+
+
+ORDER_CYCLE = '''
+import threading
+
+class Dispatcher:
+  def __init__(self):
+    self._queue_lock = threading.Lock()
+    self._swap_lock = threading.Lock()
+
+  def dispatch(self):
+    with self._queue_lock:
+      with self._swap_lock:
+        pass
+
+  def reload(self):
+    with self._swap_lock:
+      with self._queue_lock:
+        pass
+'''
+
+ORDER_CYCLE_VIA_CALL = '''
+import threading
+
+class Engine:
+  def __init__(self):
+    self._a = threading.Lock()
+    self._b = threading.Lock()
+
+  def _under_b(self):
+    with self._b:
+      with self._a:
+        pass
+
+  def run(self):
+    with self._a:
+      self._under_b()
+'''
+
+SELF_DEADLOCK = '''
+import threading
+
+class Registry:
+  def __init__(self):
+    self._lock = threading.Lock()
+
+  def names(self):
+    with self._lock:
+      return []
+
+  def snapshot(self):
+    with self._lock:
+      return self.names()  # BAD: re-acquires a non-reentrant lock
+'''
+
+RLOCK_REENTRY_OK = '''
+import threading
+
+class Config:
+  def __init__(self):
+    self._lock = threading.RLock()
+
+  def names(self):
+    with self._lock:
+      return []
+
+  def snapshot(self):
+    with self._lock:
+      return self.names()  # fine: RLock is reentrant
+'''
+
+ORDER_CONSISTENT = '''
+import threading
+
+class Pipeline:
+  def __init__(self):
+    self._a = threading.Lock()
+    self._b = threading.Lock()
+
+  def one(self):
+    with self._a:
+      with self._b:
+        pass
+
+  def two(self):
+    with self._a:
+      with self._b:
+        pass
+'''
+
+
+class TestLockOrdering:
+
+  def _ordering(self, source, extra_files=()):
+    mods = [analysis.load_source(textwrap.dedent(source), 'fixture/m.py')]
+    for path in extra_files:
+      mod = analysis.load_module(path, REPO)
+      assert mod is not None
+      mods.append(mod)
+    return lock_discipline.check_lock_ordering(analysis.Program(mods))
+
+  def test_fires_on_lexical_cycle(self):
+    findings = self._ordering(ORDER_CYCLE)
+    assert [f.check for f in findings] == ['lock-ordering-cycle']
+    assert '_queue_lock' in findings[0].symbol
+    assert '_swap_lock' in findings[0].symbol
+
+  def test_fires_on_cycle_through_method_call(self):
+    findings = self._ordering(ORDER_CYCLE_VIA_CALL)
+    assert any('_a' in f.symbol and '_b' in f.symbol for f in findings)
+
+  def test_fires_on_self_reacquire(self):
+    findings = self._ordering(SELF_DEADLOCK)
+    assert [f.check for f in findings] == ['lock-ordering-cycle']
+    assert 'self-deadlock' in findings[0].message
+
+  def test_rlock_reentry_quiet(self):
+    assert self._ordering(RLOCK_REENTRY_OK) == []
+
+  def test_consistent_order_quiet(self):
+    assert self._ordering(ORDER_CONSISTENT) == []
+
+
+RW_CONSUMER = '''
+import threading
+
+from tensor2robot_tpu.utils.concurrency import ReaderWriterLock
+
+class Predictor:
+  """The serving-plane shape: hot predict path read-locks, reload
+  write-locks, and both touch an inner metrics-style lock."""
+
+  def __init__(self):
+    self._reload_lock = ReaderWriterLock()
+    self._stats_lock = threading.Lock()
+    self._calls = 0  # GUARDED_BY(self._stats_lock)
+
+  def predict(self, features):
+    with self._reload_lock.read_locked():
+      with self._stats_lock:
+        self._calls += 1
+      return features
+
+  def restore(self):
+    with self._reload_lock.write_locked():
+      with self._stats_lock:
+        self._calls = 0
+'''
+
+RW_GENUINE_CYCLE = '''
+import threading
+
+from tensor2robot_tpu.utils.concurrency import ReaderWriterLock
+
+class Bad:
+  def __init__(self):
+    self._rw = ReaderWriterLock()
+    self._other = threading.Lock()
+
+  def path_one(self):
+    with self._rw.read_locked():
+      with self._other:
+        pass
+
+  def path_two(self):
+    with self._other:
+      with self._rw.write_locked():
+        pass
+'''
+
+
+class TestReaderWriterLockModel:
+  """Satellite: the writer-preference RW lock's acquisition order is
+  modeled as ONE lock — its internal Condition never escapes the
+  ``*_locked`` contextmanagers, so the real serving shape (predict
+  read-locks + reload write-locks around inner locks) must produce no
+  false cycle, while a genuine RW-vs-other inversion is still caught.
+  """
+
+  CONCURRENCY = os.path.join(REPO, 'tensor2robot_tpu', 'utils',
+                             'concurrency.py')
+
+  def _ordering(self, source):
+    mods = [analysis.load_source(textwrap.dedent(source), 'fixture/rw.py'),
+            analysis.load_module(self.CONCURRENCY, REPO)]
+    return lock_discipline.check_lock_ordering(
+        analysis.Program([m for m in mods if m is not None]))
+
+  def test_no_false_cycle_for_writer_preference_usage(self):
+    assert self._ordering(RW_CONSUMER) == []
+
+  def test_real_tree_concurrency_module_is_cycle_free(self):
+    mod = analysis.load_module(self.CONCURRENCY, REPO)
+    assert lock_discipline.check_lock_ordering(
+        analysis.Program([mod])) == []
+
+  def test_genuine_rw_inversion_still_caught(self):
+    findings = self._ordering(RW_GENUINE_CYCLE)
+    assert any(f.check == 'lock-ordering-cycle' and '_rw' in f.symbol
+               for f in findings)
+
+
+# ========================================================= jit hazards
+
+
+JIT_BAD = '''
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import random
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+
+def train_step(params, batch, key):
+  t0 = time.perf_counter()                      # BAD host effect
+  metrics_lib.counter('steps').inc()            # BAD host effect
+  logging.info('step at %s', t0)                # BAD host effect
+  loss = jnp.mean(params * batch)
+  norm = np.linalg.norm(batch)                  # BAD raw numpy
+  if bool(loss > 0):                            # BAD tracer bool()
+    pass
+  noise_a = random.normal(key, batch.shape)     # first use: fine
+  noise_b = random.uniform(key, batch.shape)    # BAD key reuse
+  return loss + norm + noise_a + noise_b
+
+
+step = jax.jit(train_step)
+'''
+
+JIT_FACTORY_BAD = '''
+import jax
+import jax.numpy as jnp
+
+
+class Trainer:
+  def _step_body(self):
+    def step(state, batch):
+      print('dispatch', state)  # BAD: print inside the traced closure
+      return state + jnp.sum(batch)
+
+    return step
+
+  def build(self):
+    return jax.jit(self._step_body())
+'''
+
+JIT_SCAN_RNG_LOOP = '''
+import jax
+from jax import random
+
+
+def body(carry, x):
+  key, acc = carry
+  for _ in range(3):
+    acc = acc + random.normal(key, ())  # BAD: reused across iterations
+  return (key, acc), x
+
+
+def run(key, xs):
+  return jax.lax.scan(body, (key, 0.0), xs)
+'''
+
+JIT_GOOD = '''
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import random
+
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+
+def train_step(params, batch, key):
+  pre_key, net_key = random.split(key)
+  noise = random.normal(pre_key, batch.shape)
+  mask = random.bernoulli(net_key, 0.5, batch.shape)
+  return jnp.mean(params * batch + noise * mask)
+
+
+step = jax.jit(train_step)
+
+
+def branch_exclusive(key, flag):
+  # Branches are alternatives, not sequence: no reuse either way.
+  if flag:
+    return random.normal(key, ())
+  else:
+    return random.uniform(key, ())
+
+
+def host_loop(batches):
+  # Host code OUTSIDE any jit target: effects are its whole point.
+  t0 = time.perf_counter()
+  for batch in batches:
+    metrics_lib.counter('batches').inc()
+    step(batch['params'], batch['x'], batch['key'])
+  logging.info('done in %.1fs', time.perf_counter() - t0)
+'''
+
+
+class TestJitHazards:
+
+  def test_fires_on_all_hazard_kinds(self):
+    findings = _unwaived(_analyze(JIT_BAD), 'jit-hazard')
+    checks = {f.check for f in findings}
+    assert checks == {'host-side-effect', 'numpy-on-tracer',
+                      'tracer-leak', 'rng-key-reuse'}
+    # All three host effects (time, metrics, logging) are caught.
+    assert sum(f.check == 'host-side-effect' for f in findings) == 3
+
+  def test_factory_returned_closure_is_traced(self):
+    findings = _unwaived(_analyze(JIT_FACTORY_BAD), 'jit-hazard')
+    assert [f.check for f in findings] == ['host-side-effect']
+    assert 'print' in findings[0].message
+
+  def test_rng_reuse_across_loop_iterations(self):
+    findings = _unwaived(_analyze(JIT_SCAN_RNG_LOOP), 'jit-hazard')
+    assert any(f.check == 'rng-key-reuse' for f in findings)
+
+  def test_quiet_on_split_keys_branches_and_host_code(self):
+    assert _unwaived(_analyze(JIT_GOOD), 'jit-hazard') == []
+
+
+# =================================================== recompile hazards
+
+
+RECOMPILE_BAD = '''
+import functools
+
+import jax
+
+
+def forward(params, batch, config):
+  return params
+
+
+step = jax.jit(forward)
+
+
+def serve(params, batch):
+  return step(params, batch, {'mode': 'fast'})   # BAD dict literal
+
+
+def serve_scalar(params, batch):
+  return step(params, batch, 0.5)                # BAD scalar literal
+
+
+def hot_path(x):
+  return jax.jit(lambda v: v + 1)(x)             # BAD inline jit(lambda)
+
+
+class ExecutorCache:
+  def __init__(self):
+    self._cache = {}
+
+  def put(self, fn, exe):
+    self._cache[id(fn)] = exe                    # BAD id()-keyed cache
+
+  @functools.lru_cache(maxsize=8)
+  def program(self, n):                          # BAD lru_cache on method
+    return n
+'''
+
+RECOMPILE_GOOD = '''
+import functools
+
+import jax
+
+
+def forward(params, batch, mode):
+  return params
+
+
+step = jax.jit(forward)
+
+
+def serve(params, batch, mode):
+  return step(params, batch, mode)  # names, not literals
+
+
+@functools.lru_cache(maxsize=None)
+def layout_api():  # module-level function: stable cache key
+  return object()
+
+
+class ExecutorCache:
+  def __init__(self):
+    self._cache = {}
+
+  def put(self, program_key, exe):
+    self._cache[program_key] = exe  # content-keyed
+'''
+
+
+class TestRecompileHazards:
+
+  def test_fires_on_unstable_args_and_weak_caches(self):
+    findings = _unwaived(_analyze(RECOMPILE_BAD), 'recompile-hazard')
+    checks = [f.check for f in findings]
+    assert checks.count('weak-keyed-cache') == 2
+    assert checks.count('unstable-jit-arg') >= 3
+    messages = ' '.join(f.message for f in findings)
+    assert 'id(' in messages and 'lru_cache' in messages
+    assert 'lambda' in messages
+
+  def test_quiet_on_stable_idioms(self):
+    assert _unwaived(_analyze(RECOMPILE_GOOD), 'recompile-hazard') == []
+
+
+# ============================================================ dead code
+
+
+DEAD_BAD = '''
+import json
+import os
+import sys as system
+
+_UNUSED_LIMIT = 32
+
+
+def parse(path):
+  backup = path
+  with open(path) as f:
+    return json.load(f)
+'''
+
+DEAD_GOOD = '''
+import json
+
+_LIMIT = 32
+
+
+def parse(path, fallback=None):
+  _ = fallback  # deliberate discard: underscore is exempt
+  size = _LIMIT
+  with open(path) as f:
+    return json.load(f), size
+'''
+
+
+class TestDeadCode:
+
+  def test_fires_on_unused_bindings(self):
+    findings = _unwaived(_analyze(DEAD_BAD), 'dead-code')
+    by_check = {}
+    for f in findings:
+      by_check.setdefault(f.check, []).append(f.symbol)
+    assert sorted(by_check['unused-import']) == ['os', 'system']
+    assert by_check['unused-private-global'] == ['_UNUSED_LIMIT']
+    assert by_check['unused-local'] == ['parse.backup']
+
+  def test_quiet_on_used_and_underscore(self):
+    assert _unwaived(_analyze(DEAD_GOOD), 'dead-code') == []
+
+  def test_package_init_reexports_exempt(self):
+    source = 'from tensor2robot_tpu.analysis import core\n'
+    findings = _unwaived(
+        _analyze(source, path='fixture/__init__.py'), 'dead-code')
+    assert findings == []
+
+
+# ================================================================ gate
+
+
+class TestTier1Gate:
+  """The suite over the real tree vs the checked-in baseline."""
+
+  BASELINE = os.path.join(REPO, 'analysis_baseline.json')
+
+  @pytest.fixture(scope='class')
+  def tree_findings(self):
+    program = analysis.build_program(['tensor2robot_tpu'], REPO)
+    assert len(program.modules) > 100, 'tree walk looks truncated'
+    return analysis.run_checkers(program)
+
+  def test_no_unwaived_findings(self, tree_findings):
+    unwaived = [f for f in tree_findings if not f.waived]
+    assert unwaived == [], '\n'.join(
+        f'{f.location()}: [{f.rule}:{f.check}] {f.message}'
+        for f in unwaived)
+
+  def test_waivers_match_baseline_exactly(self, tree_findings):
+    """The baseline may only shrink: every current waiver must be
+    recorded, and every recorded entry must still exist (a fixed
+    finding must delete its entry — run --write-baseline)."""
+    baseline = analysis.load_baseline(self.BASELINE)
+    waived_keys = {analysis.baseline_key(f)
+                   for f in tree_findings if f.waived}
+    assert waived_keys - set(baseline) == set(), (
+        'waived findings missing from analysis_baseline.json — '
+        'run: python tools/analyze.py --write-baseline')
+    assert set(baseline) - waived_keys == set(), (
+        'stale baseline entries (the finding was fixed): shrink the '
+        'baseline — run: python tools/analyze.py --write-baseline')
+
+  def test_baseline_has_no_silent_entries(self):
+    with open(self.BASELINE, encoding='utf-8') as f:
+      doc = json.load(f)
+    silent = [e for e in doc['waived_findings']
+              if not e.get('reason', '').strip()]
+    assert silent == [], f'baseline entries without justification: {silent}'
+
+  def test_cli_full_tree_exits_zero(self):
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'analyze.py'),
+         'tensor2robot_tpu'],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+  def test_annotated_modules_cover_the_lock_users(self):
+    """Every lock-using module named by the issue carries annotations."""
+    expected = [
+        'serving/batching.py', 'data/engine.py', 'data/native_io.py',
+        'data/input_generators.py', 'data/pipeline.py',
+        'train/trainer.py', 'observability/metrics.py',
+        'observability/tracing.py', 'observability/metricsz.py',
+        'utils/concurrency.py', 'utils/compilation_cache.py',
+        'config/gin_lite.py', 'native/__init__.py',
+    ]
+    for rel in expected:
+      path = os.path.join(REPO, 'tensor2robot_tpu', rel)
+      with open(path, encoding='utf-8') as f:
+        assert 'GUARDED_BY(' in f.read(), f'{rel} has no annotations'

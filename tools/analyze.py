@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Static-analysis CLI over ``tensor2robot_tpu/analysis``.
+
+Full-tree gate (what tier-1 runs, via tests/test_static_analysis.py):
+
+    python tools/analyze.py tensor2robot_tpu/
+
+Exit 0 iff every finding is either fixed or carries an inline
+``# ANALYSIS_OK(<rule>): <reason>`` waiver recorded in
+``analysis_baseline.json``. Unwaived findings, waivers missing from the
+baseline, and justification-free waivers all exit 1.
+
+Pre-commit fast path — analyzes ONLY files changed vs main (plus the
+working tree), typically well under 2 s:
+
+    python tools/analyze.py --diff          # vs main (or origin/main)
+    python tools/analyze.py --diff HEAD~1   # any base ref
+
+Other modes:
+
+    python tools/analyze.py --json ...          # machine-readable
+    python tools/analyze.py --write-baseline    # regenerate baseline
+                                                # from current waivers
+    python tools/analyze.py --rules dead-code tensor2robot_tpu/data/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from tensor2robot_tpu import analysis  # noqa: E402
+
+
+def _diff_files(base: str) -> list:
+  """Changed .py files vs ``base`` plus uncommitted changes."""
+  candidates = []
+  for args in (['git', 'diff', '--name-only', f'{base}...HEAD'],
+               ['git', 'diff', '--name-only', 'HEAD'],
+               ['git', 'ls-files', '--others', '--exclude-standard']):
+    try:
+      out = subprocess.run(args, cwd=_REPO_ROOT, capture_output=True,
+                           text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+      continue
+    if out.returncode == 0:
+      candidates.extend(out.stdout.split())
+  return sorted({
+      c for c in candidates
+      if c.endswith('.py') and os.path.exists(os.path.join(_REPO_ROOT, c))
+  })
+
+
+def _checkers_for(rules):
+  from tensor2robot_tpu.analysis import dead_code
+  from tensor2robot_tpu.analysis import jit_hazards
+  from tensor2robot_tpu.analysis import lock_discipline
+  from tensor2robot_tpu.analysis import recompile_hazards
+
+  table = {
+      'lock-discipline': lock_discipline.check,
+      'jit-hazard': jit_hazards.check,
+      'recompile-hazard': recompile_hazards.check,
+      'dead-code': dead_code.check,
+  }
+  if not rules:
+    return None  # all
+  unknown = [r for r in rules if r not in table]
+  if unknown:
+    raise SystemExit(f'unknown rules {unknown}; known: {sorted(table)}')
+  return tuple(table[r] for r in rules)
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  parser.add_argument('paths', nargs='*',
+                      help='files/dirs to analyze (default: '
+                           'tensor2robot_tpu/)')
+  parser.add_argument('--diff', nargs='?', const='main', default=None,
+                      metavar='BASE',
+                      help='analyze only files changed vs BASE '
+                           '(default main) + the working tree')
+  parser.add_argument('--json', action='store_true', dest='as_json',
+                      help='JSON output')
+  parser.add_argument('--baseline',
+                      default=os.path.join(_REPO_ROOT,
+                                           'analysis_baseline.json'))
+  parser.add_argument('--no-baseline', action='store_true',
+                      help='ignore the baseline (report waived findings '
+                           'as informational only)')
+  parser.add_argument('--write-baseline', action='store_true',
+                      help='rewrite the baseline from current waivers')
+  parser.add_argument('--rules', default='',
+                      help='comma-separated rule families to run '
+                           '(default: all)')
+  args = parser.parse_args(argv)
+
+  if args.diff is not None:
+    paths = _diff_files(args.diff)
+    if not paths:
+      print('analyze: no changed .py files vs '
+            f'{args.diff}; nothing to do.')
+      return 0
+  else:
+    paths = args.paths or ['tensor2robot_tpu']
+
+  checkers = _checkers_for(
+      [r.strip() for r in args.rules.split(',') if r.strip()])
+  program = analysis.build_program(paths, _REPO_ROOT)
+  findings = analysis.run_checkers(program, checkers)
+
+  baseline = ({} if args.no_baseline
+              else analysis.load_baseline(args.baseline))
+  unwaived = [f for f in findings if not f.waived]
+  waived = [f for f in findings if f.waived]
+  # In --diff / subset runs the baseline may reference files outside the
+  # analyzed set; only the analyzed files' waivers are reconciled.
+  missing_from_baseline = [
+      f for f in waived
+      if not args.no_baseline and
+      analysis.baseline_key(f) not in baseline
+  ]
+
+  if args.write_baseline:
+    doc = analysis.findings_to_baseline(findings)
+    with open(args.baseline, 'w', encoding='utf-8') as f:
+      json.dump(doc, f, indent=2, sort_keys=True)
+      f.write('\n')
+    print(f'analyze: wrote {len(doc["waived_findings"])} waived '
+          f'finding(s) to {os.path.relpath(args.baseline, _REPO_ROOT)}')
+    missing_from_baseline = []
+
+  failed = bool(unwaived or missing_from_baseline)
+  if args.as_json:
+    print(json.dumps({
+        'analyzed_files': len(program.modules),
+        'findings': [f.as_dict() for f in findings],
+        'unwaived': len(unwaived),
+        'waived': len(waived),
+        'missing_from_baseline': [
+            analysis.baseline_key(f) for f in missing_from_baseline],
+        'ok': not failed,
+    }, indent=2))
+    return 1 if failed else 0
+
+  for f in unwaived:
+    print(f'{f.location()}: [{f.rule}:{f.check}] {f.message}'
+          + (f'  ({f.symbol})' if f.symbol else ''))
+  for f in missing_from_baseline:
+    print(f'{f.location()}: [{f.rule}:{f.check}] waived inline but '
+          f'MISSING from {os.path.basename(args.baseline)} — run '
+          '--write-baseline and commit the diff for review')
+  print(f'analyze: {len(program.modules)} file(s), '
+        f'{len(unwaived)} unwaived finding(s), {len(waived)} waived'
+        + ('' if not failed else ' — FAIL'))
+  return 1 if failed else 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

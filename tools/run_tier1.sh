@@ -7,6 +7,10 @@
 # isolation with the same harness, e.g.:
 #   tools/run_tier1.sh -k engine            # expression filter
 #   tools/run_tier1.sh -m engine            # marker filter
+#   tools/run_tier1.sh -m analysis          # static-analysis gate only
 #   tools/run_tier1.sh tests/test_input_engine.py
+#
+# Pre-commit fast path for the static-analysis gate alone (only files
+# changed vs main, no pytest startup): python tools/analyze.py --diff
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
