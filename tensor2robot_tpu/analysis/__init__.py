@@ -1,6 +1,6 @@
 """AST-based static-analysis suite (stdlib-only, zero runtime cost).
 
-Four rule families gate tier-1 through ``tools/analyze.py`` and
+Six rule families gate tier-1 through ``tools/analyze.py`` and
 ``tests/test_static_analysis.py``:
 
 * ``lock-discipline`` — ``# GUARDED_BY(lock)`` / ``# HOLDS(lock)``
@@ -10,6 +10,10 @@ Four rule families gate tier-1 through ``tools/analyze.py`` and
 * ``recompile-hazard`` — unstable jit arguments and weak-keyed
   executor caches.
 * ``dead-code`` — unused imports, locals, private globals.
+* ``blocking-under-lock`` — ``.join()``/``.get()``/``device_get`` (and
+  other indefinite waits) inside a ``with lock:`` block.
+* ``donated-reuse`` — reads of an array after it was passed through
+  ``donate_argnums`` / a donated ``lax.scan`` carry.
 
 Waivers are inline ``# ANALYSIS_OK(<rule>): <reason>`` — the reason is
 mandatory. See README "Static analysis" for the workflow.

@@ -1,0 +1,156 @@
+"""Blocking-call-under-lock checker.
+
+A thread that blocks while holding a lock is one handshake away from a
+deadlock: the operation it waits on (a worker joining, a queue filling,
+a device transfer draining) frequently needs that same lock — or a lock
+ordered after it — to make progress. The engine-iterator release waiver
+in PR 8 documents a REAL instance of the shape: ``__next__`` holding the
+position lock while blocked on the ring awaiting the very ``release()``
+that needs the loop to advance. This checker flags the mechanical
+signature so the next one never lands:
+
+* ``.join()`` (thread/process join: no positional args, or a single
+  numeric timeout) inside a ``with <lock>:`` block — ``', '.join(parts)``
+  and other string joins are excluded by their non-numeric argument;
+* ``.get()`` / ``.result()`` with no positional args (queue/future
+  blocking reads; ``d.get(key)`` dict lookups have arguments and are
+  excluded) and their ``timeout=``/``block=`` keyword forms;
+* ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` — a device
+  sync can stall for a full dispatch (or forever, when the data plane is
+  wedged — the exact regime the distributed control plane exists for);
+* ``.wait_until_finished()`` — Orbax's async-checkpoint drain, which in
+  multi-host runs barriers across the job.
+
+A ``with`` target counts as a lock when it is (a) an attribute/global
+assigned from ``threading.Lock/RLock/Condition`` (or a
+``ReaderWriterLock``) anywhere in the module, (b) a
+``rw.read_locked()``/``rw.write_locked()`` context manager, or (c) a
+name whose final component looks lock-ish (``lock``/``cond``/
+``mutex``/``mu``). Waive genuinely-bounded cases inline with
+``# ANALYSIS_OK(blocking-under-lock): <why the wait cannot need the
+lock>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'blocking-under-lock'
+CHECK = 'blocking-call-under-lock'
+
+_LOCK_CTORS = {
+    'threading.Lock', 'threading.RLock', 'threading.Condition',
+    'Lock', 'RLock', 'Condition', 'ReaderWriterLock',
+    'concurrency.ReaderWriterLock',
+}
+_RW_METHODS = {'read_locked', 'write_locked'}
+_NAME_HINTS = ('lock', 'cond', 'mutex', 'mu')
+
+# Leaf call names that always block regardless of arguments.
+_ALWAYS_BLOCKING = {'device_get', 'block_until_ready',
+                    'wait_until_finished'}
+_BLOCK_KWARGS = {'timeout', 'block', 'timeout_secs', 'timeout_in_ms'}
+
+
+def _known_locks(module: core.ModuleInfo) -> Set[str]:
+  """Attr/global names assigned a lock constructor anywhere in the
+  module — ``self._lock = threading.Lock()`` yields ``self._lock``."""
+  locks: Set[str] = set()
+  for node in ast.walk(module.tree):
+    if not isinstance(node, ast.Assign):
+      continue
+    value = node.value
+    if not isinstance(value, ast.Call):
+      continue
+    name = core.call_name(value)
+    if name is None:
+      continue
+    if name in _LOCK_CTORS or name.rsplit('.', 1)[-1] in (
+        'Lock', 'RLock', 'Condition', 'ReaderWriterLock'):
+      for target in node.targets:
+        text = core.expr_text(target)
+        if text is not None:
+          locks.add(text)
+  return locks
+
+
+def _lock_of_withitem(item: ast.withitem,
+                      known: Set[str]) -> Optional[str]:
+  """The lock a withitem holds, or None when it is not lock-shaped."""
+  ctx = item.context_expr
+  text = core.expr_text(ctx)
+  if text is not None:
+    leaf = text.rsplit('.', 1)[-1].lower().strip('_')
+    if text in known or any(h in leaf for h in _NAME_HINTS):
+      return text
+    return None
+  if isinstance(ctx, ast.Call):
+    name = core.call_name(ctx)
+    if name is not None:
+      base, _, leaf = name.rpartition('.')
+      if leaf in _RW_METHODS and base:
+        return base
+  return None
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+  name = core.call_name(call)
+  if name is None:
+    return None
+  leaf = name.rsplit('.', 1)[-1]
+  if leaf in _ALWAYS_BLOCKING:
+    return (f'{leaf}() synchronizes with the device/writer and can '
+            'stall indefinitely')
+  has_receiver = '.' in name
+  kwargs = {kw.arg for kw in call.keywords if kw.arg}
+  if leaf == 'join' and has_receiver:
+    if not call.args and not (kwargs - _BLOCK_KWARGS):
+      return ('join() blocks until the target thread/process exits — '
+              'which may itself need this lock')
+    if (len(call.args) == 1 and isinstance(call.args[0], ast.Constant)
+        and isinstance(call.args[0].value, (int, float))):
+      return 'join(timeout) still blocks for the full timeout'
+  if leaf in ('get', 'result') and has_receiver:
+    if not call.args and not (kwargs - _BLOCK_KWARGS):
+      return (f'{leaf}() on a queue/future blocks until a producer runs '
+              '— which may itself need this lock')
+  return None
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+  known = _known_locks(module)
+
+  def symbol_of(node: ast.AST) -> str:
+    enclosing = module.enclosing(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return core.qualname(module, enclosing) if enclosing else ''
+
+  def scan_with(with_node: ast.With, lock_text: str) -> None:
+    for stmt in with_node.body:
+      for node in core.walk_scope(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+          continue  # nested defs run later, not under this lock
+        if isinstance(node, ast.Call):
+          reason = _blocking_reason(node)
+          if reason is not None:
+            findings.append(core.Finding(
+                rule=RULE, check=CHECK, path=module.rel_path,
+                line=node.lineno, symbol=symbol_of(node),
+                message=(f'blocking call while holding {lock_text!r}: '
+                         f'{reason}. Snapshot under the lock, then '
+                         'block outside it.')))
+
+  for node in ast.walk(module.tree):
+    if isinstance(node, ast.With):
+      for item in node.items:
+        lock_text = _lock_of_withitem(item, known)
+        if lock_text is not None:
+          scan_with(node, lock_text)
+  return findings

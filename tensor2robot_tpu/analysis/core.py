@@ -42,7 +42,8 @@ __all__ = [
 # Rule families (each checker owns one; waivers may name the family or
 # 'family:check' for a specific sub-rule).
 ALL_RULES = ('lock-discipline', 'jit-hazard', 'recompile-hazard',
-             'dead-code', 'waiver-discipline')
+             'dead-code', 'blocking-under-lock', 'donated-reuse',
+             'waiver-discipline')
 
 _GUARDED_BY_RE = re.compile(r'GUARDED_BY\(\s*([^)]+?)\s*\)')
 _HOLDS_RE = re.compile(r'HOLDS\(\s*([^)]+?)\s*\)')
@@ -242,14 +243,17 @@ def apply_waivers(module: ModuleInfo,
 
 def run_checkers(program: Program, checkers=None) -> List[Finding]:
   """Runs every checker over every module + the program-level passes."""
+  from tensor2robot_tpu.analysis import blocking_under_lock
   from tensor2robot_tpu.analysis import dead_code
+  from tensor2robot_tpu.analysis import donated_reuse
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
   from tensor2robot_tpu.analysis import recompile_hazards
 
   if checkers is None:
     checkers = (lock_discipline.check, jit_hazards.check,
-                recompile_hazards.check, dead_code.check)
+                recompile_hazards.check, dead_code.check,
+                blocking_under_lock.check, donated_reuse.check)
   findings: List[Finding] = []
   for module in program.modules:
     for checker in checkers:

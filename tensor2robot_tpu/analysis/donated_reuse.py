@@ -1,0 +1,213 @@
+"""Donated-buffer-reuse checker.
+
+``donate_argnums`` hands an argument's device buffers to XLA for in-place
+reuse: after the call, the CALLER's array is invalid — reading it
+returns garbage or raises a deleted-buffer error, but only on backends
+that actually donate (TPU), so the bug ships silently past CPU tests.
+The idiomatic pattern rebinds the result over the donated name
+(``state = step(state, batch)``), which this checker recognizes. Three
+finding shapes (the bug class ``train_state.py``'s donation comments
+warn about):
+
+* ``use-after-donate`` — a name passed at a donated position of a
+  known-donating callable (a name bound from ``jax.jit(...,
+  donate_argnums=...)`` directly or via a local factory that returns
+  one) is READ later in the same scope without being rebound first.
+* ``aliased-donation`` — the same name appears at a donated position
+  AND anywhere else in the same call's arguments: two views of one
+  buffer enter the program, one of them donated — the "sharing buffers
+  would donate the same buffer twice" hazard that forces
+  ``ema_params`` to start as a copy.
+* ``stale-scan-carry`` — the INIT carry passed to ``lax.scan`` is read
+  after the scan whose result was bound to a different name. XLA
+  updates the carry in place across iterations (donated scan carry);
+  outside a trace the buffer is gone, and even inside one, reading the
+  pre-scan value where the result exists is almost always a stale-value
+  bug (the result name was bound for a reason).
+
+Waive intentional reads inline with ``# ANALYSIS_OK(donated-reuse):
+<why the buffer is still valid / the read is pre-donation on every
+backend>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'donated-reuse'
+
+_JIT_WRAPPERS = {'jax.jit', 'jit', 'jax.pjit', 'pjit'}
+_SCAN_NAMES = {'lax.scan', 'jax.lax.scan'}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+  """The donate_argnums of a jit(...) call, or None when absent."""
+  if core.call_name(call) not in _JIT_WRAPPERS:
+    return None
+  for kw in call.keywords:
+    if kw.arg not in ('donate_argnums', 'donate_argnames'):
+      continue
+    value = kw.value
+    if isinstance(value, ast.Constant) and isinstance(value.value, int):
+      return (value.value,)
+    if isinstance(value, (ast.Tuple, ast.List)):
+      out = []
+      for elt in value.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+          out.append(elt.value)
+      return tuple(out)
+    return ()  # dynamic spec: donating, positions unknown
+  return None
+
+
+def _donating_names(module: core.ModuleInfo) -> Dict[str, Tuple[int, ...]]:
+  """Names bound to donating jitted callables → donated positions."""
+  # Local factories whose return value is a donating jit.
+  factory_positions: Dict[str, Tuple[int, ...]] = {}
+  for fn in core.func_defs(module.tree):
+    for node in ast.walk(fn):
+      if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+        positions = _donated_positions(node.value)
+        if positions:
+          factory_positions[fn.name] = positions
+  donating: Dict[str, Tuple[int, ...]] = {}
+  for node in ast.walk(module.tree):
+    if not isinstance(node, ast.Assign):
+      continue
+    value = node.value
+    positions: Optional[Tuple[int, ...]] = None
+    if isinstance(value, ast.Call):
+      positions = _donated_positions(value)
+      if not positions:
+        name = core.call_name(value)
+        if name is not None:
+          leaf = name.rsplit('.', 1)[-1]
+          positions = factory_positions.get(name,
+                                            factory_positions.get(leaf))
+    if positions:
+      for target in node.targets:
+        text = core.expr_text(target)
+        if text is not None:
+          donating[text] = positions
+  return donating
+
+
+def _assigned_names(stmt: ast.AST) -> Set[str]:
+  """Names (re)bound by the statement containing a call."""
+  out: Set[str] = set()
+  targets: Iterable[ast.AST] = ()
+  if isinstance(stmt, ast.Assign):
+    targets = stmt.targets
+  elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+    targets = (stmt.target,)
+  for target in targets:
+    for node in ast.walk(target):
+      if isinstance(node, ast.Name):
+        out.add(node.id)
+  return out
+
+
+def _containing_stmt(module: core.ModuleInfo, node: ast.AST) -> ast.AST:
+  cur, parent = node, module.parent(node)
+  while parent is not None and not isinstance(parent, (
+      ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+    cur, parent = parent, module.parent(parent)
+  return cur
+
+
+def _reads_after(scope: ast.AST, names: Set[str], after_line: int
+                 ) -> Dict[str, ast.Name]:
+  """First read of each watched name after ``after_line`` in ``scope``,
+  with a later rebind killing the watch for lines beyond it."""
+  rebinds: Dict[str, int] = {}
+  for node in core.walk_scope(scope):
+    if isinstance(node, ast.Name) and isinstance(
+        node.ctx, (ast.Store,)) and node.id in names:
+      if node.lineno > after_line:
+        line = rebinds.get(node.id)
+        rebinds[node.id] = min(line, node.lineno) if line else node.lineno
+  first_reads: Dict[str, ast.Name] = {}
+  for node in core.walk_scope(scope):
+    if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+      continue
+    if node.id not in names or node.lineno <= after_line:
+      continue
+    rebind_line = rebinds.get(node.id)
+    if rebind_line is not None and node.lineno > rebind_line:
+      continue  # rebound before this read
+    seen = first_reads.get(node.id)
+    if seen is None or node.lineno < seen.lineno:
+      first_reads[node.id] = node
+  return first_reads
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+  donating = _donating_names(module)
+
+  def scopes():
+    yield module.tree
+    yield from core.func_defs(module.tree)
+
+  for scope in scopes():
+    for node in core.walk_scope(scope):
+      if not isinstance(node, ast.Call):
+        continue
+      name = core.call_name(node)
+      if name is None:
+        continue
+      symbol = core.qualname(module, scope) if isinstance(
+          scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else ''
+      if name in donating:
+        positions = donating[name]
+        donated = {node.args[i] for i in positions if i < len(node.args)}
+        donated_names = {a.id for a in donated if isinstance(a, ast.Name)}
+        # aliased-donation: the same name enters the call twice with at
+        # least one donated position.
+        all_arg_names = [a.id for a in node.args
+                         if isinstance(a, ast.Name)]
+        for dup in sorted(donated_names):
+          if all_arg_names.count(dup) > 1:
+            findings.append(core.Finding(
+                rule=RULE, check='aliased-donation', path=module.rel_path,
+                line=node.lineno, symbol=symbol,
+                message=(f'{dup!r} is passed to donating {name}(...) '
+                         'more than once with a donated position: both '
+                         'views share one buffer and XLA will reuse it '
+                         'in place — pass a copy for the second view')))
+        stmt = _containing_stmt(module, node)
+        watch = donated_names - _assigned_names(stmt)
+        for read_name, read in sorted(
+            _reads_after(scope, watch, node.lineno).items()):
+          findings.append(core.Finding(
+              rule=RULE, check='use-after-donate', path=module.rel_path,
+              line=read.lineno, symbol=symbol,
+              message=(f'{read_name!r} was donated to {name}(...) at '
+                       f'line {node.lineno} (donate_argnums) — its '
+                       'device buffer is invalid after the call on '
+                       'donating backends. Rebind the result over it, '
+                       'or read before the call.')))
+      elif name in _SCAN_NAMES and len(node.args) >= 2:
+        init = node.args[1]
+        if not isinstance(init, ast.Name):
+          continue
+        stmt = _containing_stmt(module, node)
+        if init.id in _assigned_names(stmt):
+          continue  # carry rebound over itself: the idiomatic form
+        reads = _reads_after(scope, {init.id}, node.lineno)
+        if init.id in reads:
+          findings.append(core.Finding(
+              rule=RULE, check='stale-scan-carry', path=module.rel_path,
+              line=reads[init.id].lineno, symbol=symbol,
+              message=(f'{init.id!r} is the initial carry of the '
+                       f'lax.scan at line {node.lineno}, read again '
+                       'after the scan: the carry buffer is donated '
+                       'across iterations (XLA updates it in place) and '
+                       'the pre-scan value is stale where the scan '
+                       'result exists — use the returned carry.')))
+  return findings

@@ -130,6 +130,93 @@ def mesh_spans_processes(mesh: Mesh) -> bool:
   return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
+# ----------------------------------------------- elastic checkpoint views
+
+SAVE_AXIS = 'save'
+
+
+def participant_devices(participants: Optional[Sequence[int]] = None):
+  """All devices belonging to ``participants`` (process indices).
+
+  ``None`` means every process in the job. Order follows
+  ``jax.devices()`` (identical on every host), so the save mesh built
+  from it is consistent job-wide without communication.
+  """
+  devices = jax.devices()
+  if participants is None:
+    return list(devices)
+  wanted = set(int(p) for p in participants)
+  return [d for d in devices if d.process_index in wanted]
+
+
+def global_save_mesh(participants: Optional[Sequence[int]] = None) -> Mesh:
+  """A 1-D mesh over the participants' devices, used ONLY for payload io.
+
+  Checkpoint writes never run an XLA program over this mesh — it exists
+  so each leaf can be expressed as one global ``jax.Array`` whose shards
+  are distributed across hosts, letting Orbax's multiprocess writers
+  stripe the payload (every host writes its own shards). That makes it
+  safe on backends whose XLA build cannot execute cross-process programs
+  (array construction and serialization are pure metadata + local
+  device_puts).
+  """
+  devices = participant_devices(participants)
+  return Mesh(np.asarray(devices).reshape((len(devices),)), (SAVE_AXIS,))
+
+
+def save_sharding_for(mesh: Mesh, leaf) -> NamedSharding:
+  """IO sharding for one state leaf on the 1-D save mesh.
+
+  The largest dim divisible by the device count is striped over
+  ``save``; leaves with no divisible dim (scalars, rng keys, small
+  biases) stay replicated — Orbax then writes exactly one copy (the
+  replica-0 shard), so small leaves cost one writer, big leaves cost
+  every writer 1/N of the bytes.
+  """
+  n = mesh.devices.size
+  shape = tuple(getattr(leaf, 'shape', ()) or ())
+  if n <= 1 or not shape:
+    return NamedSharding(mesh, P())
+  candidates = [(dim, i) for i, dim in enumerate(shape) if dim % n == 0]
+  if not candidates:
+    return NamedSharding(mesh, P())
+  _, idx = max(candidates)
+  spec = [None] * len(shape)
+  spec[idx] = SAVE_AXIS
+  return NamedSharding(mesh, P(*spec))
+
+
+def build_global_save_view(tree: Any, mesh: Mesh) -> Any:
+  """Re-expresses a host-local state tree as global arrays on ``mesh``.
+
+  Used by the sharded checkpoint path when training runs per-host
+  replica groups (``create_local_mesh``): every host holds the full
+  (replicated, lockstep) state, and this view assigns each host the
+  slices it is responsible for WRITING. Each process materializes only
+  its addressable shards (``jax.make_array_from_callback`` device_puts
+  local slices; no collectives), so a 2-host job writes each striped
+  leaf half-and-half. States already sharded over a process-spanning
+  mesh (true FSDP on a pod) skip this view and save their arrays
+  directly — re-slicing them would force an all-gather.
+
+  Leaves must be HOST data (numpy, post ``device_get``); non-array
+  leaves (python ints) pass through for Orbax's aggregate writer.
+  """
+
+  def to_global(x):
+    arr = np.asarray(x)
+    sharding = save_sharding_for(mesh, arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx, a=arr: a[idx])
+
+  def view(x):
+    if isinstance(x, (int, float)) or x is None:
+      return x
+    return to_global(x)
+
+  return jax.tree_util.tree_map(view, tree)
+
+
 def describe_topology(mesh: Optional[Mesh] = None, **extra) -> Dict[str, Any]:
   """The run topology a checkpoint is only valid within.
 

@@ -12,44 +12,94 @@ cannot delete it mid-restore (``utils/train_eval.py:590-707``).
 Atomic commit protocol (the distributed-resilience extension): every
 finished checkpoint step carries a ``commit.json`` marker recording the
 run topology (process count, mesh shape, microbatch config) and, in
-multi-process runs, an ack file from EVERY host. A checkpoint is only
-*visible* — to ``restore``, :func:`latest_checkpoint_step`, the
-continuous evaluator and the predictors — once the marker exists, which
-happens strictly after all hosts finished writing (barriered over the
-``jax.distributed`` coordination service, ``train/
-distributed_resilience.py``). A step without its marker is a TORN
-checkpoint (a save cut off by preemption or a dead host) and is skipped
-with a ``checkpoint/torn_skipped`` count; a marker whose topology does
-not match the current run fails loudly instead of silently
-misinterpreting the state. Directories written before this protocol
-(no markers anywhere) keep the PR-1 behavior: try newest, fall back on
-parse errors.
+multi-process runs, an ack file from EVERY participating host. A
+checkpoint is only *visible* — to ``restore``,
+:func:`latest_checkpoint_step`, the continuous evaluator and the
+predictors — once the marker exists, which happens strictly after all
+hosts finished writing (barriered over the ``jax.distributed``
+coordination service, ``train/distributed_resilience.py``). A step
+without its marker is a TORN checkpoint (a save cut off by preemption or
+a dead host) and is skipped with a ``checkpoint/torn_skipped`` count; a
+marker whose topology does not match the current run fails loudly
+instead of silently misinterpreting the state. Directories written
+before this protocol (no markers anywhere) keep the PR-1 behavior: try
+newest, fall back on parse errors.
+
+Elastic topology (the pod-scale extension):
+
+* **Sharded multi-host payloads** (``sharded=True``): instead of process
+  0 writing the full state, EVERY host writes its own shards through
+  Orbax's multiprocess writers (``active_processes`` = the participant
+  set, barriers over the coordination service — never an XLA
+  collective). States already laid out on a process-spanning mesh (true
+  FSDP) save their global arrays directly; per-host replica-group states
+  are re-expressed as striped global arrays first
+  (:func:`~tensor2robot_tpu.parallel.mesh.build_global_save_view`). The
+  commit marker/ack protocol is unchanged — a host killed mid-write
+  leaves the step torn and invisible.
+* **Resharding restore** (``reshape=True``): the marker's recorded
+  topology becomes a restore-time PARAMETER instead of a constraint — an
+  N-host checkpoint restores onto an M-host mesh by building target
+  shardings from the *current* mesh
+  (``parallel/mesh.state_shardings_for``) and letting Orbax reshard on
+  read. :class:`TopologyMismatchError` remains only for semantic
+  mismatches (microbatch config, steps-per-dispatch) whose silent
+  acceptance would change training, not for host/mesh shape.
+* **Async multi-host commit** (``async_commit=True``): the payload write
+  starts immediately at the save point, while the ack/marker agreement
+  rides subsequent dispatch boundaries (``poll_async_commit``) instead
+  of blocking the loop; ``checkpoint/save_overlap_ms`` records how much
+  write time was hidden. Forced saves (preemption, the final save) and
+  ``wait_until_finished`` take the synchronous barriered path, so a
+  shutdown never leaves a durable payload without its marker.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import shutil
+import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.train.distributed_resilience import (
-    DistributedContext, TopologyMismatchError)
+    DeadHostError, DistributedContext, TopologyMismatchError)
 
 COMMIT_FILENAME = 'commit.json'
 HOST_ACK_PREFIX = 'host_ack_'
+
+# Payload formats recorded in the commit marker (and surfaced by
+# tools/inspect_checkpoint.py).
+FORMAT_SINGLE_WRITER = 'single_writer'
+FORMAT_SHARDED = 'sharded'
+
+# Topology keys that describe WHERE the state lived, not WHAT it means:
+# restore(reshape=True) treats a mismatch on these as a resharding
+# request. Everything else (microbatch config, steps_per_dispatch)
+# changes training semantics and always fails loudly.
+RESHAPE_KEYS = frozenset(
+    {'process_count', 'device_count', 'mesh_shape', 'mesh_spans_processes'})
 
 # (directory, step) pairs already reported as torn, so polling callers
 # (checkpoints_iterator scans every second) count each torn checkpoint
 # once rather than once per scan.
 _REPORTED_TORN: Set[Tuple[str, int]] = set()
+
+# Test-only fault-injection hook (utils/faults.install_kill_during_save):
+# called on every host with the step number once the sharded payload
+# write has been STARTED on this host, strictly before any ack/commit —
+# the window where a SIGKILL models a host dying mid-save.
+_during_save_hook: Optional[Callable[[int], None]] = None
 
 
 def _step_dir(directory: str, step: int) -> str:
@@ -71,7 +121,8 @@ def read_commit_marker(directory: str, step: int) -> Optional[Dict[str, Any]]:
 
 def write_commit_marker(directory: str, step: int,
                         topology: Optional[Dict[str, Any]] = None,
-                        hosts: Optional[List[int]] = None) -> str:
+                        hosts: Optional[List[int]] = None,
+                        extra: Optional[Dict[str, Any]] = None) -> str:
   """Atomically publishes the commit marker for ``step``."""
   payload = {
       'step': int(step),
@@ -80,6 +131,8 @@ def write_commit_marker(directory: str, step: int,
   }
   if topology is not None:
     payload['topology'] = dict(topology)
+  if extra:
+    payload.update(extra)
   path = commit_marker_path(directory, step)
   tmp = f'{path}.tmp{os.getpid()}'
   with open(tmp, 'w') as f:
@@ -139,28 +192,53 @@ def _committed_steps(directory: str, steps: List[int],
 
 def _check_topology(saved: Optional[Dict[str, Any]],
                     expected: Optional[Dict[str, Any]],
-                    directory: str, step: int) -> None:
-  """Loud, actionable error when a checkpoint's topology mismatches."""
+                    directory: str, step: int,
+                    reshape: bool = False) -> Dict[str, Tuple[Any, Any]]:
+  """Validates a checkpoint's recorded topology against this run's.
+
+  Returns the mismatches that were DEMOTED to a resharding request
+  (``reshape=True`` and every mismatched key is in :data:`RESHAPE_KEYS`)
+  — empty when the topologies agree. Raises
+  :class:`TopologyMismatchError` for semantic mismatches, or for any
+  mismatch when ``reshape`` is off.
+  """
   if not saved or not expected:
-    return
+    return {}
   mismatches = {
       key: (saved[key], expected[key])
       for key in sorted(set(saved) & set(expected))
       if saved[key] != expected[key]
   }
   if not mismatches:
-    return
+    return {}
+  semantic = {k: v for k, v in mismatches.items() if k not in RESHAPE_KEYS}
+  if reshape and not semantic:
+    return mismatches
   detail = '; '.join(
       f'{key}: checkpoint has {was!r}, this run has {now!r}'
       for key, (was, now) in mismatches.items())
+  hint = (
+      'Either relaunch with the recorded topology (e.g. the same number '
+      'of processes and mesh shape), restore elastically with '
+      'reshape=True (TrainerConfig.checkpoint_reshape) if only the '
+      'host/mesh layout changed, or — if the change is intentional — '
+      'disable the check with TrainerConfig.checkpoint_topology_check='
+      'False / CheckpointManager(topology=None).')
+  if reshape and semantic:
+    semantic_keys = ', '.join(sorted(semantic))
+    hint = (
+        f'reshape=True covers only the host/mesh layout '
+        f'({", ".join(sorted(RESHAPE_KEYS))}); {semantic_keys} changes '
+        f'what the saved state MEANS, so it must match (or disable the '
+        f'check with TrainerConfig.checkpoint_topology_check=False).')
   raise TopologyMismatchError(
       f'Checkpoint step {step} under {directory!r} was saved with a '
       f'different topology than this run: {detail}. Restoring it would '
-      f'silently misinterpret the saved state. Either relaunch with the '
-      f'recorded topology (e.g. the same number of processes and mesh '
-      f'shape), or — if the change is intentional — disable the check '
-      f'with TrainerConfig.checkpoint_topology_check=False / '
-      f'CheckpointManager(topology=None).')
+      f'silently misinterpret the saved state. {hint}')
+
+
+def _incarnation_token() -> str:
+  return f'{os.getpid()}-{time.time_ns()}'
 
 
 class CheckpointManager:
@@ -171,18 +249,33 @@ class CheckpointManager:
   write is known complete — at the next ``save`` or at
   ``wait_until_finished``), and ``restore`` prefers committed steps.
 
-  Multi-process (``distributed`` context passed): process 0 is the
-  single payload writer — its Orbax manager runs with
+  Multi-process (``distributed`` context passed), ``sharded=False``:
+  process 0 is the single payload writer — its Orbax manager runs with
   ``active_processes={0}`` so Orbax's internal barriers never span the
   job — and commit requires every host:
 
-    1. primary saves the payload (synchronously) and waits;
+    1. primary saves the payload and waits for durability;
     2. barrier; every host writes its ``host_ack_<p>.json`` into the
-       step dir (the per-host "shard" — carrying process metadata — that
-       fault injection can corrupt);
+       step dir (tagged with this job incarnation, so acks left behind
+       by a previous crashed attempt at the same step never count);
     3. barrier; primary validates all acks and atomically publishes
        ``commit.json`` with the run topology;
     4. barrier; ``save`` returns True on every host.
+
+  ``sharded=True`` replaces step 1: EVERY participant writes its own
+  shards through one shared Orbax multiprocess ``AsyncCheckpointer``
+  (coordination-service barriers only), after re-expressing per-host
+  replica-group state as striped global arrays when needed
+  (``parallel/mesh.build_global_save_view``). Steps 2–4 are identical —
+  the marker is the single commit point either way.
+
+  ``async_commit=True`` moves steps 2–3 off the critical path for
+  unforced saves: the payload write starts at the save point, each
+  host's ack lands (from a waiter thread) once its write is durable, and
+  the primary publishes the marker from ``poll_async_commit`` at a later
+  dispatch boundary — no barrier blocks the loop. Forced saves and
+  ``wait_until_finished`` run the barriered protocol, so shutdown never
+  leaves the marker behind.
 
   Any host dying mid-protocol leaves the step UNCOMMITTED (never
   restored) and surfaces as a bounded
@@ -198,7 +291,12 @@ class CheckpointManager:
                async_save: bool = True,
                topology: Optional[Dict[str, Any]] = None,
                distributed: Optional[DistributedContext] = None,
-               barrier_timeout_secs: float = 600.0):
+               barrier_timeout_secs: float = 600.0,
+               sharded: bool = False,
+               async_commit: bool = False,
+               reshape: bool = False,
+               mesh=None,
+               sharding_rules: Sequence = ()):
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     self._directory = directory
@@ -206,22 +304,45 @@ class CheckpointManager:
     self._ctx = distributed
     self._barrier_timeout = float(barrier_timeout_secs)
     self._save_interval = max(1, int(save_interval_steps))
+    self._max_to_keep = max_to_keep
+    self._keep_period = keep_period
+    self._sharded = bool(sharded and distributed is not None)
+    self._async_commit = bool(async_commit and distributed is not None)
+    self._reshape = bool(reshape)
+    self._mesh = mesh
+    self._sharding_rules = tuple(sharding_rules or ())
     self._save_seq = 0  # barrier-id uniqueness across repeated saves
     self._pending_marker: Optional[int] = None
     self._manager: Optional[ocp.CheckpointManager] = None
     self._restore_checkpointer = None
-    if self._ctx is None or self._ctx.is_primary:
+    self._incarnation: Optional[str] = None
+    # Hosts participating in saves: all processes by default; shrinks
+    # when the coordinated-shutdown negotiation excludes hosts that
+    # finished and said goodbye (set_participants).
+    self._participants: Optional[List[int]] = (
+        sorted(range(distributed.process_count))
+        if distributed is not None else None)
+    # Shared multiprocess payload writer (sharded mode), rebuilt when the
+    # participant set changes; all hosts create/use it in lockstep so
+    # Orbax's per-prefix barrier counters stay aligned.
+    self._payload_writer: Optional[ocp.AsyncCheckpointer] = None
+    self._payload_writer_parts: Optional[Tuple[int, ...]] = None
+    # In-flight async commit (one at a time; saves are serialized).
+    self._async_lock = threading.Lock()
+    self._async_state: Optional[Dict[str, Any]] = None  # GUARDED_BY(self._async_lock)
+    if self._ctx is None or (not self._sharded and self._ctx.is_primary):
       extra = {}
       if self._ctx is not None:
-        # Orbax must never barrier across the job: our commit protocol
-        # owns cross-host ordering (over the coordination service, with
-        # bounded timeouts); Orbax's own syncs collapse to this process.
-        # Multi-process commit is also barrier-synchronous — the marker
-        # must only be published once the payload is durably on disk —
-        # so async writes buy nothing and are disabled. Orbax refuses
+        # Orbax must never barrier across the job in single-writer mode:
+        # our commit protocol owns cross-host ordering (over the
+        # coordination service, with bounded timeouts); Orbax's own
+        # syncs collapse to this process. The synchronous commit is also
+        # barrier-synchronous — the marker must only be published once
+        # the payload is durably on disk — so async writes buy nothing
+        # there and are enabled only for async_commit. Orbax refuses
         # create=True with active_processes set; the root directory was
         # created above.
-        async_save = False
+        async_save = self._async_commit
         extra = dict(
             create=False,
             multiprocessing_options=ocp.options.MultiprocessingOptions(
@@ -246,32 +367,255 @@ class CheckpointManager:
   def topology(self) -> Optional[Dict[str, Any]]:
     return self._topology
 
-  def _flush_pending_marker(self) -> None:
-    """Publishes the marker for the last async save once it finished.
+  @property
+  def sharded(self) -> bool:
+    return self._sharded
 
-    Called with the Orbax write known complete (after
-    ``wait_until_finished`` or at the head of the next ``save`` — Orbax
-    serializes saves, so starting a new one implies the previous write
-    is durable). A crash before this point correctly leaves the step
-    uncommitted: its write may be torn.
+  @property
+  def participants(self) -> Optional[List[int]]:
+    return list(self._participants) if self._participants else None
+
+  def set_participants(self, hosts: Sequence[int]) -> None:
+    """Restricts the commit protocol to ``hosts`` (surviving processes).
+
+    Installed by the trainer when the coordinated-shutdown negotiation
+    excluded hosts that completed and said goodbye: subsequent saves
+    barrier/ack only among the survivors, and the marker records them.
     """
-    if self._pending_marker is None:
+    if self._ctx is None:
       return
-    step, self._pending_marker = self._pending_marker, None
-    if os.path.isdir(_step_dir(self._directory, step)):
-      write_commit_marker(self._directory, step, topology=self._topology)
-    else:
-      # Retention GC may legitimately have collected the step already;
-      # anything else (e.g. a still-unfinalized write) is a bug worth
-      # hearing about — the step would read as torn forever.
+    hosts = sorted(int(h) for h in hosts)
+    if self._ctx.process_index not in hosts:
+      raise ValueError(
+          f'process {self._ctx.process_index} cannot save with a '
+          f'participant set {hosts} that excludes itself.')
+    if hosts != self._participants:
       logging.warning(
-          'Commit marker for checkpoint step %d skipped: step directory '
-          'no longer exists under %r.', step, self._directory)
+          'Checkpoint commit participants restricted to %s (of %d '
+          'processes): peers that completed and said goodbye are '
+          'excluded from the remaining saves.', hosts,
+          self._ctx.process_count)
+      self._participants = hosts
+    if (not self._sharded and self._manager is None and
+        self._is_commit_primary()):
+      # Single-writer mode with the original primary gone: this host
+      # takes over the payload-writer role for the remaining saves.
+      # Orbax resolves primary-host identity against the RUNTIME process
+      # index (== ctx.process_index in a real job), so key on that.
+      runtime_index = jax.process_index()
+      self._manager = ocp.CheckpointManager(
+          self._directory,
+          options=ocp.CheckpointManagerOptions(
+              max_to_keep=self._max_to_keep,
+              keep_period=self._keep_period,
+              save_interval_steps=self._save_interval,
+              enable_async_checkpointing=self._async_commit,
+              step_prefix='ckpt',
+              create=False,
+              multiprocessing_options=ocp.options.MultiprocessingOptions(
+                  primary_host=runtime_index,
+                  active_processes={runtime_index},
+                  barrier_sync_key_prefix=(
+                      f't2r_ckpt_takeover_p{self._ctx.process_index}'))))
 
-  def save(self, step: int, state, force: bool = False) -> bool:
+  # ---------------------------------------------------------- commit plumbing
+
+  def _is_commit_primary(self) -> bool:
+    return (self._ctx is not None and self._participants and
+            self._ctx.process_index == self._participants[0])
+
+  def _barrier(self, name: str, participants: Sequence[int]) -> None:
+    if len(participants) <= 1:
+      return  # solo survivor: nothing to wait for
+    self._ctx.barrier(name, self._barrier_timeout,
+                      participants=participants)
+
+  def _get_incarnation(self) -> str:
+    """A job-incarnation token shared by all hosts (first-writer-wins).
+
+    Acks are tagged with it so a PREVIOUS incarnation's leftovers in the
+    same step dir (a job that crashed mid-protocol, then the restart
+    reached the same step) can never satisfy this run's ack count — the
+    hazard the async commit path would otherwise race against.
+    """
+    if self._incarnation is not None:
+      return self._incarnation
+    token = _incarnation_token()
+    if self._ctx is None:
+      self._incarnation = token
+      return token
+    # Stable across processes (python's str hash is per-process salted).
+    dir_digest = hashlib.sha1(self._directory.encode()).hexdigest()[:12]
+    key = f'ckpt/incarnation/{dir_digest}'
+    self._ctx.put(key, token)  # first writer wins across hosts
+    agreed = self._ctx.get(key, self._barrier_timeout)
+    self._incarnation = agreed if agreed is not None else token
+    return self._incarnation
+
+  def _write_ack(self, step: int) -> None:
+    ctx = self._ctx
+    step_dir = _step_dir(self._directory, step)
+    ack = {
+        'process_index': ctx.process_index,
+        'step': int(step),
+        'pid': os.getpid(),
+        'time': time.time(),
+        'incarnation': self._get_incarnation(),
+        'format': FORMAT_SHARDED if self._sharded else FORMAT_SINGLE_WRITER,
+    }
+    ack_path = os.path.join(
+        step_dir, f'{HOST_ACK_PREFIX}{ctx.process_index}.json')
+    tmp = f'{ack_path}.tmp{os.getpid()}'
+    with open(tmp, 'w') as f:
+      json.dump(ack, f)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp, ack_path)
+
+  def _read_acks(self, step: int,
+                 incarnation: Optional[str] = None) -> Dict[int, dict]:
+    """Parsable acks in the step dir, filtered to ``incarnation``.
+
+    With an expected incarnation, acks missing the tag or carrying a
+    different one are STALE (a previous attempt at this step) and do not
+    count — a commit must never be satisfied by a dead job's leftovers.
+    """
+    step_dir = _step_dir(self._directory, step)
+    acked: Dict[int, dict] = {}
+    try:
+      names = os.listdir(step_dir)
+    except FileNotFoundError:
+      return acked
+    for name in names:
+      if not (name.startswith(HOST_ACK_PREFIX) and name.endswith('.json')):
+        continue
+      try:
+        with open(os.path.join(step_dir, name)) as f:
+          payload = json.load(f)
+        host = int(payload['process_index'])
+      except (OSError, ValueError, KeyError, TypeError):
+        continue  # unparseable ack == no ack: the step stays uncommitted
+      if (incarnation is not None and
+          payload.get('incarnation') != incarnation):
+        continue
+      acked[host] = payload
+    return acked
+
+  def _publish_marker(self, step: int, acks: Dict[int, dict]) -> None:
+    fmt = FORMAT_SHARDED if self._sharded else FORMAT_SINGLE_WRITER
+    shards = {
+        str(host): {'pid': ack.get('pid'), 'time': ack.get('time')}
+        for host, ack in sorted(acks.items())
+    }
+    write_commit_marker(
+        self._directory, step, topology=self._topology,
+        hosts=sorted(acks),
+        extra={'format': fmt, 'incarnation': self._get_incarnation(),
+               'shards': shards})
+
+  def _commit_barriered(self, step: int, seq: int,
+                        participants: Sequence[int]) -> None:
+    """Steps 2–4 of the protocol: acks, validation, marker, release."""
+    self._barrier(f'ckpt/{step}/{seq}/saved', participants)
+    self._write_ack(step)
+    self._barrier(f'ckpt/{step}/{seq}/acked', participants)
+    if self._ctx.process_index == participants[0]:
+      if read_commit_marker(self._directory, step) is None:
+        self._validate_and_publish(step, participants)
+    self._barrier(f'ckpt/{step}/{seq}/committed', participants)
+
+  def _validate_and_publish(self, step: int,
+                            participants: Sequence[int]) -> None:
+    acks = self._read_acks(step, incarnation=self._get_incarnation())
+    missing = set(participants) - set(acks)
+    if missing:
+      raise RuntimeError(
+          f'checkpoint step {step}: host ack(s) missing for '
+          f'process(es) {sorted(missing)} AFTER the ack barrier '
+          f'passed — the shared filesystem dropped or corrupted '
+          f'them; refusing to commit a torn checkpoint.')
+    self._publish_marker(step, acks)
+
+  def _gc_old_steps(self) -> None:
+    """Retention for the sharded path (no Orbax manager owns the dir).
+
+    Deletes COMMITTED steps beyond ``max_to_keep`` (keeping
+    ``keep_period`` multiples), never torn ones — a torn step may be an
+    in-flight async write. Primary-of-participants only.
+    """
+    if self._max_to_keep is None or not self._is_commit_primary():
+      return
+    committed, _ = _committed_steps(
+        self._directory, _fs_steps(self._directory), 'retention')
+    excess = committed[:-self._max_to_keep] if self._max_to_keep else []
+    for step in excess:
+      if self._keep_period and step % self._keep_period == 0:
+        continue
+      shutil.rmtree(_step_dir(self._directory, step), ignore_errors=True)
+
+  # ------------------------------------------------------------ payload write
+
+  def _payload_checkpointer(self, participants: Sequence[int]
+                            ) -> ocp.AsyncCheckpointer:
+    parts = tuple(participants)
+    if self._payload_writer is not None and (
+        self._payload_writer_parts == parts):
+      return self._payload_writer
+    if self._payload_writer is not None:
+      self._payload_writer.close()
+    prefix = 't2r_shard_p' + '_'.join(str(p) for p in parts)
+    self._payload_writer = ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler(),
+        timeout_secs=max(1, int(self._barrier_timeout)),
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=parts[0],
+            active_processes=set(parts),
+            barrier_sync_key_prefix=prefix))
+    self._payload_writer_parts = parts
+    return self._payload_writer
+
+  def _sharded_save_view(self, state, participants: Sequence[int]):
+    """The global-array view of ``state`` each participant writes from."""
+    leaves = jax.tree_util.tree_leaves(state)
+    if leaves and all(
+        isinstance(x, jax.Array) and not x.is_fully_addressable
+        for x in leaves if isinstance(x, jax.Array)) and any(
+            isinstance(x, jax.Array) and not x.is_fully_addressable
+            for x in leaves):
+      # Already global (process-spanning mesh, true FSDP): Orbax writes
+      # each process's addressable shards as-is.
+      return state
+    save_mesh = mesh_lib.global_save_mesh(participants)
+    return mesh_lib.build_global_save_view(jax.device_get(state), save_mesh)
+
+  def _start_sharded_payload(self, step: int, state,
+                             participants: Sequence[int]) -> None:
+    step_dir = _step_dir(self._directory, step)
+    if self._ctx.process_index == participants[0]:
+      os.makedirs(step_dir, exist_ok=True)
+    view = self._sharded_save_view(state, participants)
+    ckptr = self._payload_checkpointer(participants)
+    ckptr.save(os.path.join(step_dir, 'default'),
+               args=ocp.args.StandardSave(view), force=True)
+
+  # ------------------------------------------------------------------- saves
+
+  def save(self, step: int, state, force: bool = False,
+           sync: Optional[bool] = None) -> bool:
+    """Saves ``state`` at ``step``; True when a save actually happened.
+
+    ``force`` bypasses the interval gate (identically on every host).
+    ``sync`` controls the commit style in ``async_commit`` mode: None
+    (default) lets unforced interval saves commit asynchronously at
+    later dispatch boundaries; True (what the trainer passes for
+    preemption/final saves) runs the full barriered protocol so the
+    marker is on disk before the call returns.
+    """
     step = int(step)
     if self._ctx is not None:
-      return self._save_distributed(step, state, force)
+      return self._save_distributed(step, state, force,
+                                    sync=bool(sync) if sync is not None
+                                    else not self._async_commit)
     # Hand Orbax the DEVICE arrays: its async path owns the device→host
     # copy (blocking only for the D2H transfer, writing to disk in the
     # background). An eager jax.device_get here would serialize a full
@@ -297,107 +641,280 @@ class CheckpointManager:
       metrics_lib.counter('checkpoint/saves').inc()
     return saved
 
-  def _save_distributed(self, step: int, state, force: bool) -> bool:
-    """The multi-host commit protocol; every host calls this at the same
-    step (the trainer's boundaries guarantee it)."""
+  def _save_distributed(self, step: int, state, force: bool,
+                        sync: bool) -> bool:
+    """The multi-host commit protocol; every participating host calls
+    this at the same step (the trainer's boundaries guarantee it)."""
     ctx = self._ctx
     if read_commit_marker(self._directory, step) is not None:
       return False  # already committed; consistent across hosts
     if not force and step % self._save_interval:
       return False  # mirror Orbax's own interval gate, identically per host
+    # At most one async commit in flight: starting a new save (sync or
+    # not) first finalizes the previous one — every host executes the
+    # same save sequence, so all enter this path in lockstep.
+    self._finalize_async_commit()
     self._save_seq += 1
     seq = self._save_seq
-    step_dir = _step_dir(self._directory, step)
+    participants = list(self._participants)
     with tracing.span('checkpoint/save'):
-      if self._manager is not None:
+      if self._sharded:
+        try:
+          self._start_sharded_payload(step, state, participants)
+        except DeadHostError:
+          raise
+        except Exception as e:  # pylint: disable=broad-except
+          raise DeadHostError(
+              f'sharded checkpoint payload write for step {step} failed '
+              f'on process {ctx.process_index} (a peer likely died '
+              f'mid-save; the step stays uncommitted): {e}') from e
+      elif self._manager is not None:
         # Single payload writer. The host copy is explicit: with a
         # per-host mesh in a multi-process runtime Orbax refuses device
         # arrays, and the commit barriers serialize on the write anyway.
-        if step not in self._manager.all_steps():
-          self._manager.save(
-              step, args=ocp.args.StandardSave(jax.device_get(state)),
-              force=True)
-          self._manager.wait_until_finished()
-      ctx.barrier(f'ckpt/{step}/{seq}/saved', self._barrier_timeout)
-      # Every host acknowledges INTO the step dir: the commit marker is
-      # only written over a complete set of acks, so a host that died
-      # before finishing leaves the step uncommitted.
-      ack = {
-          'process_index': ctx.process_index,
-          'step': step,
-          'pid': os.getpid(),
-          'time': time.time(),
-      }
-      ack_path = os.path.join(
-          step_dir, f'{HOST_ACK_PREFIX}{ctx.process_index}.json')
-      tmp = f'{ack_path}.tmp{os.getpid()}'
-      with open(tmp, 'w') as f:
-        json.dump(ack, f)
-        f.flush()
-        os.fsync(f.fileno())
-      os.replace(tmp, ack_path)
-      ctx.barrier(f'ckpt/{step}/{seq}/acked', self._barrier_timeout)
-      if ctx.is_primary:
-        acked = self._read_acks(step)
-        missing = set(range(ctx.process_count)) - set(acked)
-        if missing:
-          raise RuntimeError(
-              f'checkpoint step {step}: host ack(s) missing for '
-              f'process(es) {sorted(missing)} AFTER the ack barrier '
-              f'passed — the shared filesystem dropped or corrupted '
-              f'them; refusing to commit a torn checkpoint.')
-        write_commit_marker(self._directory, step, topology=self._topology,
-                            hosts=sorted(acked))
-      ctx.barrier(f'ckpt/{step}/{seq}/committed', self._barrier_timeout)
+        step_dir = _step_dir(self._directory, step)
+        if os.path.isdir(step_dir):
+          # We only reach this point when the step has NO commit marker:
+          # anything already on disk is a previous attempt's torn
+          # leftover (payload fragments, stale acks), and Orbax refuses
+          # to write over an existing destination — clear it first.
+          shutil.rmtree(step_dir, ignore_errors=True)
+        self._manager.save(
+            step, args=ocp.args.StandardSave(jax.device_get(state)),
+            force=True)
+      hook = _during_save_hook
+      if hook is not None:
+        hook(step)
+      if not sync and self._async_commit:
+        self._begin_async_commit(step, seq, participants)
+        metrics_lib.counter('checkpoint/saves').inc()
+        metrics_lib.counter('checkpoint/async_commits').inc()
+        return True
+      self._wait_payload(participants)
+      self._commit_barriered(step, seq, participants)
     metrics_lib.counter('checkpoint/saves').inc()
+    self._gc_old_steps()
     return True
 
-  def _read_acks(self, step: int) -> List[int]:
-    step_dir = _step_dir(self._directory, step)
-    acked = []
+  def _await_primary_ack(self, step: int, primary: int) -> None:
+    """Blocks (bounded) until the primary's fresh ack for ``step``."""
+    incarnation = self._get_incarnation()
+    deadline = time.monotonic() + self._barrier_timeout
+    while primary not in self._read_acks(step, incarnation=incarnation):
+      if time.monotonic() > deadline:
+        raise DeadHostError(
+            f'checkpoint step {step}: the payload writer (process '
+            f'{primary}) never acked within {self._barrier_timeout:.0f}s '
+            f'(likely died mid-save); the step stays uncommitted.')
+      time.sleep(0.02)
+
+  def _wait_payload(self, participants: Sequence[int]) -> None:
+    """Blocks until this host's payload contribution is durable."""
+    ctx = self._ctx
     try:
-      names = os.listdir(step_dir)
-    except FileNotFoundError:
-      return acked
-    for name in names:
-      if not (name.startswith(HOST_ACK_PREFIX) and name.endswith('.json')):
-        continue
+      if self._sharded:
+        self._payload_checkpointer(participants).wait_until_finished()
+      elif self._manager is not None:
+        self._manager.wait_until_finished()
+    except DeadHostError:
+      raise
+    except Exception as e:  # pylint: disable=broad-except
+      raise DeadHostError(
+          f'checkpoint payload wait failed on process '
+          f'{ctx.process_index} (a peer likely died mid-save; the step '
+          f'stays uncommitted): {e}') from e
+
+  # ----------------------------------------------------------- async commit
+
+  def _begin_async_commit(self, step: int, seq: int,
+                          participants: Sequence[int]) -> None:
+    """Starts the off-loop half of an async save: a waiter thread acks
+    once this host's write is durable; the marker rides a later
+    ``poll_async_commit`` (primary) or the next forced sync."""
+    pending = {
+        'step': step,
+        'seq': seq,
+        'participants': list(participants),
+        'started_at': time.perf_counter(),
+        'error': None,
+        'done': threading.Event(),
+    }
+
+    def waiter():
       try:
-        with open(os.path.join(step_dir, name)) as f:
-          acked.append(int(json.load(f)['process_index']))
-      except (OSError, ValueError, KeyError, TypeError):
-        continue  # unparseable ack == no ack: the step stays uncommitted
-    return acked
+        self._wait_payload(participants)
+        if (not self._sharded and
+            self._ctx.process_index != participants[0]):
+          # Single-writer causality: a non-primary ack must imply the
+          # primary's payload is durable AND from THIS incarnation (the
+          # primary may first clear a previous attempt's torn step dir —
+          # acking the bare directory would race that cleanup). The
+          # primary's own ack, written strictly after its payload wait,
+          # carries both facts.
+          self._await_primary_ack(step, participants[0])
+        self._write_ack(step)
+      except BaseException as e:  # pylint: disable=broad-except
+        pending['error'] = e
+        logging.warning(
+            'Async checkpoint commit for step %d: payload wait/ack '
+            'failed (%r); the step stays uncommitted until the forced '
+            'sync surfaces the error.', step, e)
+      finally:
+        pending['done'].set()
+
+    thread = threading.Thread(target=waiter, daemon=True,
+                              name=f't2r-ckpt-async-{step}')
+    pending['thread'] = thread
+    with self._async_lock:
+      self._async_state = pending
+    thread.start()
+
+  def poll_async_commit(self) -> bool:
+    """One dispatch boundary's async-commit progress check (non-blocking).
+
+    The commit primary publishes the marker once every participant's ack
+    (for this incarnation) is on disk — each ack is written strictly
+    after that host's payload is durable, so the marker never covers a
+    torn write. Returns True when the pending step is now committed.
+    Non-primary hosts have nothing to do here (their waiter thread wrote
+    the ack); the pending record itself is cleared by the next save or
+    ``wait_until_finished`` so the barriered finalize stays symmetric
+    across hosts.
+    """
+    with self._async_lock:
+      pending = self._async_state
+    if pending is None:
+      return False
+    step = pending['step']
+    if read_commit_marker(self._directory, step) is not None:
+      return True
+    participants = pending['participants']
+    if self._ctx.process_index != participants[0]:
+      return False
+    acks = self._read_acks(step, incarnation=self._get_incarnation())
+    if set(participants) - set(acks):
+      return False
+    self._publish_marker(step, acks)
+    overlap_ms = (time.perf_counter() - pending['started_at']) * 1e3
+    metrics_lib.histogram('checkpoint/save_overlap_ms').observe(overlap_ms)
+    logging.info(
+        'Async checkpoint commit: step %d marker published %.0f ms after '
+        'the save point (write overlapped training).', step, overlap_ms)
+    self._gc_old_steps()
+    return True
+
+  def _finalize_async_commit(self) -> None:
+    """The forced-sync path: joins the waiter, runs the barriered
+    ack/marker round, and surfaces any write error. Every host calls it
+    at the same protocol points (next save / wait_until_finished /
+    close), so the barriers always pair up."""
+    with self._async_lock:
+      pending, self._async_state = self._async_state, None
+    if pending is None:
+      return
+    step, seq = pending['step'], pending['seq']
+    participants = pending['participants']
+    if not pending['done'].wait(self._barrier_timeout):
+      raise DeadHostError(
+          f'async checkpoint commit for step {step}: payload writer '
+          f'still not durable after {self._barrier_timeout:.0f}s; '
+          f'refusing to publish the marker.')
+    if pending['error'] is not None:
+      raise pending['error']
+    self._barrier(f'ckpt/{step}/{seq}/async_sync', participants)
+    if self._ctx.process_index == participants[0]:
+      if read_commit_marker(self._directory, step) is None:
+        self._validate_and_publish(step, participants)
+        overlap_ms = (time.perf_counter() - pending['started_at']) * 1e3
+        metrics_lib.histogram('checkpoint/save_overlap_ms').observe(
+            overlap_ms)
+    self._barrier(f'ckpt/{step}/{seq}/async_committed', participants)
+    self._gc_old_steps()
+
+  # ----------------------------------------------------------------- restore
 
   def _restore_payload(self, step: int, target):
     """Reads one step's payload into ``target``'s structure."""
-    if self._manager is not None:
+    if self._manager is not None and self._ctx is None:
       return self._manager.restore(
           int(step), args=ocp.args.StandardRestore(target))
-    # Non-primary host: single-process read of the committed payload.
+    # Multi-process (or sharded): every host reads independently — the
+    # payload is one logical tree regardless of how many writers striped
+    # it, and concurrent reads are safe.
     if self._restore_checkpointer is None:
-      ctx = self._ctx
+      extra = {}
+      if self._ctx is not None:
+        extra = dict(
+            multiprocessing_options=ocp.options.MultiprocessingOptions(
+                primary_host=self._ctx.process_index,
+                active_processes={self._ctx.process_index},
+                barrier_sync_key_prefix=(
+                    f't2r_restore_p{self._ctx.process_index}')))
       self._restore_checkpointer = ocp.Checkpointer(
-          ocp.StandardCheckpointHandler(),
-          multiprocessing_options=ocp.options.MultiprocessingOptions(
-              primary_host=ctx.process_index,
-              active_processes={ctx.process_index},
-              barrier_sync_key_prefix=f't2r_restore_p{ctx.process_index}'))
+          ocp.StandardCheckpointHandler(), **extra)
     item_dir = os.path.join(_step_dir(self._directory, step), 'default')
     if not os.path.isdir(item_dir):
       item_dir = _step_dir(self._directory, step)
     return self._restore_checkpointer.restore(
         item_dir, args=ocp.args.StandardRestore(target))
 
+  def _host_target(self, state):
+    """A host-memory restore target (Orbax rejects numpy SCALARS)."""
+
+    def conv(x):
+      if x is None or isinstance(x, (jax.ShapeDtypeStruct, int, float)):
+        return x
+      return np.asarray(x)
+
+    return jax.tree_util.tree_map(conv, state)
+
+  def _resharded_target(self, state):
+    """Abstract target with shardings rebuilt from the CURRENT mesh —
+    Orbax reads exactly the index ranges each device needs, so an N-host
+    payload lands directly on an M-host layout with no full-state
+    gather."""
+    shardings = mesh_lib.state_shardings_for(
+        self._mesh, state, rules=self._sharding_rules)
+
+    def abstract(x, s):
+      if x is None or isinstance(x, (int, float)):
+        return x
+      if isinstance(x, jax.ShapeDtypeStruct):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+      arr_dtype = getattr(x, 'dtype', None)
+      if arr_dtype is None:
+        return x
+      return jax.ShapeDtypeStruct(np.shape(x), arr_dtype, sharding=s)
+
+    return jax.tree_util.tree_map(abstract, state, shardings)
+
+  def _target_for(self, state, demoted: Dict[str, Any]):
+    if demoted and self._mesh is not None:
+      metrics_lib.counter('checkpoint/reshaped_restores').inc()
+      logging.warning(
+          'Resharding restore: checkpoint topology differs on %s; '
+          'rebuilding target shardings from the current mesh and letting '
+          'Orbax reshard on read.', sorted(demoted))
+      return self._resharded_target(state)
+    if demoted:
+      metrics_lib.counter('checkpoint/reshaped_restores').inc()
+    return self._host_target(state)
+
   def restore(self, state, step: Optional[int] = None,
-              fallback_to_older: bool = True):
+              fallback_to_older: bool = True,
+              reshape: Optional[bool] = None):
     """Restores into the structure of ``state`` (an abstract/concrete tree).
 
     Only COMMITTED steps are candidates once the commit protocol is in
     use (any marker present); a step missing its marker is torn and is
     never restored (``checkpoint/torn_skipped``). The committed step's
     recorded topology must match this manager's (when both are known) or
-    a :class:`TopologyMismatchError` explains the mismatch.
+    a :class:`TopologyMismatchError` explains the mismatch — except
+    under ``reshape`` (defaulting to the manager's ``reshape=`` flag),
+    where host/mesh-layout differences become a resharding restore: the
+    payload is read onto target shardings built from the CURRENT mesh
+    (``checkpoint/reshaped_restores`` counts them). Semantic mismatches
+    (microbatch config, steps-per-dispatch) always raise.
 
     With ``fallback_to_older`` (the default when no explicit ``step`` is
     requested), a truncated/corrupt latest checkpoint — the signature of
@@ -406,6 +923,7 @@ class CheckpointManager:
     step fails does the last error propagate; an explicit ``step``
     restores exactly that step or raises.
     """
+    reshape = self._reshape if reshape is None else bool(reshape)
     if step is not None:
       step = int(step)
       _, protocol_active = _committed_steps(
@@ -415,11 +933,13 @@ class CheckpointManager:
         raise RuntimeError(
             f'checkpoint step {step} under {self._directory!r} has no '
             f'commit marker (torn/uncommitted); refusing to restore it.')
+      demoted = {}
       if marker is not None:
-        _check_topology(marker.get('topology'), self._topology,
-                        self._directory, step)
+        demoted = _check_topology(marker.get('topology'), self._topology,
+                                  self._directory, step, reshape=reshape)
       with tracing.span('checkpoint/restore'):
-        restored = self._restore_payload(step, jax.device_get(state))
+        restored = self._restore_payload(step, self._target_for(
+            state, demoted))
       metrics_lib.counter('checkpoint/restores').inc()
       return restored
     steps, _ = _committed_steps(
@@ -427,19 +947,21 @@ class CheckpointManager:
     steps = sorted(steps, reverse=True)
     if not steps:
       return None
-    target = jax.device_get(state)
     last_exc: Optional[BaseException] = None
     for i, s in enumerate(steps):
       marker = read_commit_marker(self._directory, s)
+      demoted = {}
       if marker is not None:
         # Topology mismatch is NOT a fallback case: every step in this
         # directory came from the same job shape, so older steps would
-        # fail identically — raise the actionable error instead.
-        _check_topology(marker.get('topology'), self._topology,
-                        self._directory, s)
+        # fail identically — raise the actionable error instead (unless
+        # reshape demotes it to a resharding restore).
+        demoted = _check_topology(marker.get('topology'), self._topology,
+                                  self._directory, s, reshape=reshape)
       try:
         with tracing.span('checkpoint/restore'):
-          restored = self._restore_payload(s, target)
+          restored = self._restore_payload(s, self._target_for(
+              state, demoted))
         metrics_lib.counter('checkpoint/restores').inc()
         if i > 0:
           metrics_lib.counter('checkpoint/restore_fallbacks').inc(i)
@@ -457,6 +979,31 @@ class CheckpointManager:
     raise RuntimeError(
         f'All {len(steps)} checkpoint(s) under {self._directory!r} failed '
         f'to restore; last error: {last_exc!r}') from last_exc
+
+  # ------------------------------------------------------------- bookkeeping
+
+  def _flush_pending_marker(self) -> None:
+    """Publishes the marker for the last async save once it finished.
+
+    Called with the Orbax write known complete (after
+    ``wait_until_finished`` or at the head of the next ``save`` — Orbax
+    serializes saves, so starting a new one implies the previous write
+    is durable). A crash before this point correctly leaves the step
+    uncommitted: its write may be torn.
+    """
+    if self._pending_marker is None:
+      return
+    step, self._pending_marker = self._pending_marker, None
+    if os.path.isdir(_step_dir(self._directory, step)):
+      write_commit_marker(self._directory, step, topology=self._topology,
+                          extra={'format': FORMAT_SINGLE_WRITER})
+    else:
+      # Retention GC may legitimately have collected the step already;
+      # anything else (e.g. a still-unfinalized write) is a bug worth
+      # hearing about — the step would read as torn forever.
+      logging.warning(
+          'Commit marker for checkpoint step %d skipped: step directory '
+          'no longer exists under %r.', step, self._directory)
 
   def latest_step(self) -> Optional[int]:
     if self._manager is not None and self._ctx is None:
@@ -478,11 +1025,18 @@ class CheckpointManager:
   def wait_until_finished(self) -> None:
     # Time the train loop spends barriered on in-flight async writes.
     with tracing.span('checkpoint/wait'):
+      if self._ctx is not None:
+        self._finalize_async_commit()
       if self._manager is not None:
         self._manager.wait_until_finished()
       self._flush_pending_marker()
 
   def close(self) -> None:
+    if self._ctx is not None:
+      self._finalize_async_commit()
+    if self._payload_writer is not None:
+      self._payload_writer.close()
+      self._payload_writer = None
     if self._manager is not None:
       self._manager.wait_until_finished()
       self._flush_pending_marker()
@@ -507,8 +1061,9 @@ def latest_checkpoint_step(directory: str) -> Optional[int]:
   unmarked steps are torn (or still being written) and are not reported
   — so the continuous evaluator and the predictors never pick up a
   checkpoint mid-write. Each torn step counts ``checkpoint/torn_skipped``
-  once (not once per poll). Marker-less legacy directories behave as
-  before.
+  once (not once per poll). Sharded and single-writer step dirs mix
+  freely (the marker rule is format-agnostic); marker-less legacy
+  directories behave as before.
   """
   steps, _ = _committed_steps(directory, _fs_steps(directory),
                               'latest_checkpoint_step')

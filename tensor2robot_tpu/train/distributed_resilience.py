@@ -40,7 +40,7 @@ import os
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.train import resilience
@@ -141,16 +141,28 @@ class DistributedContext:
     except Exception:  # pylint: disable=broad-except
       return None
 
-  def barrier(self, name: str, timeout_secs: float) -> None:
-    """All processes wait at ``name``; DeadHostError on timeout.
+  def barrier(self, name: str, timeout_secs: float,
+              participants: Optional[Sequence[int]] = None) -> None:
+    """Processes wait at ``name``; DeadHostError on timeout.
 
     Barrier ids are one-shot in the coordination service — callers must
     make ``name`` unique per use (embed the step / a sequence number).
+    ``participants`` restricts the barrier to a subset of processes
+    (surviving hosts after peers completed and said goodbye); the subset
+    is embedded in the barrier id, so two hosts with DIFFERENT views of
+    who participates time out bounded instead of pairing up wrongly.
     """
+    process_ids = None
+    if participants is not None:
+      process_ids = sorted(int(p) for p in participants)
+      name = f'{name}/p{"_".join(str(p) for p in process_ids)}'
+      if process_ids == list(range(self.process_count)):
+        process_ids = None  # full set: the plain all-process barrier
     try:
       with tracing_span('distributed/barrier'):
         self._client.wait_at_barrier(self._key(name),
-                                     int(timeout_secs * 1000))
+                                     int(timeout_secs * 1000),
+                                     process_ids)
     except Exception as e:  # pylint: disable=broad-except
       metrics_lib.counter('distributed/barrier_timeouts').inc()
       raise DeadHostError(
@@ -193,6 +205,23 @@ class CoordinatedShutdown:
   completed host's published (final) step wins the max, every other
   host trains to it, and the aligned final save commits normally.
 
+  Two defenses close the completed-host vs late-proposal race (a host
+  that finished its loop while a peer's SIGTERM was still in flight):
+
+  * a COMPLETING host publishes its final boundary unconditionally
+    (:meth:`publish_boundary`) before entering its final-save barriers,
+    so a late proposer finds the boundary in the KV store even though
+    the completed host will never poll again — the negotiation converges
+    on the completed host's final step and the aligned save commits with
+    every host;
+  * if a missing host is GONE entirely (its goodbye heartbeat says
+    ``done`` and no boundary ever landed — it exited before the
+    proposal), the negotiation RETRIES ONCE against the surviving hosts:
+    the target becomes the survivors' max, :attr:`participants` records
+    who remains, and the subsequent forced save commits among them. Only
+    when a missing host is neither published nor done does the bounded
+    :class:`DeadHostError` escalate.
+
   ``poll`` returns the agreed target step (or None). The trainer
   checkpoints at the first boundary >= target and raises
   :class:`~tensor2robot_tpu.train.resilience.PreemptedError`.
@@ -202,16 +231,21 @@ class CoordinatedShutdown:
                context: DistributedContext,
                local: Optional[resilience.GracefulShutdown],
                negotiate_timeout_secs: float = 120.0,
-               poll_interval_secs: float = 0.05):
+               poll_interval_secs: float = 0.05,
+               peer_heartbeats: Optional[
+                   Callable[[], Dict[int, Dict[str, Any]]]] = None):
     self._ctx = context
     self._local = local
     self._timeout = float(negotiate_timeout_secs)
     self._poll_interval = float(poll_interval_secs)
+    self._peer_heartbeats = peer_heartbeats
     self._proposed = False
     self._published = False
     self._target: Optional[int] = None
+    self.participants: Optional[List[int]] = None
     self._m_stops = metrics_lib.counter('distributed/coordinated_stops')
     self._m_target = metrics_lib.gauge('distributed/coordinated_stop_step')
+    self._m_retries = metrics_lib.counter('distributed/negotiation_retries')
 
   @property
   def target_step(self) -> Optional[int]:
@@ -221,6 +255,33 @@ class CoordinatedShutdown:
     """Programmatic local shutdown request (tests, cluster agents)."""
     if self._local is not None:
       self._local.request()
+
+  def publish_boundary(self, step: int) -> None:
+    """Publishes this host's boundary unconditionally (idempotent).
+
+    Called by the trainer when its loop COMPLETES, before the final-save
+    barriers: a peer whose SIGTERM lands after this moment still finds
+    our final step in the KV store, so its negotiation converges instead
+    of timing out against a host that will never poll again.
+    """
+    if self._published:
+      return
+    self._published = True
+    self._ctx.put(f'shutdown/step/{self._ctx.process_index}',
+                  str(int(step)))
+
+  def _done_peers(self) -> Dict[int, int]:
+    """Hosts whose goodbye heartbeat marks an orderly, completed exit."""
+    if self._peer_heartbeats is None:
+      return {}
+    out: Dict[int, int] = {}
+    try:
+      for host, payload in self._peer_heartbeats().items():
+        if payload.get('done'):
+          out[int(host)] = int(payload.get('step', 0))
+    except Exception:  # pylint: disable=broad-except
+      logging.exception('peer heartbeat read failed (non-fatal).')
+    return out
 
   def poll(self, step: int) -> Optional[int]:
     """One boundary's coordination round; returns the agreed stop step."""
@@ -247,29 +308,53 @@ class CoordinatedShutdown:
       self._ctx.put(f'shutdown/step/{self._ctx.process_index}',
                     str(int(step)))
     deadline = time.monotonic() + self._timeout
+    retried = False
+    expected = set(range(self._ctx.process_count))
     while True:
       published = self._ctx.get_dir('shutdown/step/')
-      if len(published) >= self._ctx.process_count:
+      # Keys come back namespace-stripped but path-full:
+      # 'shutdown/step/<p>'.
+      steps = {int(key.rsplit('/', 1)[-1]): int(value)
+               for key, value in published.items()}
+      if expected <= set(steps):
         break
+      missing = expected - set(steps)
+      if not retried and missing:
+        done = self._done_peers()
+        if missing <= set(done):
+          # Every missing host completed and said goodbye before the
+          # proposal landed: retry the negotiation once against the
+          # surviving hosts. The survivors' max is the target; the done
+          # hosts' final states are already committed by their own final
+          # saves, and they are excluded from the remaining commits.
+          retried = True
+          expected = expected - missing
+          self._m_retries.inc()
+          logging.warning(
+              'Coordinated stop: host(s) %s completed and exited before '
+              'the proposal; retrying the negotiation against surviving '
+              'host(s) %s.', sorted(missing), sorted(expected))
+          continue
       if time.monotonic() > deadline:
         metrics_lib.counter('distributed/barrier_timeouts').inc()
         raise DeadHostError(
-            f'coordinated shutdown negotiation: only {len(published)} of '
-            f'{self._ctx.process_count} processes published a stop '
-            f'boundary within {self._timeout:.0f}s — one or more peers '
-            f'died mid-negotiation. Restart the job; it will resume from '
+            f'coordinated shutdown negotiation: only '
+            f'{len(set(steps) & expected)} of {len(expected)} expected '
+            f'processes published a stop boundary within '
+            f'{self._timeout:.0f}s — one or more peers died '
+            f'mid-negotiation. Restart the job; it will resume from '
             f'the last committed checkpoint.')
       time.sleep(self._poll_interval)
-    # Keys come back namespace-stripped but path-full: 'shutdown/step/<p>'.
-    steps = {int(key.rsplit('/', 1)[-1]): int(value)
-             for key, value in published.items()}
+    steps = {h: s for h, s in steps.items() if h in expected}
     self._target = max(steps.values())
+    self.participants = sorted(expected)
     self._m_stops.inc()
     self._m_target.set(self._target)
     logging.warning(
-        'Coordinated stop agreed: all %d processes checkpoint at step %d '
-        '(published boundaries: %s).', self._ctx.process_count,
-        self._target, {f'host{h}': s for h, s in sorted(steps.items())})
+        'Coordinated stop agreed: %d process(es) %s checkpoint at step '
+        '%d (published boundaries: %s).', len(expected),
+        sorted(expected), self._target,
+        {f'host{h}': s for h, s in sorted(steps.items())})
     return self._target
 
 

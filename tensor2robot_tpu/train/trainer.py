@@ -291,11 +291,49 @@ class TrainerConfig:
   # shape, microbatch config) against this run on restore; a mismatch is
   # a loud TopologyMismatchError instead of silently misread state.
   checkpoint_topology_check: bool = True
+  # Elastic topology resume: with reshape on (the default), a mismatch
+  # on the PURE-LAYOUT topology keys (process_count, device_count, mesh
+  # shape) becomes a resharding restore — target shardings are rebuilt
+  # from the CURRENT mesh and Orbax reshards the payload on read — so a
+  # preempted 2-host job resumes on 1 host (or 4) instead of dying on
+  # TopologyMismatchError. Semantic keys (grad_accum_microbatches,
+  # steps_per_dispatch) still fail loudly: they change what the state
+  # MEANS, not where it lives. False restores the strict PR-5 behavior.
+  checkpoint_reshape: bool = True
+  # Sharded multi-host checkpoint payloads: 'auto' shards whenever the
+  # state's arrays span processes (a true FSDP/pod mesh — each host then
+  # writes exactly the shards it owns); 'on' additionally stripes
+  # per-host replica-group state across hosts (requires replicas in
+  # lockstep, which deterministic same-stream training guarantees — the
+  # 2-process drills run this); 'off' keeps the single-writer path
+  # (process 0 writes everything).
+  checkpoint_sharded_payloads: str = 'auto'
+  # Async multi-host commit: unforced interval saves start their payload
+  # write at the save point but run the ack/marker agreement at LATER
+  # dispatch boundaries instead of blocking the loop on commit barriers
+  # (checkpoint/save_overlap_ms records the hidden write time). Forced
+  # saves — preemption, the final save — always commit synchronously, so
+  # shutdown never leaves a durable payload without its marker.
+  checkpoint_async_commit: bool = False
+  # Deadline for every cross-host wait in the commit protocol; a peer
+  # that misses it surfaces as a bounded DeadHostError, never a hang.
+  checkpoint_barrier_timeout_secs: float = 600.0
 
   def resolved_distributed_coordination(self) -> bool:
     if self.distributed_coordination is not None:
       return self.distributed_coordination
     return jax.process_count() > 1
+
+  def resolved_sharded_payloads(self, mesh) -> bool:
+    if self.checkpoint_sharded_payloads == 'on':
+      return True
+    if self.checkpoint_sharded_payloads == 'off':
+      return False
+    if self.checkpoint_sharded_payloads != 'auto':
+      raise ValueError(
+          f"checkpoint_sharded_payloads must be 'auto', 'on' or 'off'; "
+          f'got {self.checkpoint_sharded_payloads!r}')
+    return mesh is not None and mesh_lib.mesh_spans_processes(mesh)
 
   def resolved_auto_input_layouts(self) -> bool:
     if jax.process_count() > 1:
@@ -790,6 +828,10 @@ class Trainer:
           steps_per_dispatch=self._loop_k)
     self._manager: Optional[ckpt_lib.CheckpointManager] = None
     if config.model_dir:
+      sharding_rules = ()
+      if hasattr(model, 'param_sharding_rules'):
+        sharding_rules = tuple(
+            model.param_sharding_rules(self._mesh) or ())
       self._manager = ckpt_lib.CheckpointManager(
           os.path.join(config.model_dir, 'checkpoints'),
           max_to_keep=config.max_checkpoints_to_keep,
@@ -797,7 +839,13 @@ class Trainer:
           save_interval_steps=config.save_interval_steps,
           async_save=config.async_checkpoints,
           topology=topology,
-          distributed=self._dist_ctx)
+          distributed=self._dist_ctx,
+          barrier_timeout_secs=config.checkpoint_barrier_timeout_secs,
+          sharded=config.resolved_sharded_payloads(self._mesh),
+          async_commit=config.checkpoint_async_commit,
+          reshape=config.checkpoint_reshape,
+          mesh=self._mesh,
+          sharding_rules=sharding_rules)
     # Opt-in live metrics endpoint (config port or T2R_METRICSZ_PORT
     # env); process-global and idempotent, so a second Trainer in the
     # same process reuses the running server.
@@ -1138,10 +1186,13 @@ class Trainer:
     self._eval_step_fn = self._build_eval_step()
     return self._state
 
-  def save_checkpoint(self, force: bool = False) -> None:
+  def save_checkpoint(self, force: bool = False,
+                      sync: Optional[bool] = None) -> None:
+    """Saves the current state; ``sync=True`` (preemption/final saves)
+    forces the barriered commit even under checkpoint_async_commit."""
     if self._manager is None or self._state is None:
       return
-    if self._manager.save(self.step, self._state, force=force):
+    if self._manager.save(self.step, self._state, force=force, sync=sync):
       for cb in self._callbacks:
         cb.after_checkpoint(self, self.step)
 
@@ -1263,7 +1314,6 @@ class Trainer:
     # medium — without one, liveness degrades to barrier timeouts only).
     coordinated: Optional[dist_lib.CoordinatedShutdown] = None
     if self._dist_ctx is not None:
-      coordinated = dist_lib.CoordinatedShutdown(self._dist_ctx, shutdown)
       if config.model_dir:
         self._heartbeat = dist_lib.HeartbeatService(
             os.path.join(config.model_dir,
@@ -1276,6 +1326,12 @@ class Trainer:
             action=config.liveness_action)
         self._heartbeat.set_step(step)
         self._heartbeat.start()
+      # Goodbye heartbeats let the negotiation retry against surviving
+      # hosts when a peer completed and exited before a late proposal.
+      coordinated = dist_lib.CoordinatedShutdown(
+          self._dist_ctx, shutdown,
+          peer_heartbeats=(self._heartbeat.read_peers
+                           if self._heartbeat is not None else None))
     # The step ALL processes agreed to stop at (or this process's own
     # boundary for a single-process shutdown). The loop keeps training
     # until it reaches it, so every host's forced checkpoint lands on
@@ -1289,6 +1345,11 @@ class Trainer:
             # local SIGTERM to every process and agrees on the common
             # stop step (max of all published boundaries).
             stop_step = coordinated.poll(step)
+            if (stop_step is not None and self._manager is not None and
+                coordinated.participants is not None):
+              # Hosts that completed and said goodbye before the
+              # proposal are excluded from the remaining commits.
+              self._manager.set_participants(coordinated.participants)
           elif shutdown is not None and shutdown.requested:
             stop_step = step
         if stop_step is not None and step >= stop_step:
@@ -1300,7 +1361,7 @@ class Trainer:
           logging.warning(
               'Graceful shutdown requested; checkpointing step %d and '
               'raising PreemptedError (resumable).', self.step)
-          self.save_checkpoint(force=True)
+          self.save_checkpoint(force=True, sync=True)
           if self._manager is not None:
             self._manager.wait_until_finished()
           for cb in self._callbacks:
@@ -1350,6 +1411,11 @@ class Trainer:
           # Liveness payload: peers (and post-mortem tooling) see the
           # last COMPLETED dispatch boundary, not a wall-clock guess.
           self._heartbeat.set_step(step)
+        if self._manager is not None and self._dist_ctx is not None:
+          # Async-commit progress (checkpoint_async_commit): the commit
+          # primary publishes the marker for an in-flight save once every
+          # participant's payload is durable — no barrier on the loop.
+          self._manager.poll_async_commit()
         if self._nonfinite_policy is not None:
           prev, pending_nonfinite = pending_nonfinite, (
               scalars.get('nonfinite_count'), step)
@@ -1401,14 +1467,21 @@ class Trainer:
       # Flush the final dispatch's flag before declaring success.
       self._nonfinite_policy.observe(*pending_nonfinite)
     if coordinated is not None and stop_step is None:
-      # A peer may have proposed a stop while this host was finishing its
-      # last dispatch: join the (bounded) negotiation so the peer is not
-      # stranded at the barrier. Any agreed target includes this host's
-      # completed boundary in its max, so completion proceeds normally —
-      # and the final save's commit barriers align across hosts because
-      # every host saves the same final step.
+      # Completion: publish this host's final boundary UNCONDITIONALLY —
+      # a peer whose SIGTERM lands after this moment (the completed-host
+      # vs late-proposal race) finds it in the KV store and converges on
+      # it, even though this host will never poll again. Then join any
+      # already-in-flight negotiation so the peer is not stranded: the
+      # agreed target includes this host's completed boundary in its
+      # max, so completion proceeds normally and the final save's commit
+      # barriers align across hosts (every host saves the same final
+      # step).
+      coordinated.publish_boundary(step)
       coordinated.poll(step)
-    self.save_checkpoint(force=True)
+      if (self._manager is not None and
+          coordinated.participants is not None):
+        self._manager.set_participants(coordinated.participants)
+    self.save_checkpoint(force=True, sync=True)
     if self._manager is not None:
       self._manager.wait_until_finished()
     if eval_iter_fn is not None and not eval_metrics:

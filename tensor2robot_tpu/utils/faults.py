@@ -29,6 +29,12 @@ drills over the real 2-process ``jax.distributed`` harness):
   tear a checkpoint the way a mid-commit death does: the step's payload
   looks complete but the commit protocol never finished, so restore
   must skip it.
+* :func:`install_kill_during_save` — SIGKILL this process INSIDE the
+  sharded-payload write window (after the Orbax multiprocess write
+  started, before any ack/commit): the exact anatomy of a host dying
+  mid-save, which must leave the step torn (invisible) and surface on
+  the survivors as a bounded liveness exit, never a committed marker
+  over a half-written payload.
 
 All schedules are explicit step/index sets or seeded draws — a failing
 test replays bit-identically.
@@ -253,6 +259,34 @@ def remove_commit_marker(ckpt_dir: str, step: int) -> None:
   if not os.path.exists(path):
     raise FileNotFoundError(path)
   os.remove(path)
+
+
+def install_kill_during_save(at_step: int, signum: int = 9) -> None:
+  """Arms a SIGKILL inside the next sharded save at/after ``at_step``.
+
+  The hook fires on this host once its Orbax multiprocess payload write
+  has STARTED for the step, strictly before the host's ack — so the
+  peers observe a writer that went silent mid-payload. The survivors'
+  contract: the step stays uncommitted (no ``commit.json``), their exit
+  is bounded (barrier timeout → ``DeadHostError`` or heartbeat liveness
+  → status 43), and a restart resumes from the last COMMITTED step.
+  """
+  from tensor2robot_tpu.train import checkpoints as ckpt_lib
+
+  at_step = int(at_step)
+
+  def hook(step: int) -> None:
+    if step >= at_step:
+      os.kill(os.getpid(), int(signum))
+
+  ckpt_lib._during_save_hook = hook  # pylint: disable=protected-access
+
+
+def clear_kill_during_save() -> None:
+  """Disarms :func:`install_kill_during_save` (test teardown)."""
+  from tensor2robot_tpu.train import checkpoints as ckpt_lib
+
+  ckpt_lib._during_save_hook = None  # pylint: disable=protected-access
 
 
 def corrupt_checkpoint_host_ack(ckpt_dir: str, step: int, host: int) -> None:

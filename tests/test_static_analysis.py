@@ -632,6 +632,193 @@ class TestDeadCode:
     assert findings == []
 
 
+# ================================================== blocking under lock
+
+
+BLOCKING_BAD = '''
+import threading
+import queue
+import jax
+
+class Pool:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._q = queue.Queue()
+    self._threads = []
+
+  def close(self):
+    with self._lock:
+      for t in self._threads:
+        t.join()                   # BAD: worker may need this lock
+      item = self._q.get()         # BAD: producer may need this lock
+      return jax.device_get(item)  # BAD: device sync under lock
+
+  def drain(self, manager, fut):
+    with self._lock:
+      manager.wait_until_finished()  # BAD: multi-host barrier under lock
+      return fut.result()            # BAD: future blocks under lock
+'''
+
+BLOCKING_GOOD = '''
+import threading
+
+class Pool:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._threads = []
+    self._index = {}
+
+  def close(self):
+    # Snapshot under the lock, block OUTSIDE it — the fixed shape.
+    with self._lock:
+      snapshot = list(self._threads)
+      label = ', '.join(t.name for t in snapshot)  # str.join: not a wait
+      entry = self._index.get(label)               # dict.get(key): lookup
+    for t in snapshot:
+      t.join()
+    return entry
+
+  def worker_joins_elsewhere(self):
+    with self._lock:
+      def later():
+        self._threads[0].join()  # nested def runs later, not under lock
+      return later
+
+  def bounded(self, t):
+    with self._lock:
+      # ANALYSIS_OK(blocking-under-lock): t exited before close() was
+      # callable; join returns immediately.
+      t.join()
+'''
+
+
+class TestBlockingUnderLock:
+
+  def test_fires_on_blocking_calls_under_lock(self):
+    findings = _unwaived(_analyze(BLOCKING_BAD), 'blocking-under-lock')
+    assert len(findings) == 5, findings
+    messages = ' '.join(f.message for f in findings)
+    assert 'join()' in messages and 'get()' in messages
+    assert 'device_get' in messages and 'wait_until_finished' in messages
+    assert all(f.check == 'blocking-call-under-lock' for f in findings)
+
+  def test_quiet_on_snapshot_then_block_and_waiver(self):
+    findings = _analyze(BLOCKING_GOOD)
+    assert _unwaived(findings, 'blocking-under-lock') == []
+    waived = [f for f in findings
+              if f.waived and f.rule == 'blocking-under-lock']
+    assert len(waived) == 1 and 'before close' in waived[0].waiver_reason
+
+  def test_rw_lock_context_managers_are_locks(self):
+    source = '''
+import threading
+
+class P:
+  def __init__(self, rw):
+    self._rw = rw
+
+  def reload(self, thread):
+    with self._rw.write_locked():
+      thread.join()  # BAD: blocking under the writer lock
+'''
+    findings = _unwaived(_analyze(source), 'blocking-under-lock')
+    assert len(findings) == 1 and 'self._rw' in findings[0].message
+
+
+# ====================================================== donated reuse
+
+
+DONATE_BAD = '''
+import jax
+from jax import lax
+
+
+def _step(state, batch):
+  return state
+
+
+train_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def run(state, batch):
+  new_state = train_step(state, batch)
+  loss = state['loss']          # BAD: read after donation
+  return new_state, loss
+
+
+def alias(state, batch):
+  del batch
+  return train_step(state, state)   # BAD: one buffer, two views
+
+
+def scan_user(body, carry, xs):
+  final, ys = lax.scan(body, carry, xs)
+  del ys
+  return final + carry          # BAD: stale initial carry
+'''
+
+DONATE_GOOD = '''
+import jax
+from jax import lax
+
+
+def _step(state, batch):
+  return state
+
+
+def _build():
+  return jax.jit(_step, donate_argnums=(0,))
+
+
+train_step = _build()
+
+
+def run(state, batch):
+  before = state['step']        # read BEFORE the donating call: fine
+  state = train_step(state, batch)   # rebind over the donated name
+  return state, before
+
+
+def scan_user(body, carry, xs):
+  carry, ys = lax.scan(body, carry, xs)  # carry rebound over itself
+  return carry, ys
+
+
+def non_donating(state, batch):
+  plain = jax.jit(_step)
+  out = plain(state, batch)
+  return out, state             # no donation: reading state is fine
+'''
+
+
+class TestDonatedReuse:
+
+  def test_fires_on_reuse_alias_and_stale_carry(self):
+    findings = _unwaived(_analyze(DONATE_BAD), 'donated-reuse')
+    checks = sorted(f.check for f in findings)
+    assert checks == ['aliased-donation', 'stale-scan-carry',
+                      'use-after-donate'], findings
+    by_check = {f.check: f for f in findings}
+    assert "'state'" in by_check['use-after-donate'].message
+    assert 'donate_argnums' in by_check['use-after-donate'].message
+    assert "'carry'" in by_check['stale-scan-carry'].message
+
+  def test_quiet_on_rebind_factory_and_pre_donation_reads(self):
+    # The factory-returned donating jit is tracked (run() would fire on
+    # a post-donation read) but every idiom here is the safe shape.
+    assert _unwaived(_analyze(DONATE_GOOD), 'donated-reuse') == []
+
+  def test_factory_bound_donation_is_tracked(self):
+    source = DONATE_GOOD + '''
+
+def bad(state, batch):
+  new = train_step(state, batch)
+  return new, state   # BAD: factory-bound donate_argnums still tracked
+'''
+    findings = _unwaived(_analyze(source), 'donated-reuse')
+    assert [f.check for f in findings] == ['use-after-donate']
+
+
 # ================================================================ gate
 
 

@@ -58,7 +58,9 @@ def _diff_files(base: str) -> list:
 
 
 def _checkers_for(rules):
+  from tensor2robot_tpu.analysis import blocking_under_lock
   from tensor2robot_tpu.analysis import dead_code
+  from tensor2robot_tpu.analysis import donated_reuse
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
   from tensor2robot_tpu.analysis import recompile_hazards
@@ -68,6 +70,8 @@ def _checkers_for(rules):
       'jit-hazard': jit_hazards.check,
       'recompile-hazard': recompile_hazards.check,
       'dead-code': dead_code.check,
+      'blocking-under-lock': blocking_under_lock.check,
+      'donated-reuse': donated_reuse.check,
   }
   if not rules:
     return None  # all

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Inspect a checkpoint directory's commit/topology state (stdlib-only).
+
+The operator-facing half of elastic topology resume: before resuming a
+preempted job onto a different slice shape, see exactly what is on disk
+— which steps are COMMITTED vs TORN, the topology each was saved with
+(process count, mesh shape, microbatch config), which hosts acked, and
+how the payload is sharded across writers. Runs anywhere (no jax/orbax
+import; it only reads the marker/ack JSON and lists the payload).
+
+    python tools/inspect_checkpoint.py <model_dir>/checkpoints
+    python tools/inspect_checkpoint.py <ckpt_dir> --step 1200
+    python tools/inspect_checkpoint.py <ckpt_dir> --json | jq .steps
+
+Verdicts:
+
+  committed    commit.json present — restore will consider this step.
+  torn         no marker while other steps have one: a save cut off by
+               preemption or a dead host; invisible to restore.
+  legacy       no marker anywhere in the directory (pre-commit-protocol
+               layout): restore keeps the try-newest/fall-back behavior.
+
+Exit status: 0 when the directory holds at least one restorable step,
+1 otherwise (empty/unreadable/all-torn) — scriptable as a pre-resume
+health check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+COMMIT_FILENAME = 'commit.json'
+HOST_ACK_PREFIX = 'host_ack_'
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+  try:
+    with open(path, encoding='utf-8') as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return None
+
+
+def _step_dirs(directory: str) -> Dict[int, str]:
+  out: Dict[int, str] = {}
+  try:
+    names = os.listdir(directory)
+  except OSError:
+    return out
+  for name in names:
+    if not name.startswith('ckpt_') or name.endswith(
+        '.orbax-checkpoint-tmp'):
+      continue
+    suffix = name.rsplit('_', 1)[-1]
+    if suffix.isdigit():
+      out[int(suffix)] = os.path.join(directory, name)
+  return out
+
+
+def _dir_bytes(path: str) -> int:
+  total = 0
+  for dirpath, _, filenames in os.walk(path):
+    for name in filenames:
+      try:
+        total += os.path.getsize(os.path.join(dirpath, name))
+      except OSError:
+        pass
+  return total
+
+
+def _shard_layout(step_dir: str) -> Dict[str, Any]:
+  """What the payload physically looks like: one writer or N."""
+  item_dir = os.path.join(step_dir, 'default')
+  if not os.path.isdir(item_dir):
+    item_dir = step_dir
+  layout: Dict[str, Any] = {
+      'item_dir': os.path.relpath(item_dir, step_dir) or '.',
+      # CheckpointManager writes the metadata at the step level; the raw
+      # multiprocess Checkpointer writes it inside the item dir.
+      'finalized': any(
+          os.path.exists(os.path.join(d, '_CHECKPOINT_METADATA'))
+          for d in (item_dir, step_dir)),
+      'process_stores': {},
+  }
+  try:
+    names = sorted(os.listdir(item_dir))
+  except OSError:
+    names = []
+  for name in names:
+    if name.startswith('ocdbt.process_'):
+      layout['process_stores'][name.rsplit('_', 1)[-1]] = {
+          'bytes': _dir_bytes(os.path.join(item_dir, name))}
+    if name.endswith('.orbax-checkpoint-tmp') or (
+        '.orbax-checkpoint-tmp-' in name):
+      layout.setdefault('stale_tmp_dirs', []).append(name)
+  layout['total_bytes'] = _dir_bytes(step_dir)
+  return layout
+
+
+def _acks(step_dir: str) -> List[Dict[str, Any]]:
+  acks = []
+  try:
+    names = sorted(os.listdir(step_dir))
+  except OSError:
+    return acks
+  for name in names:
+    if not (name.startswith(HOST_ACK_PREFIX) and name.endswith('.json')):
+      continue
+    payload = _read_json(os.path.join(step_dir, name))
+    if payload is None:
+      acks.append({'file': name, 'unparseable': True})
+    else:
+      payload['file'] = name
+      acks.append(payload)
+  return acks
+
+
+def inspect_step(directory: str, step: int, step_dir: str,
+                 protocol_active: bool) -> Dict[str, Any]:
+  marker = _read_json(os.path.join(step_dir, COMMIT_FILENAME))
+  if marker is not None:
+    verdict = 'committed'
+  elif protocol_active:
+    verdict = 'torn'
+  else:
+    verdict = 'legacy'
+  acks = _acks(step_dir)
+  incarnation = (marker or {}).get('incarnation')
+  for ack in acks:
+    if incarnation is not None and not ack.get('unparseable'):
+      ack['stale'] = ack.get('incarnation') != incarnation
+  info: Dict[str, Any] = {
+      'step': step,
+      'verdict': verdict,
+      'topology': (marker or {}).get('topology'),
+      'format': (marker or {}).get('format'),
+      'committed_hosts': (marker or {}).get('hosts'),
+      'commit_time': (marker or {}).get('time'),
+      'incarnation': incarnation,
+      'acks': acks,
+      'shard_layout': _shard_layout(step_dir),
+  }
+  del directory
+  return info
+
+
+def inspect_directory(directory: str) -> Dict[str, Any]:
+  directory = os.path.abspath(directory)
+  steps = _step_dirs(directory)
+  protocol_active = any(
+      os.path.exists(os.path.join(path, COMMIT_FILENAME))
+      for path in steps.values())
+  out: Dict[str, Any] = {
+      'directory': directory,
+      'protocol_active': protocol_active,
+      'steps': [
+          inspect_step(directory, step, steps[step], protocol_active)
+          for step in sorted(steps)
+      ],
+  }
+  committed = [s['step'] for s in out['steps']
+               if s['verdict'] in ('committed', 'legacy')]
+  out['latest_restorable_step'] = committed[-1] if committed else None
+  out['torn_steps'] = [s['step'] for s in out['steps']
+                       if s['verdict'] == 'torn']
+  return out
+
+
+def _print_human(report: Dict[str, Any]) -> None:
+  print(f"checkpoint dir: {report['directory']}")
+  print(f"commit protocol: "
+        f"{'active' if report['protocol_active'] else 'legacy (no markers)'}")
+  for info in report['steps']:
+    print(f"\nstep {info['step']}: {info['verdict'].upper()}")
+    topo = info['topology']
+    if topo:
+      mesh = topo.get('mesh_shape')
+      print(f"  topology: processes={topo.get('process_count')} "
+            f"devices={topo.get('device_count')} mesh={mesh} "
+            f"microbatches={topo.get('grad_accum_microbatches')} "
+            f"steps_per_dispatch={topo.get('steps_per_dispatch')}")
+    if info['format']:
+      print(f"  format: {info['format']}  "
+            f"committed hosts: {info['committed_hosts']}")
+    layout = info['shard_layout']
+    stores = layout['process_stores']
+    if stores:
+      per_host = ', '.join(
+          f"process_{p}: {meta['bytes']:,} B" for p, meta in stores.items())
+      print(f"  shards: {len(stores)} writer(s) ({per_host})")
+    print(f"  payload: {layout['total_bytes']:,} B, "
+          f"finalized={layout['finalized']}")
+    fresh = [a for a in info['acks']
+             if not a.get('unparseable') and not a.get('stale')]
+    stale = [a for a in info['acks'] if a.get('stale')]
+    if info['acks']:
+      print(f"  acks: {sorted(a.get('process_index') for a in fresh)}"
+            + (f" (+{len(stale)} stale from a previous attempt)"
+               if stale else ''))
+  print(f"\nlatest restorable step: {report['latest_restorable_step']}")
+  if report['torn_steps']:
+    print(f"torn (invisible) steps: {report['torn_steps']}")
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  parser.add_argument('directory',
+                      help='checkpoint dir (<model_dir>/checkpoints)')
+  parser.add_argument('--step', type=int, default=None,
+                      help='inspect only this step')
+  parser.add_argument('--json', action='store_true', dest='as_json',
+                      help='machine-readable output')
+  args = parser.parse_args(argv)
+
+  report = inspect_directory(args.directory)
+  if args.step is not None:
+    report['steps'] = [s for s in report['steps']
+                       if s['step'] == args.step]
+    if not report['steps']:
+      print(f'no step {args.step} under {report["directory"]}',
+            file=sys.stderr)
+      return 1
+  if args.as_json:
+    print(json.dumps(report, indent=2, sort_keys=True))
+  else:
+    _print_human(report)
+  return 0 if report['latest_restorable_step'] is not None else 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())
