@@ -531,6 +531,40 @@ def bench_serving_plane(clients_sweep=(1, 8, 16, 32), headline_clients=32,
                     'value': round(head.latency_ms_p99, 2), 'unit': 'ms',
                     'clients': head.clients}))
 
+  # Incident-observability overhead pin (ISSUE 10 acceptance): the
+  # headline load with the flight ring + FULL per-request lifecycle
+  # tracing (request_trace_sample=1.0 — production default is 0, i.e.
+  # off) must hold >= 0.97x the untraced plane. Measured as ALTERNATING
+  # untraced/traced slices against two live planes (A-B-A-B): adjacent
+  # slices see the same machine, so slow CPU drift — which dwarfs the
+  # effect at +-5% between non-adjacent runs — cancels out of the ratio.
+  with DynamicBatcher(predictor, max_batch=64, batch_deadline_ms=0.2
+                      ) as plain_batcher, \
+       DynamicBatcher(predictor, max_batch=64, batch_deadline_ms=0.2,
+                      request_trace_sample=1.0) as traced_batcher:
+    slices = {'untraced': [], 'traced': []}
+    for _ in range(2):
+      for name, batcher in (('untraced', plain_batcher),
+                            ('traced', traced_batcher)):
+        slices[name].append(loadgen.run_load(
+            loadgen.inproc_submit_fn(batcher), features_fn,
+            num_clients=headline_clients,
+            duration_secs=duration_secs / 2).actions_per_sec)
+  untraced_aps = sum(slices['untraced']) / len(slices['untraced'])
+  traced_aps = sum(slices['traced']) / len(slices['traced'])
+  print(json.dumps({
+      'metric': 'serving_flight_overhead',
+      'value': round(traced_aps / untraced_aps, 4) if untraced_aps else None,
+      'unit': 'traced/untraced actions-per-sec ratio',
+      'clients': headline_clients,
+      'traced_actions_per_sec': round(traced_aps, 1),
+      'untraced_actions_per_sec': round(untraced_aps, 1),
+      'request_trace_sample': 1.0,
+      'note': 'flight ring + queued/assembled/dispatched/returned events '
+              'for EVERY request, interleaved A-B-A-B slices; acceptance '
+              '>= 0.97x untraced',
+  }))
+
   # Quantized serving (int8 weight-only, parity-gated): the same sweep
   # against the quantized plane. The mock is weight-streaming-bound, so
   # the param-bytes ratio is the mechanism; the throughput delta on CPU

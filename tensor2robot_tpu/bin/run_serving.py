@@ -78,6 +78,16 @@ def main(argv=None):
                       help='Relative term of the quantization parity '
                            'band (scaled by the full-precision output '
                            'magnitude).')
+  parser.add_argument('--request-trace-sample', type=float, default=0.0,
+                      help='Fraction of requests whose queued/assembled/'
+                           'dispatched/returned lifecycle is recorded '
+                           'into the flight ring (0 disables; request '
+                           'IDs + latency exemplars are always on).')
+  parser.add_argument('--postmortem-dir', default=None,
+                      help='Directory for incident bundles: a reload '
+                           'failure falling back to the last-good model '
+                           'dumps flight events + metrics history here '
+                           '(render with tools/postmortem.py).')
   args = parser.parse_args(argv)
   logging.basicConfig(
       level=logging.INFO,
@@ -108,7 +118,9 @@ def main(argv=None):
       reload_interval_secs=reload_interval,
       quantize=args.quantize,
       quant_parity_atol=args.quant_parity_atol,
-      quant_parity_rtol=args.quant_parity_rtol)
+      quant_parity_rtol=args.quant_parity_rtol,
+      request_trace_sample=args.request_trace_sample,
+      postmortem_dir=args.postmortem_dir)
 
   stop = threading.Event()
 

@@ -14,6 +14,15 @@
   telemetry: allocator ``memory_stats()`` published as
   ``device/memory/*`` gauges, train scalars, and the
   ``device_memory_peak_mb`` readings BENCH batch-curve points record.
+* :mod:`~tensor2robot_tpu.observability.flight` — the crash-forensics
+  flight recorder: a bounded ring of structured events (spans, dispatch
+  boundaries, checkpoint commits, hot swaps, shutdown proposals,
+  request lifecycles) capturing the seconds before an incident.
+* :mod:`~tensor2robot_tpu.observability.timeseries` — periodic registry
+  snapshots in a bounded ring (``/metricsz?history=1``).
+* :mod:`~tensor2robot_tpu.observability.postmortem` — one-file incident
+  bundles written on every abnormal-exit path; rendered by
+  ``tools/postmortem.py``.
 
 The trainer's per-dispatch step-time breakdown (host wait / H2D
 placement / device step / callbacks, ``examples_per_sec``,
@@ -21,19 +30,24 @@ placement / device step / callbacks, ``examples_per_sec``,
 ``train/trainer.py`` and the README "Observability" section.
 """
 
-from tensor2robot_tpu.observability import memory, metrics, metricsz, tracing
+from tensor2robot_tpu.observability import (flight, memory, metrics,
+                                            metricsz, postmortem,
+                                            timeseries, tracing)
+from tensor2robot_tpu.observability.flight import FlightRecorder
 from tensor2robot_tpu.observability.memory import (device_memory_peak_mb,
                                                    device_memory_stats,
                                                    memory_scalars)
 from tensor2robot_tpu.observability.metrics import (Counter, Gauge,
                                                     Histogram, Registry)
+from tensor2robot_tpu.observability.timeseries import TimeSeriesRecorder
 from tensor2robot_tpu.observability.tracing import (capture,
                                                     dump_chrome_trace, span,
                                                     step_annotation)
 
 __all__ = [
-    'memory', 'metrics', 'metricsz', 'tracing', 'Counter', 'Gauge',
-    'Histogram', 'Registry', 'capture', 'device_memory_peak_mb',
+    'flight', 'memory', 'metrics', 'metricsz', 'postmortem', 'timeseries',
+    'tracing', 'Counter', 'FlightRecorder', 'Gauge', 'Histogram',
+    'Registry', 'TimeSeriesRecorder', 'capture', 'device_memory_peak_mb',
     'device_memory_stats', 'dump_chrome_trace', 'memory_scalars', 'span',
     'step_annotation',
 ]

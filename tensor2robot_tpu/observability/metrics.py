@@ -38,7 +38,7 @@ import math
 import os
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'Registry', 'Scope', 'counter',
@@ -106,6 +106,16 @@ class Histogram:
   estimates are upper bucket edges — within 2× of truth at any scale,
   which is the resolution that matters for "where did the time go"
   questions (a 2× bucket cannot hide an order-of-magnitude regression).
+
+  ``observe(value, exemplar=...)`` additionally remembers ONE exemplar
+  label per bucket (the latest) — e.g. the serving plane attaches each
+  request's ID to its latency observation, so a p99 outlier bucket
+  points at a concrete request whose flight-ring trace slice can be
+  pulled. Bounded: at most one string ref per occupied bucket, and the
+  bucket count is bounded by the value range (~2100 worst case, dozens
+  in practice). ``snapshot()`` includes an ``exemplars`` entry (bucket
+  upper edge → label) only when any exist, keeping the plain-histogram
+  document unchanged.
   """
 
   kind = 'histogram'
@@ -118,8 +128,9 @@ class Histogram:
     self._min = math.inf  # GUARDED_BY(self._lock)
     self._max = -math.inf  # GUARDED_BY(self._lock)
     self._buckets: Dict[int, int] = {}  # GUARDED_BY(self._lock)
+    self._exemplars: Dict[int, str] = {}  # GUARDED_BY(self._lock)
 
-  def observe(self, value: float) -> None:
+  def observe(self, value: float, exemplar: Optional[str] = None) -> None:
     value = float(value)
     with self._lock:
       self._count += 1
@@ -132,6 +143,8 @@ class Histogram:
       # covers (2**(e-1), 2**e]. Zero and negatives share bucket -inf→0.
       e = math.frexp(value)[1] if value > 0.0 else -1075
       self._buckets[e] = self._buckets.get(e, 0) + 1
+      if exemplar is not None:
+        self._exemplars[e] = str(exemplar)
 
   def _percentile_locked(self, fraction: float) -> float:  # HOLDS(self._lock)
     if self._count == 0:
@@ -157,12 +170,22 @@ class Histogram:
     with self._lock:
       return self._sum / self._count if self._count else 0.0
 
+  @staticmethod
+  def bucket_upper(exponent: int) -> float:
+    """The inclusive upper edge of a frexp-exponent bucket."""
+    return 0.0 if exponent == -1075 else math.ldexp(1.0, exponent)
+
+  def bucket_counts(self) -> Dict[int, int]:
+    """Raw ``{frexp exponent: count}`` (for exposition formats)."""
+    with self._lock:
+      return dict(self._buckets)
+
   def snapshot(self):
     with self._lock:
       if self._count == 0:
         return {'count': 0, 'sum': 0.0, 'min': 0.0, 'max': 0.0,
                 'mean': 0.0, 'p50': 0.0, 'p90': 0.0, 'p99': 0.0}
-      return {
+      out = {
           'count': self._count,
           'sum': self._sum,
           'min': self._min,
@@ -172,6 +195,12 @@ class Histogram:
           'p90': self._percentile_locked(0.90),
           'p99': self._percentile_locked(0.99),
       }
+      if self._exemplars:
+        out['exemplars'] = {
+            repr(self.bucket_upper(e)): label
+            for e, label in sorted(self._exemplars.items())
+        }
+      return out
 
 
 class Registry:
@@ -214,6 +243,13 @@ class Registry:
   def names(self, prefix: str = '') -> List[str]:
     with self._lock:
       return sorted(n for n in self._metrics if n.startswith(prefix))
+
+  def items(self, prefix: str = '') -> List:
+    """Sorted ``(name, metric)`` pairs — exposition formats (e.g. the
+    Prometheus renderer) need the metric objects for bucket data."""
+    with self._lock:
+      return sorted((n, m) for n, m in self._metrics.items()
+                    if n.startswith(prefix))
 
   def snapshot(self, prefix: str = '') -> Dict[str, object]:
     """Point-in-time copy: counters → int, gauges → float, histograms →

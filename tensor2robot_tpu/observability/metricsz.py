@@ -15,8 +15,12 @@ default (metrics can reveal data paths — exposing them beyond the host
 is an operator decision via ``host=``).
 
 Endpoints:
-  ``/metricsz``  the full ``metrics.report()`` JSON document
-  ``/healthz``   ``{"status": "ok"}`` — liveness for fleet probes
+  ``/metricsz``              the full ``metrics.report()`` JSON document
+  ``/metricsz?history=1``    the time-series ring — periodic registry
+                             snapshots (``observability/timeseries.py``)
+  ``/metricsz?format=prom``  OpenMetrics/Prometheus text exposition, so
+                             standard scrapers work without a JSON shim
+  ``/healthz``               ``{"status": "ok"}`` — liveness probe
 """
 
 from __future__ import annotations
@@ -24,13 +28,66 @@ from __future__ import annotations
 import http.server
 import json
 import logging
+import math
 import os
+import re
 import threading
-from typing import Optional
+import urllib.parse
+from typing import List, Optional
 
 from tensor2robot_tpu.observability import metrics as metrics_lib
 
 ENV_VAR = 'T2R_METRICSZ_PORT'
+
+_PROM_NAME_RE = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _prom_name(name: str) -> str:
+  out = _PROM_NAME_RE.sub('_', name)
+  if out and out[0].isdigit():
+    out = '_' + out
+  return out
+
+
+def _prom_num(value: float) -> str:
+  if isinstance(value, float) and math.isinf(value):
+    return '+Inf' if value > 0 else '-Inf'
+  return repr(value) if isinstance(value, float) else str(value)
+
+
+def prom_exposition(registry: Optional[metrics_lib.Registry] = None) -> str:
+  """The registry as Prometheus/OpenMetrics text exposition (v0.0.4).
+
+  Mapping: ``Counter`` → ``<name>_total`` counter; ``Gauge`` → gauge;
+  ``Histogram`` → cumulative ``<name>_bucket{le="..."}`` series over the
+  power-of-two buckets plus ``_sum``/``_count``. Slash scopes become
+  underscores (``serving/request_latency_ms`` →
+  ``serving_request_latency_ms``).
+  """
+  registry = registry if registry is not None else metrics_lib.registry
+  lines: List[str] = []
+  for name, metric in registry.items():
+    pname = _prom_name(name)
+    if isinstance(metric, metrics_lib.Counter):
+      lines.append(f'# TYPE {pname}_total counter')
+      lines.append(f'{pname}_total {metric.value}')
+    elif isinstance(metric, metrics_lib.Gauge):
+      lines.append(f'# TYPE {pname} gauge')
+      lines.append(f'{pname} {_prom_num(metric.value)}')
+    elif isinstance(metric, metrics_lib.Histogram):
+      snap = metric.snapshot()
+      buckets = metric.bucket_counts()
+      lines.append(f'# TYPE {pname} histogram')
+      cumulative = 0
+      for exponent in sorted(buckets):
+        cumulative += buckets[exponent]
+        upper = metrics_lib.Histogram.bucket_upper(exponent)
+        lines.append(
+            f'{pname}_bucket{{le="{_prom_num(float(upper))}"}} {cumulative}')
+      lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
+      lines.append(f'{pname}_sum {_prom_num(float(snap["sum"]))}')
+      lines.append(f'{pname}_count {snap["count"]}')
+  return '\n'.join(lines) + '\n'
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -49,10 +106,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     self.end_headers()
     self.wfile.write(body)
 
+  def _reply_text(self, code: int, text: str, content_type: str) -> None:
+    body = text.encode()
+    self.send_response(code)
+    self.send_header('Content-Type', content_type)
+    self.send_header('Content-Length', str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
   def do_GET(self):  # noqa: N802 - stdlib naming
-    path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    parsed = urllib.parse.urlparse(self.path)
+    path = parsed.path.rstrip('/') or '/'
+    query = urllib.parse.parse_qs(parsed.query)
     if path == '/metricsz':
-      self._reply(200, metrics_lib.report())
+      if query.get('format', [''])[0] == 'prom':
+        self._reply_text(200, prom_exposition(),
+                         'text/plain; version=0.0.4; charset=utf-8')
+      elif query.get('history', [''])[0] not in ('', '0'):
+        from tensor2robot_tpu.observability import timeseries
+
+        self._reply(200, timeseries.history())
+      else:
+        self._reply(200, metrics_lib.report())
     elif path == '/healthz':
       self._reply(200, {'status': 'ok'})
     else:

@@ -1,0 +1,157 @@
+"""Postmortem bundles: one JSON file answering "what was it doing?".
+
+On every abnormal-exit path — graceful preemption (exit 42), a liveness
+kill (exit 43), ``nonfinite_mode='raise'``, an uncaught trainer
+exception, a serving reload falling back to last-good — :func:`dump`
+writes ``<model_dir>/postmortem/<ts>.json`` combining every
+observability surface at the moment of death:
+
+* the flight ring's last-window events (``observability/flight.py``);
+* the full ``metrics.report()`` (counters/gauges/histograms + report
+  providers — cluster, serving);
+* the metrics time-series window (``observability/timeseries.py``);
+* the last K closed ``_DispatchBreakdown`` windows (the trainer pushes
+  each via :func:`note_breakdown_window`);
+* the run topology and the terminal error.
+
+Render with ``tools/postmortem.py`` (timeline, top metric deltas,
+slowest spans; ``--json`` for machines).
+
+Contract with the exit paths that call this: **bounded and harmless.**
+``dump`` never raises (an observability failure must not mask the real
+one), rate-limits to one bundle per (directory, reason) per
+``MIN_INTERVAL_SECS`` (so a reload poller retrying a broken export
+cannot spray bundles), writes atomically (tmp + rename), and does only
+one bounded serialize+write — safe to run between the terminal log line
+and ``os._exit``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import timeseries
+
+__all__ = [
+    'dump', 'note_breakdown_window', 'breakdown_windows',
+    'POSTMORTEM_DIRNAME', 'DEFAULT_WINDOW_SECS', 'MIN_INTERVAL_SECS',
+]
+
+POSTMORTEM_DIRNAME = 'postmortem'
+
+# The event/time-series window a bundle captures: long enough to cover a
+# straggler's decline into a liveness kill (default 60 s timeout), short
+# enough that the bundle stays one readable file.
+DEFAULT_WINDOW_SECS = 300.0
+
+# Rate limit per (directory, reason): an exit dumps once; a retry loop
+# (serving reload poller) coalesces into one bundle per interval.
+MIN_INTERVAL_SECS = 30.0
+
+_BREAKDOWN_WINDOWS = 16
+
+_lock = threading.Lock()
+_last_dump: Dict[tuple, float] = {}  # GUARDED_BY(_lock)
+_windows: 'collections.deque' = collections.deque(  # GUARDED_BY(_lock)
+    maxlen=_BREAKDOWN_WINDOWS)
+
+
+def note_breakdown_window(scalars: Dict[str, float]) -> None:
+  """Retains one closed dispatch-breakdown window (bounded ring).
+
+  Called by ``_DispatchBreakdown.window_scalars`` at every log crossing;
+  the postmortem bundle then carries the last K windows of
+  wall/host-wait/placement/device decomposition — the trainer-side
+  "what was slow" record.
+  """
+  entry = {'time': time.time()}
+  entry.update({k: float(v) for k, v in scalars.items()})
+  with _lock:
+    _windows.append(entry)
+
+
+def breakdown_windows() -> list:
+  with _lock:
+    return list(_windows)
+
+
+def _should_dump(directory: str, reason: str) -> bool:
+  key = (os.path.abspath(directory), reason)
+  now = time.monotonic()
+  with _lock:
+    last = _last_dump.get(key)
+    if last is not None and now - last < MIN_INTERVAL_SECS:
+      return False
+    _last_dump[key] = now
+    return True
+
+
+def _reset_rate_limit_for_tests() -> None:
+  with _lock:
+    _last_dump.clear()
+    _windows.clear()
+
+
+def dump(model_dir: Optional[str],
+         reason: str,
+         exit_code: Optional[int] = None,
+         error: Optional[BaseException] = None,
+         topology: Optional[Dict[str, Any]] = None,
+         extra: Optional[Dict[str, Any]] = None,
+         window_secs: float = DEFAULT_WINDOW_SECS) -> Optional[str]:
+  """Writes one postmortem bundle; returns its path (None if skipped).
+
+  Never raises; rate-limited per (model_dir, reason). ``model_dir`` of
+  None/'' skips quietly — library embedders without a run directory
+  still get the terminal log line, just no bundle.
+  """
+  if not model_dir:
+    return None
+  try:
+    if not _should_dump(model_dir, reason):
+      return None
+    bundle = {
+        'kind': 'postmortem',
+        'version': 1,
+        'reason': reason,
+        'exit_code': exit_code,
+        'time': time.time(),
+        'pid': os.getpid(),
+        'window_secs': window_secs,
+        'error': None if error is None else {
+            'type': type(error).__name__,
+            'message': str(error)[:2000],
+        },
+        'topology': topology,
+        'events': flight.events(last_secs=window_secs),
+        'breakdown_windows': breakdown_windows(),
+        'timeseries': timeseries.history(last_secs=window_secs),
+        'metrics_report': metrics_lib.report(),
+    }
+    if extra:
+      bundle['extra'] = extra
+    directory = os.path.join(model_dir, POSTMORTEM_DIRNAME)
+    os.makedirs(directory, exist_ok=True)
+    stamp = time.strftime('%Y%m%dT%H%M%S', time.gmtime())
+    path = os.path.join(directory, f'{stamp}-{os.getpid()}-{reason}.json')
+    tmp = f'{path}.tmp{os.getpid()}'
+    with open(tmp, 'w') as f:
+      json.dump(bundle, f, indent=2, sort_keys=True, default=str)
+      f.write('\n')
+    os.replace(tmp, path)
+    logging.warning('Postmortem bundle written: %s (reason: %s).',
+                    path, reason)
+    return path
+  except Exception:  # pylint: disable=broad-except
+    # The bundle is forensics for ANOTHER failure; never let it eclipse
+    # that failure or block the exit path.
+    logging.exception('Postmortem dump failed (non-fatal).')
+    return None

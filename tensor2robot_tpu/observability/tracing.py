@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
-from tensor2robot_tpu.observability import metrics
+from tensor2robot_tpu.observability import flight, metrics
 
 __all__ = [
     'span', 'step_annotation', 'start_capture', 'stop_capture', 'capture',
@@ -117,6 +117,10 @@ class span:  # noqa: N801 - context manager used as a function
       self._ann.__exit__(None, None, None)
       self._ann = None
     metrics.histogram(self._name + '_ms').observe((t1 - self._t0) * 1e3)
+    # Flight-recorder feed: coarse (>= flight.span_feed_min_ms) spans
+    # land in the crash-forensics ring; the duration filter runs before
+    # any locking, so hot-loop micro-spans pay two float compares.
+    flight.note_span(self._name, self._t0, t1)
     # ANALYSIS_OK(lock-discipline): racy fast-path probe on the hot
     # span exit; _record_event re-checks under the lock before writing.
     if _events is not None:
@@ -139,8 +143,15 @@ def _record_event(name: str, t0: float, t1: float) -> None:
       return
     if len(_events) >= _events_cap:
       _dropped += 1
-      return
-    _events.append(event)
+      dropped_now = True
+    else:
+      _events.append(event)
+      dropped_now = False
+  if dropped_now:
+    # Registry mirror: a truncated capture is DETECTABLE from report()/
+    # /metricsz ('tracing/dropped_events'), not only from the trace
+    # file's own metadata. Outside the lock — the counter has its own.
+    metrics.counter('tracing/dropped_events').inc()
 
 
 def start_capture(max_events: int = 200_000) -> None:

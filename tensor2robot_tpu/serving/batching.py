@@ -62,13 +62,17 @@ gauge, ``serving/quant/*`` parity + compression gauges).
 from __future__ import annotations
 
 import collections
+import heapq
+import itertools
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
 
 
@@ -122,10 +126,11 @@ class _Request:
   """One client's queued examples + completion signal."""
 
   __slots__ = ('features', 'n', 'enqueue_time', 'event', 'outputs', 'error',
-               'model_version')
+               'model_version', 'request_id', 'traced', 'queued_wall')
 
   def __init__(self, features: Dict[str, np.ndarray], n: int,
-               enqueue_time: float):
+               enqueue_time: float, request_id: str = '',
+               traced: bool = False):
     self.features = features
     self.n = n
     self.enqueue_time = enqueue_time
@@ -133,6 +138,13 @@ class _Request:
     self.outputs: Optional[Dict[str, np.ndarray]] = None
     self.error: Optional[BaseException] = None
     self.model_version: int = -1
+    self.request_id = request_id
+    self.traced = traced
+    # Wall-clock submit time for traced requests: the dispatcher records
+    # the 'queued' flight event retroactively with this timestamp, so
+    # client threads never touch the ring (no lock contention at the
+    # submit edge).
+    self.queued_wall: float = 0.0
 
 
 class ServingFuture:
@@ -155,6 +167,11 @@ class ServingFuture:
   @property
   def model_version(self) -> int:
     return self._request.model_version
+
+  @property
+  def request_id(self) -> str:
+    """The ID assigned at submit (client-provided or generated)."""
+    return self._request.request_id
 
 
 class JitBucketExecutor:
@@ -310,6 +327,9 @@ class DynamicBatcher:
                quant_calibration_batches: int = 2,
                quant_calibration_batch_size: int = 4,
                quant_skip_patterns: Sequence[str] = (),
+               request_trace_sample: float = 0.0,
+               slow_request_log_size: int = 10,
+               postmortem_dir: Optional[str] = None,
                clock: Callable[[], float] = time.monotonic):
     if max_batch < 1:
       raise ValueError(f'max_batch must be >= 1, got {max_batch}')
@@ -334,6 +354,28 @@ class DynamicBatcher:
           f'{self._max_batch}: full batches could not dispatch')
     self._reload_interval = reload_interval_secs
     self._clock = clock
+    # Per-request tracing (the incident path): every request gets an ID
+    # at submit (echoed as X-Request-Id by the HTTP edge and attached to
+    # the latency histogram as a bucket exemplar); lifecycle events
+    # (queued → assembled → dispatched → returned) flow into the flight
+    # ring only for SAMPLED requests — off by default, overhead pinned
+    # by bench.py's serving_flight_overhead line.
+    if not 0.0 <= float(request_trace_sample) <= 1.0:
+      raise ValueError(f'request_trace_sample must be in [0, 1], got '
+                       f'{request_trace_sample!r}')
+    self._trace_sample = float(request_trace_sample)
+    self._trace_every = (int(round(1.0 / self._trace_sample))
+                         if self._trace_sample > 0 else 0)
+    # CPython-atomic sequence (itertools.count.__next__ holds the GIL);
+    # pid-tagged so IDs stay unique across a fleet's logs.
+    self._req_seq = itertools.count(1)
+    self._id_prefix = f'r{os.getpid():x}'
+    self._postmortem_dir = postmortem_dir
+    # Bounded sampled slow-request log: top-k completed requests by
+    # latency, surfaced in /statz so a p99 outlier names its request.
+    self._slow_k = max(0, int(slow_request_log_size))
+    self._slow_lock = threading.Lock()
+    self._slow_log: List[Tuple[float, int, Dict[str, Any]]] = []  # GUARDED_BY(self._slow_lock)
 
     self._cond = threading.Condition()
     self._pending: collections.deque = collections.deque()  # GUARDED_BY(self._cond)
@@ -375,6 +417,11 @@ class DynamicBatcher:
     self._m_quant_bytes_ratio = qs.gauge('param_bytes_ratio')
     self._m_quant_abs_err = qs.gauge('parity_max_abs_err')
     self._m_quant_rel_err = qs.gauge('parity_max_rel_err')
+    # Watched across reload polls: the predictor absorbs a committed-
+    # but-broken export INTERNALLY (keeps last-good, counts here, never
+    # raises) — still an incident worth a bundle.
+    self._m_predictor_fallbacks = metrics_lib.counter(
+        'predictor/load_fallbacks')
 
   # ------------------------------------------------------------- lifecycle
 
@@ -441,12 +488,18 @@ class DynamicBatcher:
   def buckets(self) -> Tuple[int, ...]:
     return self._buckets
 
-  def submit(self, features: Dict[str, np.ndarray]) -> ServingFuture:
+  def submit(self, features: Dict[str, np.ndarray],
+             request_id: Optional[str] = None) -> ServingFuture:
     """Queues one client's examples; returns a future for the batched
     dispatch. ``features`` values carry a leading batch dim and share
     it (a single example may omit it — the predictor's dim-expansion
     contract); a request larger than ``max_batch`` is rejected (split
-    client-side — it could never ride one dispatch)."""
+    client-side — it could never ride one dispatch).
+
+    ``request_id`` (e.g. an ingress ``X-Request-Id``) labels the request
+    through the latency exemplars, the slow-request log, and — for
+    sampled requests — its flight-ring lifecycle trace; omitted, a
+    process-unique one is generated (``ServingFuture.request_id``)."""
     features = self._validate(features)
     sizes = {np.shape(v)[0] if np.ndim(v) else 1 for v in features.values()}
     if len(sizes) != 1:
@@ -455,7 +508,13 @@ class DynamicBatcher:
     if n < 1 or n > self._max_batch:
       raise RequestError(
           f'request batch {n} outside [1, max_batch={self._max_batch}]')
-    request = _Request(features, int(n), self._clock())
+    seq = next(self._req_seq)
+    rid = request_id if request_id else f'{self._id_prefix}-{seq}'
+    traced = bool(self._trace_every) and seq % self._trace_every == 0
+    request = _Request(features, int(n), self._clock(), request_id=rid,
+                       traced=traced)
+    if traced:
+      request.queued_wall = time.time()
     with self._cond:
       if self._closed:
         raise OverloadedError('serving plane is shut down')
@@ -563,6 +622,8 @@ class DynamicBatcher:
         self._m_swaps.inc()
         self._m_version.set(float(pending.version))
         self._m_param_bytes.set(float(pending.param_bytes))
+        flight.event('swap', 'serving/model_swap',
+                     f'version={pending.version}')
         logging.info('Serving hot-swapped to model version %d',
                      pending.version)
       self._execute(batch)
@@ -571,6 +632,19 @@ class DynamicBatcher:
     total = sum(r.n for r in batch)
     with self._cond:
       model = self._model
+    # Traced subset computed once: the lifecycle phases below batch
+    # their ring writes (flight.events_many — one lock per phase per
+    # dispatch, not per request), keeping full-sample tracing within
+    # the bench-pinned 3% overhead budget.
+    traced = [r for r in batch if r.traced]
+    if traced:
+      assembled = f' batch={len(batch)} total={total}'
+      entries = [('request', 'serving/queued',
+                  f'id={r.request_id} n={r.n}', r.queued_wall)
+                 for r in traced]
+      entries.extend(('request', 'serving/assembled',
+                      'id=' + r.request_id + assembled) for r in traced)
+      flight.events_many(entries)
     t0 = self._clock()
     try:
       if len(batch) == 1:
@@ -587,6 +661,11 @@ class DynamicBatcher:
         self._m_padded.inc(bucket - total)
       else:
         bucket = total
+      if traced:
+        dispatched = f' bucket={bucket}'
+        flight.events_many([
+            ('request', 'serving/dispatched',
+             'id=' + r.request_id + dispatched) for r in traced])
       outputs = model.execute(features, bucket)
       offset = 0
       for request in batch:
@@ -606,9 +685,50 @@ class DynamicBatcher:
       self._m_batch_size.observe(total)
       self._m_actions.inc(total)
       self._note_rate(now, total)
+      returned_events = []
       for request in batch:
-        self._m_latency.observe(1e3 * (now - request.enqueue_time))
+        latency_ms = 1e3 * (now - request.enqueue_time)
+        # The request ID rides the latency histogram as a bucket
+        # exemplar: a p99 outlier bucket names a concrete request whose
+        # flight trace / slow-log entry can be pulled.
+        self._m_latency.observe(latency_ms, exemplar=request.request_id)
+        self._note_slow(request, latency_ms, now)
+        if request.traced:
+          returned_events.append(
+              ('request', 'serving/returned',
+               f'id={request.request_id} latency_ms={latency_ms:.3f} '
+               f'error={int(request.error is not None)}'))
+      flight.events_many(returned_events)
+      for request in batch:
         request.event.set()
+
+  def _note_slow(self, request: _Request, latency_ms: float,
+                 now: float) -> None:
+    """Maintains the bounded top-k-by-latency request log (dispatcher
+    thread writes, ``report()`` readers snapshot under the lock)."""
+    del now
+    if not self._slow_k:
+      return
+    entry = (latency_ms, id(request), {
+        'request_id': request.request_id,
+        'latency_ms': round(latency_ms, 3),
+        'examples': request.n,
+        'model_version': request.model_version,
+        'error': request.error is not None,
+        'time': time.time(),
+    })
+    with self._slow_lock:
+      log = self._slow_log
+      if len(log) < self._slow_k:
+        heapq.heappush(log, entry)
+      elif latency_ms > log[0][0]:
+        heapq.heapreplace(log, entry)
+
+  def slow_requests(self) -> List[Dict[str, Any]]:
+    """Top-k completed requests by latency, slowest first."""
+    with self._slow_lock:
+      entries = [info for _, _, info in self._slow_log]
+    return sorted(entries, key=lambda e: -e['latency_ms'])
 
   def _note_rate(self, now: float, n: int) -> None:
     window = self._rate_window
@@ -696,14 +816,22 @@ class DynamicBatcher:
     warmed) and hand it to the dispatcher for adoption between
     dispatches. Returns True when a swap was staged. Never raises —
     the last-good generation keeps serving (``serving/reload_errors``,
-    mirroring the predictor's own ``predictor/load_fallbacks``)."""
+    mirroring the predictor's own ``predictor/load_fallbacks``).
+
+    Both last-good shapes dump an incident bundle when
+    ``postmortem_dir`` is set: a reload that RAISES here, and a broken
+    committed export the predictor absorbed internally (visible only as
+    a ``predictor/load_fallbacks`` increment across ``restore()``)."""
+    fallbacks0 = self._m_predictor_fallbacks.value
     try:
       if not self._predictor.restore():
+        self._note_predictor_fallback(fallbacks0)
         return False
       with self._cond:
         current = self._pending_model or self._model
       if (int(self._predictor.model_version) == current.version and
           self._same_generation(current)):
+        self._note_predictor_fallback(fallbacks0)
         return False
       new_model = self._build_executor(reuse_from=current)
       new_model.warm()  # compile before adoption: swap cost ~pointer swap
@@ -712,10 +840,32 @@ class DynamicBatcher:
       return True
     except Exception as e:  # pylint: disable=broad-except
       self._m_reload_errors.inc()
+      flight.event('error', 'serving/reload_failed', repr(e))
       logging.warning(
           'Serving reload failed (%r); continuing on model version %d.',
           e, self.model_version)
+      # Last-good fallback is an INCIDENT even though serving survives:
+      # record what the plane was doing around the broken generation.
+      # Rate-limited inside dump() — the poller retrying the same broken
+      # export coalesces to one bundle per interval.
+      from tensor2robot_tpu.observability import postmortem
+
+      postmortem.dump(self._postmortem_dir, 'serving_reload_failure',
+                      error=e,
+                      extra={'model_version': self.model_version})
       return False
+
+  def _note_predictor_fallback(self, fallbacks_before: int) -> None:
+    """Bundles a reload the PREDICTOR degraded to last-good internally."""
+    if self._m_predictor_fallbacks.value <= fallbacks_before:
+      return
+    flight.event('error', 'serving/reload_fallback',
+                 f'predictor kept last-good version={self.model_version}')
+    from tensor2robot_tpu.observability import postmortem
+
+    postmortem.dump(self._postmortem_dir, 'serving_reload_failure',
+                    extra={'model_version': self.model_version,
+                           'predictor_fallback': True})
 
   def _same_generation(self, current) -> bool:
     if not isinstance(current, JitBucketExecutor):
@@ -741,6 +891,9 @@ class DynamicBatcher:
     snap = metrics_lib.snapshot('serving/')
     latency = snap.get('serving/request_latency_ms', {}) or {}
     return {
+        'request_trace_sample': self._trace_sample,
+        'request_latency_exemplars': latency.get('exemplars', {}),
+        'slow_requests': self.slow_requests(),
         'max_batch': self._max_batch,
         'batch_deadline_ms': self._deadline_s * 1e3,
         'buckets': list(self._buckets),

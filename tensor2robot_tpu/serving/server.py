@@ -13,10 +13,15 @@ Endpoints:
   (a bare feature dict is also accepted). Each feature carries a leading
   batch dim shared across features; a single example may omit it (the
   predictor's dim-expansion contract). Reply: ``{"outputs": {...},
-  "model_version": N, "examples": n}``.
+  "model_version": N, "examples": n, "request_id": "..."}``. An
+  ``X-Request-Id`` request header is honored as the request's ID (else
+  one is generated) and echoed back as the same response header on every
+  status — the handle that joins a client log line to the plane's
+  latency exemplars, slow-request log, and flight-ring trace slice.
 * ``GET /healthz`` — liveness + loaded model version.
 * ``GET /statz`` — the batcher's ``serving`` report (same document the
-  registry's ``/metricsz`` embeds via ``register_report_provider``).
+  registry's ``/metricsz`` embeds via ``register_report_provider``),
+  including the bounded slow-request log and latency exemplars.
 
 Status codes: 400 malformed request, 404 unknown path, 503 queue full /
 shutting down (back off and retry), 504 request timed out in the plane,
@@ -48,11 +53,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
   def _batcher(self) -> batching_lib.DynamicBatcher:
     return self.server.batcher  # type: ignore[attr-defined]
 
-  def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+  def _reply(self, code: int, payload: Dict[str, Any],
+             request_id: Optional[str] = None) -> None:
     body = json.dumps(payload).encode()
     self.send_response(code)
     self.send_header('Content-Type', 'application/json')
     self.send_header('Content-Length', str(len(body)))
+    if request_id:
+      self.send_header('X-Request-Id', request_id)
     self.end_headers()
     try:
       self.wfile.write(body)
@@ -72,8 +80,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
   def do_POST(self):  # noqa: N802 - stdlib naming
     path = self.path.split('?', 1)[0].rstrip('/')
+    # Ingress request ID: honor the client's X-Request-Id (distributed-
+    # trace convention) or let the batcher mint one; either way it is
+    # echoed on EVERY reply below so the client can quote it.
+    request_id = (self.headers.get('X-Request-Id') or '').strip() or None
     if path != '/v1/predict':
-      self._reply(404, {'error': f'unknown path {path!r}'})
+      self._reply(404, {'error': f'unknown path {path!r}'},
+                  request_id=request_id)
       return
     try:
       length = int(self.headers.get('Content-Length', 0))
@@ -83,31 +96,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         raise ValueError('body must carry a non-empty feature dict')
       features = {k: np.asarray(v) for k, v in raw.items()}
     except (ValueError, TypeError) as e:
-      self._reply(400, {'error': f'malformed request: {e}'})
+      self._reply(400, {'error': f'malformed request: {e}'},
+                  request_id=request_id)
       return
     try:
-      future = self._batcher.submit(features)
+      future = self._batcher.submit(features, request_id=request_id)
     except batching_lib.OverloadedError as e:
-      self._reply(503, {'error': str(e)})
+      self._reply(503, {'error': str(e)}, request_id=request_id)
       return
     except batching_lib.RequestError as e:
-      self._reply(400, {'error': str(e)})
+      self._reply(400, {'error': str(e)}, request_id=request_id)
       return
+    request_id = future.request_id
     timeout = self.server.request_timeout_secs  # type: ignore[attr-defined]
     try:
       outputs = future.result(timeout=timeout)
     except TimeoutError as e:
-      self._reply(504, {'error': str(e)})
+      self._reply(504, {'error': str(e)}, request_id=request_id)
       return
     except batching_lib.ServingError as e:
-      self._reply(500, {'error': str(e)})
+      self._reply(500, {'error': str(e)}, request_id=request_id)
       return
     examples = next(iter(outputs.values())).shape[0] if outputs else 0
     self._reply(200, {
         'outputs': {k: np.asarray(v).tolist() for k, v in outputs.items()},
         'model_version': future.model_version,
         'examples': int(examples),
-    })
+        'request_id': request_id,
+    }, request_id=request_id)
 
 
 class ServingServer:
@@ -133,6 +149,7 @@ class ServingServer:
                host: str = '127.0.0.1',
                request_timeout_secs: float = 30.0,
                compilation_cache_dir: Optional[str] = None,
+               timeseries_interval_secs: float = 10.0,
                **batcher_kwargs):
     # Persistent compile cache first: bucket warmup is the serving
     # plane's restart cost, and a cache hit turns each bucket compile
@@ -141,6 +158,11 @@ class ServingServer:
         maybe_enable_compilation_cache)
 
     maybe_enable_compilation_cache(compilation_cache_dir)
+    # Metrics history for /metricsz?history=1 and postmortem bundles
+    # (0 disables; idempotent process-global recorder).
+    from tensor2robot_tpu.observability import timeseries
+
+    timeseries.maybe_start(timeseries_interval_secs or None)
     self._batcher = batching_lib.DynamicBatcher(predictor, **batcher_kwargs)
     self._requested = (host, int(port))
     self._request_timeout_secs = request_timeout_secs

@@ -69,6 +69,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -140,6 +141,10 @@ def write_commit_marker(directory: str, step: int,
     f.flush()
     os.fsync(f.fileno())
   os.replace(tmp, path)
+  # The commit point of the whole protocol — the one event a postmortem
+  # must have to say "this step WAS durable before the process died".
+  flight.event('checkpoint', 'checkpoint/commit',
+               f'step={int(step)} hosts={payload["hosts"]}')
   return path
 
 
@@ -165,6 +170,8 @@ def _report_torn(directory: str, step: int, where: str) -> None:
     return
   _REPORTED_TORN.add(key)
   metrics_lib.counter('checkpoint/torn_skipped').inc()
+  flight.event('checkpoint', 'checkpoint/torn_skip',
+               f'step={int(step)} where={where}')
   logging.warning(
       'Checkpoint step %d under %r has no commit marker — a torn '
       'checkpoint (save cut off by preemption or a dead host); skipping '
@@ -639,6 +646,8 @@ class CheckpointManager:
     if saved:
       self._pending_marker = step
       metrics_lib.counter('checkpoint/saves').inc()
+      flight.event('checkpoint', 'checkpoint/save',
+                   f'step={step} force={int(force)}')
     return saved
 
   def _save_distributed(self, step: int, state, force: bool,
@@ -685,6 +694,10 @@ class CheckpointManager:
       hook = _during_save_hook
       if hook is not None:
         hook(step)
+      flight.event(
+          'checkpoint', 'checkpoint/save',
+          f'step={step} force={int(force)} sync={int(sync)} '
+          f'sharded={int(self._sharded)}')
       if not sync and self._async_commit:
         self._begin_async_commit(step, seq, participants)
         metrics_lib.counter('checkpoint/saves').inc()

@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.train import resilience
 
@@ -294,6 +295,8 @@ class CoordinatedShutdown:
       # lists keys UNDER a prefix, so the poll below can see it.
       self._ctx.put(f'shutdown/proposal/{self._ctx.process_index}',
                     str(int(step)))
+      flight.event('shutdown', 'distributed/stop_proposed',
+                   f'host={self._ctx.process_index} step={step}')
       logging.warning(
           'Process %d observed a local shutdown signal at step %d; '
           'proposing a coordinated stop to all %d processes.',
@@ -350,6 +353,8 @@ class CoordinatedShutdown:
     self.participants = sorted(expected)
     self._m_stops.inc()
     self._m_target.set(self._target)
+    flight.event('shutdown', 'distributed/stop_agreed',
+                 f'target={self._target} participants={self.participants}')
     logging.warning(
         'Coordinated stop agreed: %d process(es) %s checkpoint at step '
         '%d (published boundaries: %s).', len(expected),
@@ -514,6 +519,8 @@ class HeartbeatService:
         if host not in self._flagged_stragglers:
           self._flagged_stragglers.add(host)
           self._m_stragglers.inc()
+          flight.event('liveness', 'distributed/straggler',
+                       f'host={host} age_sec={age:.1f}')
           logging.warning(
               'Host %d is straggling: last heartbeat %.1fs ago (straggler '
               'threshold %.1fs, declared dead at %.1fs).', host, age,
@@ -536,10 +543,25 @@ class HeartbeatService:
         f'{LIVENESS_EXIT_CODE} so the scheduler restarts the job from the '
         f'last committed checkpoint.')
     logging.critical(message)
+    flight.event('error', 'distributed/dead_host',
+                 f'dead={sorted(newly_dead)} '
+                 f'ages={[round(ages[h], 1) for h in sorted(newly_dead)]}')
     if self._on_dead is not None:
       self._on_dead(set(newly_dead))
     if self._action == 'exit':
       print(message, file=sys.stderr, flush=True)
+      # Forensics before the hard exit: the bundle is a bounded atomic
+      # write (postmortem.dump never raises), and this monitor thread is
+      # alive precisely because the main thread may be wedged — this is
+      # the only chance to record what led up to the death.
+      from tensor2robot_tpu.observability import postmortem
+
+      postmortem.dump(
+          os.path.dirname(os.path.abspath(self._dir)) or None,
+          'dead_host', exit_code=LIVENESS_EXIT_CODE,
+          extra={'dead_hosts': sorted(newly_dead),
+                 'monitor_host': self.process_index,
+                 'last_step': self._step})
       # os._exit, not sys.exit: the main thread may be wedged inside a
       # collective/barrier and would never process a normal exception.
       os._exit(LIVENESS_EXIT_CODE)

@@ -40,6 +40,7 @@ import signal
 import threading
 from typing import Optional, Tuple
 
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
 
 # The distinct, resumable exit status the trainer binary uses for
@@ -83,6 +84,9 @@ class GracefulShutdown:
     self._event = threading.Event()
     self._prev = {}
     self._installed = False
+    # Which signal tripped the flag (None for programmatic requests);
+    # read by the trainer's boundary poll for the flight-ring record.
+    self._signal_observed: Optional[int] = None
 
   @property
   def requested(self) -> bool:
@@ -90,6 +94,9 @@ class GracefulShutdown:
 
   def request(self) -> None:
     """Programmatic preemption (tests, cluster agents without signals)."""
+    if not self._event.is_set():
+      flight.event('shutdown', 'resilience/shutdown_requested',
+                   'source=programmatic')
     self._event.set()
 
   def _handler(self, signum, frame) -> None:
@@ -97,6 +104,10 @@ class GracefulShutdown:
     logging.warning(
         'Received signal %d: finishing the in-flight dispatch, then '
         'checkpointing and exiting resumable (next signal kills).', signum)
+    # No flight.event here: a signal handler must not take the ring lock
+    # (the interrupted main thread may hold it). The signal is recorded
+    # when the trainer OBSERVES the flag at the next dispatch boundary.
+    self._signal_observed = signum
     self._event.set()
     self.uninstall()
 
@@ -196,6 +207,10 @@ class NonFinitePolicy:
     # final scalars/report must carry the full skip accounting.
     self._m_bad_steps.inc(count)
     self._m_consecutive.set(self.consecutive_bad)
+    flight.event(
+        'nonfinite', 'resilience/nonfinite_skip',
+        f'count={count} step={step} consecutive={self.consecutive_bad} '
+        f'mode={self.mode}')
     if self.mode == 'raise':
       raise NonFiniteError(
           f'non-finite loss/grads at dispatch ending step {step} '

@@ -34,8 +34,10 @@ import numpy as np
 import optax
 
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import memory as memory_lib
 from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import postmortem as postmortem_lib
 from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct
@@ -258,6 +260,13 @@ class TrainerConfig:
   # env var also opts in); 0 = an ephemeral port (logged, and readable
   # from ``observability.metricsz.global_server().port``).
   metricsz_port: Optional[int] = None
+  # Metrics time-series history (observability/timeseries.py): snapshot
+  # the whole registry every this-many seconds into a bounded ring,
+  # served at /metricsz?history=1 and embedded in postmortem bundles so
+  # an incident shows how every series MOVED over the final minutes,
+  # not just where it ended. 0 disables; the process-global recorder is
+  # started once (first cadence wins).
+  timeseries_interval_secs: float = 10.0
   # Persistent XLA compilation cache (utils/compilation_cache.py): a
   # restarted process deserializes prior executables instead of
   # re-lowering the K×M train program, so restart-to-first-step time
@@ -747,6 +756,9 @@ class _DispatchBreakdown:
     for key, value in out.items():
       metrics_lib.gauge(f'trainer/{key}').set(value)
     self._windows.inc()
+    # Postmortem retention: the last K closed windows ride every
+    # incident bundle (bounded ring in observability/postmortem.py).
+    postmortem_lib.note_breakdown_window(out)
     self._reset_window()
     return out
 
@@ -849,9 +861,12 @@ class Trainer:
     # Opt-in live metrics endpoint (config port or T2R_METRICSZ_PORT
     # env); process-global and idempotent, so a second Trainer in the
     # same process reuses the running server.
-    from tensor2robot_tpu.observability import metricsz
+    from tensor2robot_tpu.observability import metricsz, timeseries
 
     metricsz.maybe_start(config.metricsz_port)
+    # Metrics history ring: feeds /metricsz?history=1 and the postmortem
+    # bundle's time-series window (idempotent, first cadence wins).
+    timeseries.maybe_start(config.timeseries_interval_secs or None)
     # Before the first lowering: the restart-goodput slice — executables
     # compiled by a previous incarnation load from disk instead of
     # recompiling (measured by restart_to_first_step_seconds below).
@@ -1202,7 +1217,59 @@ class Trainer:
             train_iter: Iterator[Batch],
             eval_iter_fn: Optional[Callable[[], Iterator[Batch]]] = None
             ) -> MetricDict:
-    """Interleaved train/eval loop (train_and_evaluate semantics)."""
+    """Interleaved train/eval loop (train_and_evaluate semantics).
+
+    Every abnormal exit — preemption (:class:`~tensor2robot_tpu.train.
+    resilience.PreemptedError`, 42), a liveness/barrier failure
+    (``DeadHostError``, 43), a non-finite raise, or any uncaught
+    exception — writes a postmortem bundle into
+    ``<model_dir>/postmortem/`` (flight-ring events, metrics report,
+    time-series window, breakdown windows, topology) before the error
+    propagates; render it with ``tools/postmortem.py``.
+    """
+    try:
+      return self._train_loop(train_iter, eval_iter_fn)
+    except BaseException as e:
+      self._note_abnormal_exit(e)
+      raise
+
+  def _note_abnormal_exit(self, error: BaseException) -> None:
+    """Classifies a terminal error and dumps the postmortem bundle.
+
+    Bounded and non-raising (postmortem.dump's contract): runs between
+    the terminal error and its propagation to the exit path.
+    """
+    if isinstance(error, (GeneratorExit, StopIteration)):
+      return
+    if isinstance(error, resilience.PreemptedError):
+      reason = 'preempted'
+    elif isinstance(error, resilience.NonFiniteError):
+      reason = 'nonfinite'
+    elif isinstance(error, dist_lib.DeadHostError):
+      reason = 'dead_host'
+    elif isinstance(error, KeyboardInterrupt):
+      reason = 'keyboard_interrupt'
+    else:
+      reason = 'trainer_exception'
+    flight.event('error', f'trainer/{reason}',
+                 f'{type(error).__name__}: {str(error)[:180]}')
+    exit_code = getattr(error, 'exit_code', None)
+    try:
+      topology = mesh_lib.describe_topology(
+          self._mesh,
+          grad_accum_microbatches=self._accum_m,
+          steps_per_dispatch=self._loop_k)
+    except Exception:  # pylint: disable=broad-except
+      topology = None
+    postmortem_lib.dump(self._config.model_dir, reason,
+                        exit_code=exit_code, error=error,
+                        topology=topology,
+                        extra={'step': self.step})
+
+  def _train_loop(self,
+                  train_iter: Iterator[Batch],
+                  eval_iter_fn: Optional[Callable[[], Iterator[Batch]]] = None
+                  ) -> MetricDict:
     config = self._config
     # Ring-buffer lease hook (data/engine.py reuse_buffers): present on
     # engine-backed iterators; None otherwise. Called once per consumed
@@ -1352,6 +1419,13 @@ class Trainer:
               self._manager.set_participants(coordinated.participants)
           elif shutdown is not None and shutdown.requested:
             stop_step = step
+            # First boundary that OBSERVES the flag: safe (non-signal)
+            # context for the flight record the handler could not take.
+            signum = getattr(shutdown, '_signal_observed', None)
+            flight.event(
+                'shutdown', 'resilience/shutdown_observed',
+                f'step={step} ' + (f'signum={signum}' if signum is not None
+                                   else 'source=programmatic'))
         if stop_step is not None and step >= stop_step:
           # Preemption: the in-flight dispatch finished (we are at a
           # boundary); force a checkpoint + input-state save and exit
@@ -1407,6 +1481,13 @@ class Trainer:
             examples=int(np.prod(batch_leaves[0].shape[:2]))
             if self._loop_k > 1 and batch_leaves
             else (batch_leaves[0].shape[0] if batch_leaves else 0))
+        if flight.enabled():
+          # One flight event per dispatch boundary: the incident ring's
+          # backbone timeline (~1 µs; the ring is bounded, so even
+          # sub-ms steps only shorten the window it covers).
+          flight.event(
+              'dispatch', 'trainer/boundary',
+              f'step={step} wall_ms={(t_boundary - t_wait0) * 1e3:.3f}')
         if self._heartbeat is not None:
           # Liveness payload: peers (and post-mortem tooling) see the
           # last COMPLETED dispatch boundary, not a wall-clock guess.

@@ -32,6 +32,7 @@ import re
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Type
 
+from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
 
 # Exceptions that mark a *data/IO* problem worth retrying or skipping.
@@ -162,6 +163,10 @@ class ErrorBudget:
                len(self.by_source) <= _MAX_SOURCES else _OVERFLOW_SOURCE)
     metrics_lib.counter(
         f'resilience/data_errors/{self.name}/{reg_src}').inc()
+    flight.event(
+        'budget', 'resilience/budget_charge',
+        f'name={self.name} source={src} errors={self.errors}/'
+        f'{self.max_errors} error={type(exc).__name__}')
     if self.errors > self.max_errors:
       per_source = ', '.join(
           f'{s}: {n}' for s, n in sorted(

@@ -1,0 +1,597 @@
+"""Incident-observability drills: flight recorder, time-series history,
+postmortem bundles on every abnormal-exit path, and per-request tracing.
+
+Every abnormal exit the framework distinguishes is drilled end to end —
+real SIGTERM → exit-42 preemption, a fake-fabric liveness kill (a real
+subprocess exiting 43), ``nonfinite_mode='raise'``, and a serving reload
+falling back to last-good — and each must leave one parseable bundle
+whose flight ring carries events from at least two subsystems. Plus: the
+bounded-memory soak on the rings, the ``/metricsz`` history + Prometheus
+endpoints under a concurrent-scrape hammer, and X-Request-Id propagation
+over HTTP including a batched multi-client interleave.
+
+Marker: ``obs`` (tier-1; ``tools/run_tier1.sh -m obs`` selects).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import metricsz
+from tensor2robot_tpu.observability import postmortem as postmortem_lib
+from tensor2robot_tpu.observability import timeseries
+from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.serving import batching as batching_lib
+from tensor2robot_tpu.serving import server as server_lib
+from tensor2robot_tpu.train import (GracefulShutdown, NonFiniteError,
+                                    PreemptedError, Trainer, TrainerConfig,
+                                    resilience)
+from tensor2robot_tpu.utils import faults
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_incident_state():
+  """Each drill gets a clean flight ring + postmortem rate-limit slate
+  (both are process-global by design)."""
+  flight.recorder().clear()
+  flight.set_enabled(True)
+  flight.set_span_feed_min_ms(flight.DEFAULT_SPAN_FEED_MIN_MS)
+  postmortem_lib._reset_rate_limit_for_tests()
+  yield
+  flight.set_enabled(True)
+  flight.set_span_feed_min_ms(flight.DEFAULT_SPAN_FEED_MIN_MS)
+
+
+def _bundles(model_dir):
+  directory = os.path.join(model_dir, postmortem_lib.POSTMORTEM_DIRNAME)
+  if not os.path.isdir(directory):
+    return []
+  return sorted(os.path.join(directory, name)
+                for name in os.listdir(directory)
+                if name.endswith('.json'))
+
+
+def _load_bundle(path):
+  with open(path) as f:
+    bundle = json.load(f)
+  assert bundle['kind'] == 'postmortem'
+  assert bundle['version'] == 1
+  return bundle
+
+
+def _event_kinds(bundle):
+  return {e['kind'] for e in bundle['events']}
+
+
+def make_trainer(model_dir='', callbacks=(), shutdown=None, **cfg):
+  model = MockT2RModel(device_type='tpu')
+  cfg.setdefault('prefetch_batches', 0)
+  cfg.setdefault('eval_interval_steps', 0)
+  cfg.setdefault('log_interval_steps', 0)
+  config = TrainerConfig(model_dir=model_dir, **cfg)
+  trainer = Trainer(model, config, callbacks=list(callbacks),
+                    shutdown=shutdown)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  return trainer, gen
+
+
+def _loaded_predictor():
+  predictor = CheckpointPredictor(
+      MockT2RModel(device_type='tpu'), model_dir='/nonexistent')
+  predictor.init_randomly()
+  return predictor
+
+
+def _features(value, n=1):
+  return {'measured_position': np.full((n, 2), value, np.float32)}
+
+
+# ------------------------------------------------------- bounded-memory rings
+
+
+def test_flight_ring_byte_size_stable_under_100k_events():
+  """The acceptance soak: the ring's byte footprint may not grow with
+  event volume (fixed slots, truncated details)."""
+  rec = flight.FlightRecorder(capacity=512)
+  for i in range(50_000):
+    rec.record('span', 'soak/span', f'i={i} dur_ms={i % 97}.123')
+  mid = rec.ring_bytes()
+  for i in range(50_000, 100_000):
+    rec.record('span', 'soak/span', f'i={i} dur_ms={i % 97}.123')
+  end = rec.ring_bytes()
+  assert rec.recorded == 100_000
+  assert len(rec.events()) == 512
+  # Same-shaped events: the footprint is stable to within the jitter of
+  # individual string sizes (a few % of a ~50 KB ring), never cumulative.
+  assert abs(end - mid) < 0.05 * mid
+  # Oldest-overwrite semantics: the ring holds the LAST 512.
+  events = rec.events()
+  assert events[-1]['detail'].startswith('i=99999')
+  assert events[0]['detail'].startswith(f'i={100_000 - 512}')
+
+
+def test_flight_detail_truncated_at_bound():
+  rec = flight.FlightRecorder(capacity=4)
+  rec.record('error', 'x', 'y' * 10_000)
+  (event,) = rec.events()
+  assert len(event['detail']) == flight.MAX_DETAIL_CHARS
+
+
+def test_flight_disabled_records_nothing():
+  flight.set_enabled(False)
+  flight.event('span', 'off/event')
+  with tracing.span('off/span'):
+    time.sleep(0.01)
+  assert flight.events() == []
+
+
+def test_span_feed_duration_filter():
+  flight.set_span_feed_min_ms(5.0)
+  with tracing.span('fast/span'):
+    pass  # well under 5 ms: filtered before any lock
+  with tracing.span('slow/span'):
+    time.sleep(0.02)
+  names = [e['name'] for e in flight.events(kinds=('span',))]
+  assert 'slow/span' in names
+  assert 'fast/span' not in names
+  detail = [e for e in flight.events(kinds=('span',))
+            if e['name'] == 'slow/span'][0]['detail']
+  assert float(detail.split('dur_ms=')[1]) >= 5.0
+
+
+def test_timeseries_ring_bounded_and_windowed():
+  rec = timeseries.TimeSeriesRecorder(interval_secs=10.0, capacity=5)
+  gauge = metrics_lib.gauge('obs_test/ts_gauge')
+  for i in range(12):
+    gauge.set(i)
+    rec.sample()
+  history = rec.history()
+  assert history['kind'] == 'metrics_timeseries'
+  samples = history['samples']
+  assert len(samples) == 5  # bounded
+  # Newest-last, and the ring kept the LAST five samples (gauges 7..11).
+  values = [s['metrics']['obs_test/ts_gauge'] for s in samples]
+  assert values == [7.0, 8.0, 9.0, 10.0, 11.0]
+  assert rec.history(last_secs=0.0)['samples'] == []
+
+
+def test_tracing_dropped_events_counter_detects_truncation():
+  before = metrics_lib.counter('tracing/dropped_events').value
+  tracing.start_capture(max_events=2)
+  for _ in range(5):
+    with tracing.span('trunc/span'):
+      pass
+  trace = tracing.chrome_trace()
+  tracing.stop_capture()
+  dropped = metrics_lib.counter('tracing/dropped_events').value - before
+  assert dropped == 3
+  assert trace['metadata']['dropped_events'] == 3
+  # The registry counter makes truncation visible in report()/metricsz.
+  assert metrics_lib.report()['metrics']['tracing/dropped_events'] >= 3
+
+
+# ------------------------------------------------------------ postmortem unit
+
+
+def test_postmortem_dump_content_and_rate_limit(tmp_path):
+  model_dir = str(tmp_path)
+  flight.event('checkpoint', 'checkpoint/save', 'step=7')
+  postmortem_lib.note_breakdown_window({'breakdown/wall_ms': 12.5})
+  path = postmortem_lib.dump(model_dir, 'unit_drill', exit_code=42,
+                             error=RuntimeError('boom'),
+                             topology={'process_count': 1},
+                             extra={'step': 7})
+  assert path is not None
+  bundle = _load_bundle(path)
+  assert bundle['reason'] == 'unit_drill'
+  assert bundle['exit_code'] == 42
+  assert bundle['error'] == {'type': 'RuntimeError', 'message': 'boom'}
+  assert bundle['topology'] == {'process_count': 1}
+  assert bundle['extra']['step'] == 7
+  assert any(e['name'] == 'checkpoint/save' for e in bundle['events'])
+  assert bundle['breakdown_windows'][-1]['breakdown/wall_ms'] == 12.5
+  assert bundle['metrics_report']['kind'] == 'metrics_report'
+  # <= 1 bundle per exit: an immediate second dump for the same
+  # (dir, reason) is swallowed by the rate limit.
+  assert postmortem_lib.dump(model_dir, 'unit_drill') is None
+  assert len(_bundles(model_dir)) == 1
+  # A different reason (a genuinely different exit path) still dumps.
+  assert postmortem_lib.dump(model_dir, 'other_drill') is not None
+
+
+def test_postmortem_dump_without_model_dir_is_noop():
+  assert postmortem_lib.dump('', 'x') is None
+  assert postmortem_lib.dump(None, 'x') is None
+
+
+# ------------------------------------------------------ abnormal-exit drills
+
+
+def test_postmortem_on_real_sigterm_preemption(tmp_path):
+  """Drill 1: a real OS SIGTERM → forced checkpoint → exit-42 path
+  leaves a bundle whose ring shows the shutdown, the checkpoint commit,
+  and the dispatch timeline."""
+  model_dir = str(tmp_path / 'm')
+  prev = signal.getsignal(signal.SIGTERM)
+  shutdown = GracefulShutdown(signals=(signal.SIGTERM,)).install()
+  try:
+    cb = faults.PreemptionCallback(at_step=3, signum=signal.SIGTERM)
+    trainer, gen = make_trainer(model_dir=model_dir, callbacks=[cb],
+                                shutdown=shutdown, max_train_steps=10,
+                                save_interval_steps=1000)
+    with pytest.raises(PreemptedError) as excinfo:
+      trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+    assert excinfo.value.exit_code == resilience.PREEMPTED_EXIT_CODE
+  finally:
+    shutdown.uninstall()
+    signal.signal(signal.SIGTERM, prev)
+  (path,) = _bundles(model_dir)
+  bundle = _load_bundle(path)
+  assert bundle['reason'] == 'preempted'
+  assert bundle['exit_code'] == resilience.PREEMPTED_EXIT_CODE
+  assert bundle['topology']['steps_per_dispatch'] == 1
+  kinds = _event_kinds(bundle)
+  assert {'shutdown', 'checkpoint'} <= kinds  # >= 2 subsystems
+  names = [e['name'] for e in bundle['events']]
+  assert 'resilience/shutdown_observed' in names
+  assert 'checkpoint/commit' in names
+  assert 'trainer/boundary' in names
+  observed = [e for e in bundle['events']
+              if e['name'] == 'resilience/shutdown_observed']
+  assert f'signum={int(signal.SIGTERM)}' in observed[0]['detail']
+
+
+def test_postmortem_on_liveness_exit_43(tmp_path):
+  """Drill 2: a real subprocess whose heartbeat monitor declares a fake
+  peer dead exits 43 AND writes the bundle on its way out."""
+  model_dir = str(tmp_path / 'm')
+  os.makedirs(model_dir)
+  script = f'''
+import os, sys, time
+sys.path.insert(0, {REPO!r})
+from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.train.distributed_resilience import HeartbeatService
+
+with tracing.span('drill/warmup'):
+    time.sleep(0.02)  # >= span-feed threshold: a second subsystem's event
+hb = HeartbeatService(os.path.join({model_dir!r}, 'heartbeats'),
+                      process_index=0, process_count=2,
+                      interval_secs=0.05, straggler_after_secs=0.1,
+                      dead_after_secs=0.4, action='exit')
+hb.start()
+time.sleep(30)  # the monitor must kill us long before this
+sys.exit(99)
+'''
+  proc = subprocess.run([sys.executable, '-c', script],
+                        capture_output=True, text=True, timeout=60)
+  assert proc.returncode == 43, proc.stderr
+  assert 'LIVENESS' in proc.stderr
+  (path,) = _bundles(model_dir)
+  bundle = _load_bundle(path)
+  assert bundle['reason'] == 'dead_host'
+  assert bundle['exit_code'] == 43
+  assert bundle['extra']['dead_hosts'] == [1]
+  kinds = _event_kinds(bundle)
+  assert 'error' in kinds and ('liveness' in kinds or 'span' in kinds)
+  names = [e['name'] for e in bundle['events']]
+  assert 'distributed/dead_host' in names
+
+
+def test_postmortem_on_nonfinite_raise(tmp_path):
+  """Drill 3: nonfinite_mode='raise' halts training and the bundle
+  records both the poisoned dispatch and the terminal error."""
+  model_dir = str(tmp_path / 'm')
+  trainer, gen = make_trainer(model_dir=model_dir, max_train_steps=4,
+                              save_interval_steps=1000,
+                              nonfinite_mode='raise')
+  it = gen.create_iterator(ModeKeys.TRAIN)
+  clean = [next(it) for _ in range(4)]
+  poisoned = [clean[0], faults.nanify(clean[1]), clean[2], clean[3]]
+  with pytest.raises(NonFiniteError):
+    trainer.train(iter(poisoned), None)
+  (path,) = _bundles(model_dir)
+  bundle = _load_bundle(path)
+  assert bundle['reason'] == 'nonfinite'
+  assert bundle['error']['type'] == 'NonFiniteError'
+  kinds = _event_kinds(bundle)
+  assert {'nonfinite', 'dispatch'} <= kinds  # >= 2 subsystems
+  skip = [e for e in bundle['events']
+          if e['name'] == 'resilience/nonfinite_skip']
+  assert skip and 'mode=raise' in skip[0]['detail']
+
+
+def test_postmortem_on_serving_broken_reload(tmp_path):
+  """Drill 4: a reload failure falls back to last-good AND dumps one
+  (rate-limited) bundle naming the incident."""
+  predictor = _loaded_predictor()
+  pm_dir = str(tmp_path / 'serving')
+  with batching_lib.DynamicBatcher(
+      predictor, max_batch=4, batch_deadline_ms=1.0,
+      request_trace_sample=1.0, postmortem_dir=pm_dir) as batcher:
+    batcher.submit(_features(0.1)).result(timeout=30.0)
+
+    def broken_restore():
+      raise RuntimeError('export root unreadable')
+
+    predictor.restore = broken_restore
+    assert not batcher.maybe_reload()
+    version = batcher.model_version
+    # Last-good keeps serving after the failed reload.
+    batcher.submit(_features(0.2)).result(timeout=30.0)
+    assert batcher.model_version == version
+    # The poller retrying the same broken export coalesces to ONE bundle.
+    assert not batcher.maybe_reload()
+  (path,) = _bundles(pm_dir)
+  bundle = _load_bundle(path)
+  assert bundle['reason'] == 'serving_reload_failure'
+  assert bundle['error']['type'] == 'RuntimeError'
+  kinds = _event_kinds(bundle)
+  assert {'error', 'request'} <= kinds  # >= 2 subsystems
+  assert metrics_lib.counter('serving/reload_errors').value >= 2
+
+
+# ----------------------------------------------------------- tools/postmortem
+
+
+def test_postmortem_tool_renders_and_json_round_trips(tmp_path, capsys):
+  from tools import postmortem as tool
+
+  model_dir = str(tmp_path)
+  flight.event('checkpoint', 'checkpoint/commit', 'step=11 hosts=[0]')
+  with tracing.span('tool/slow_span'):
+    time.sleep(0.02)
+  timeseries.stop_global()
+  rec = timeseries.TimeSeriesRecorder(interval_secs=10.0, capacity=4)
+  counter = metrics_lib.counter('obs_test/tool_counter')
+  rec.sample()
+  counter.inc(5)
+  rec.sample()
+  # Hand-assemble the history into the bundle via the global recorder.
+  with timeseries._GLOBAL_LOCK:
+    timeseries._GLOBAL = rec
+  try:
+    postmortem_lib.note_breakdown_window(
+        {'breakdown/wall_ms': 20.0, 'breakdown/host_wait_ms': 5.0})
+    path = postmortem_lib.dump(model_dir, 'tool_drill', exit_code=42,
+                               error=RuntimeError('tool boom'),
+                               topology={'process_count': 1})
+  finally:
+    timeseries.stop_global()
+  assert path is not None
+
+  # Directory resolution: model dir -> newest bundle in postmortem/.
+  assert tool.find_bundle(model_dir) == path
+  assert tool.main([model_dir]) == 0
+  text = capsys.readouterr().out
+  assert 'tool_drill' in text and 'exit 42' in text
+  assert 'checkpoint/commit' in text
+  assert 'tool/slow_span' in text
+  assert 'obs_test/tool_counter' in text  # metric delta section
+
+  assert tool.main([path, '--json']) == 0
+  summary = json.loads(capsys.readouterr().out)  # --json round-trips
+  assert summary['kind'] == 'postmortem_summary'
+  assert summary['reason'] == 'tool_drill'
+  assert summary['exit_code'] == 42
+  assert any(s['name'] == 'tool/slow_span'
+             for s in summary['slowest_spans'])
+  assert any(d['metric'] == 'obs_test/tool_counter' and d['delta'] == 5
+             for d in summary['metric_deltas'])
+  assert summary['breakdown_windows'][-1]['breakdown/wall_ms'] == 20.0
+
+
+# ------------------------------------------------- /metricsz history + prom
+
+
+def test_prom_exposition_maps_all_metric_kinds():
+  metrics_lib.counter('obs_test/prom_counter').inc(3)
+  metrics_lib.gauge('obs_test/prom_gauge').set(2.5)
+  hist = metrics_lib.histogram('obs_test/prom_hist')
+  hist.observe(1.0)
+  hist.observe(3.0)
+  text = metricsz.prom_exposition()
+  assert '# TYPE obs_test_prom_counter_total counter' in text
+  assert 'obs_test_prom_counter_total 3' in text
+  assert '# TYPE obs_test_prom_gauge gauge' in text
+  assert 'obs_test_prom_gauge 2.5' in text
+  assert '# TYPE obs_test_prom_hist histogram' in text
+  # Power-of-two buckets, CUMULATIVE counts: frexp puts 1.0 under the
+  # le=2.0 edge and 3.0 under le=4.0.
+  assert 'obs_test_prom_hist_bucket{le="2.0"} 1' in text
+  assert 'obs_test_prom_hist_bucket{le="4.0"} 2' in text
+  assert 'obs_test_prom_hist_bucket{le="+Inf"} 2' in text
+  assert 'obs_test_prom_hist_sum 4.0' in text
+  assert 'obs_test_prom_hist_count 2' in text
+
+
+def test_metricsz_history_and_prom_under_concurrent_scrape_hammer():
+  timeseries.stop_global()
+  timeseries.maybe_start(0.02)
+  server = metricsz.MetricsServer(port=0).start()
+  stop = threading.Event()
+  errors = []
+
+  def writer():
+    gauge = metrics_lib.gauge('obs_test/hammer_gauge')
+    hist = metrics_lib.histogram('obs_test/hammer_hist')
+    i = 0
+    while not stop.is_set():
+      gauge.set(i)
+      hist.observe(i % 17, exemplar=f'req-{i}')
+      i += 1
+      time.sleep(0.0005)
+
+  def scraper(suffix, check):
+    try:
+      for _ in range(25):
+        with urllib.request.urlopen(
+            f'http://127.0.0.1:{server.port}/metricsz{suffix}',
+            timeout=10) as response:
+          assert response.status == 200
+          check(response.read())
+    except Exception as e:  # pylint: disable=broad-except
+      errors.append(e)
+
+  def check_json(body):
+    assert json.loads(body)['kind'] == 'metrics_report'
+
+  def check_history(body):
+    assert json.loads(body)['kind'] == 'metrics_timeseries'
+
+  def check_prom(body):
+    text = body.decode()
+    assert '# TYPE obs_test_hammer_gauge gauge' in text
+
+  threads = [threading.Thread(target=writer, daemon=True)]
+  for suffix, check in (('', check_json), ('?history=1', check_history),
+                        ('?format=prom', check_prom)) * 2:
+    threads.append(threading.Thread(target=scraper, args=(suffix, check),
+                                    daemon=True))
+  samples_after = 0
+  try:
+    for t in threads:
+      t.start()
+    for t in threads[1:]:
+      t.join(timeout=60)
+    samples_after = len(timeseries.history()['samples'])
+  finally:
+    stop.set()
+    threads[0].join(timeout=5)
+    server.close()
+    timeseries.stop_global()
+  assert not errors, errors
+  # The history ring actually accumulated samples while hammered, and
+  # stop_global cleared the process-global recorder for later tests.
+  assert samples_after >= 1
+  assert timeseries.history()['samples'] == []
+
+
+# ----------------------------------------------- request IDs + exemplars e2e
+
+
+def test_request_ids_exemplars_and_slow_log_inproc():
+  predictor = _loaded_predictor()
+  with batching_lib.DynamicBatcher(
+      predictor, max_batch=8, batch_deadline_ms=0.5,
+      request_trace_sample=1.0, slow_request_log_size=3) as batcher:
+    futures = [batcher.submit(_features(0.01 * (i + 1)), request_id=f'me-{i}')
+               for i in range(6)]
+    for f in futures:
+      f.result(timeout=30.0)
+    assert [f.request_id for f in futures] == [f'me-{i}' for i in range(6)]
+    # Generated IDs: unique, process-tagged.
+    gen_a = batcher.submit(_features(0.5))
+    gen_b = batcher.submit(_features(0.6))
+    gen_a.result(timeout=30.0), gen_b.result(timeout=30.0)
+    assert gen_a.request_id != gen_b.request_id
+    assert gen_a.request_id.startswith(f'r{os.getpid():x}-')
+
+    report = batcher.report()
+    # Slow log: bounded at k, sorted slowest-first, carries IDs.
+    slow = report['slow_requests']
+    assert 0 < len(slow) <= 3
+    assert slow == sorted(slow, key=lambda e: -e['latency_ms'])
+    assert all('request_id' in entry for entry in slow)
+    # Exemplars ride the latency histogram buckets. The histogram is
+    # process-global (earlier tests' exemplars may linger in buckets we
+    # did not touch), but the buckets THIS run hit carry our IDs.
+    exemplars = report['request_latency_exemplars']
+    assert exemplars
+    all_ids = {f'me-{i}' for i in range(6)} | {gen_a.request_id,
+                                               gen_b.request_id}
+    assert set(exemplars.values()) & all_ids
+    # Full lifecycle for traced requests: all four phases in the ring.
+    names = {e['name'] for e in flight.events(kinds=('request',))}
+    assert names == {'serving/queued', 'serving/assembled',
+                     'serving/dispatched', 'serving/returned'}
+
+
+def test_request_id_propagation_http_e2e_with_interleave():
+  """X-Request-Id honored + echoed on every reply; a batched multi-client
+  interleave returns each client ITS OWN result, joined by its ID."""
+  predictor = _loaded_predictor()
+  with server_lib.ServingServer(
+      predictor, max_batch=8, batch_deadline_ms=2.0,
+      request_trace_sample=1.0, timeseries_interval_secs=0.0) as server:
+    url = f'http://127.0.0.1:{server.port}/v1/predict'
+
+    def post(features, request_id=None):
+      body = json.dumps(
+          {'features': {k: np.asarray(v).tolist()
+                        for k, v in features.items()}}).encode()
+      request = urllib.request.Request(
+          url, data=body, headers={'Content-Type': 'application/json'})
+      if request_id:
+        request.add_header('X-Request-Id', request_id)
+      with urllib.request.urlopen(request, timeout=30) as response:
+        return (response.headers.get('X-Request-Id'),
+                json.loads(response.read()))
+
+    # Explicit ID: echoed in header AND body.
+    header_id, payload = post(_features(0.25), request_id='client-abc')
+    assert header_id == 'client-abc'
+    assert payload['request_id'] == 'client-abc'
+    # Generated ID: present and unique.
+    gen1, _ = post(_features(0.25))
+    gen2, _ = post(_features(0.25))
+    assert gen1 and gen2 and gen1 != gen2
+
+    # Batched interleave: 6 client threads x 4 requests, distinct ids
+    # and payloads; every reply must match ITS request.
+    expected = {}
+    for i in range(6):
+      value = 0.05 * (i + 1)
+      expected[i] = predictor.predict(_features(value))
+    results = {}
+    failures = []
+
+    def client(i):
+      try:
+        value = 0.05 * (i + 1)
+        for j in range(4):
+          rid = f'c{i}-{j}'
+          header_id, payload = post(_features(value), request_id=rid)
+          assert header_id == rid and payload['request_id'] == rid
+          results[(i, j)] = payload['outputs']
+      except Exception as e:  # pylint: disable=broad-except
+        failures.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=60)
+    assert not failures, failures
+    assert len(results) == 24
+    for (i, _), outputs in results.items():
+      for key, want in expected[i].items():
+        np.testing.assert_allclose(
+            np.asarray(outputs[key]), np.asarray(want), rtol=1e-5,
+            err_msg=f'client {i} got another request\'s outputs')
+
+    # /statz carries the slow-request log + exemplars over HTTP too.
+    with urllib.request.urlopen(
+        f'http://127.0.0.1:{server.port}/statz', timeout=10) as response:
+      statz = json.loads(response.read())
+    assert statz['request_trace_sample'] == 1.0
+    assert statz['slow_requests']
+    assert any(entry['request_id'].startswith(('c', 'client-', 'r'))
+               for entry in statz['slow_requests'])

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Render a postmortem bundle (observability/postmortem.py) for humans.
+
+    python tools/postmortem.py <bundle.json | model_dir | postmortem_dir>
+    python tools/postmortem.py <path> --json        # machine-readable
+    python tools/postmortem.py <path> --events 40 --top 15
+
+Given a directory, the newest ``*.json`` under it (or under its
+``postmortem/`` subdirectory) is rendered. Sections:
+
+* header — reason, exit code, wall time, pid, terminal error, topology;
+* timeline — the flight ring's events, timestamped relative to the
+  moment of death (the last seconds of the process's life);
+* slowest spans — ``kind=span`` events ranked by their ``dur_ms=``;
+* top metric deltas — how counters/histogram counts moved across the
+  bundle's time-series window (first sample → last), largest first;
+* breakdown windows — the last K dispatch wall-time decompositions.
+
+Pure stdlib; works on any host (the bundle is plain JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+POSTMORTEM_DIRNAME = 'postmortem'
+
+
+def find_bundle(path: str) -> str:
+  """Resolves a file, model dir, or postmortem dir to one bundle path."""
+  if os.path.isfile(path):
+    return path
+  if not os.path.isdir(path):
+    raise FileNotFoundError(f'no bundle at {path!r}')
+  sub = os.path.join(path, POSTMORTEM_DIRNAME)
+  directory = sub if os.path.isdir(sub) else path
+  candidates = sorted(glob.glob(os.path.join(directory, '*.json')),
+                      key=os.path.getmtime)
+  if not candidates:
+    raise FileNotFoundError(f'no *.json bundles under {directory!r}')
+  return candidates[-1]
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+  with open(path) as f:
+    bundle = json.load(f)
+  if bundle.get('kind') != 'postmortem':
+    raise ValueError(f'{path!r} is not a postmortem bundle '
+                     f'(kind={bundle.get("kind")!r})')
+  return bundle
+
+
+def _parse_detail(detail: str) -> Dict[str, str]:
+  out = {}
+  for token in (detail or '').split():
+    if '=' in token:
+      key, _, value = token.partition('=')
+      out[key] = value
+  return out
+
+
+def timeline(bundle: Dict[str, Any],
+             max_events: Optional[int] = None) -> List[Dict[str, Any]]:
+  """Events with an ``offset_sec`` relative to the moment of death."""
+  t_death = float(bundle.get('time', 0.0))
+  events = bundle.get('events', [])
+  if max_events is not None and len(events) > max_events:
+    events = events[-max_events:]
+  return [{
+      'offset_sec': round(float(e['time']) - t_death, 3),
+      'kind': e['kind'],
+      'name': e['name'],
+      'detail': e.get('detail', ''),
+  } for e in events]
+
+
+def slowest_spans(bundle: Dict[str, Any], top: int = 10
+                  ) -> List[Dict[str, Any]]:
+  spans = []
+  for e in bundle.get('events', []):
+    if e.get('kind') != 'span':
+      continue
+    dur = _parse_detail(e.get('detail', '')).get('dur_ms')
+    if dur is None:
+      continue
+    try:
+      spans.append({'name': e['name'], 'dur_ms': float(dur),
+                    'time': e['time']})
+    except ValueError:
+      continue
+  spans.sort(key=lambda s: -s['dur_ms'])
+  return spans[:top]
+
+
+def metric_deltas(bundle: Dict[str, Any], top: int = 15
+                  ) -> List[Dict[str, Any]]:
+  """Counter / histogram-count movement over the time-series window."""
+  samples = (bundle.get('timeseries') or {}).get('samples') or []
+  if len(samples) < 2:
+    return []
+  first, last = samples[0]['metrics'], samples[-1]['metrics']
+  window = samples[-1]['time'] - samples[0]['time']
+  deltas = []
+  for name, end in last.items():
+    start = first.get(name)
+    if isinstance(end, bool):
+      continue
+    if isinstance(end, int):
+      delta = end - (start if isinstance(start, int) else 0)
+      kind = 'counter'
+    elif isinstance(end, dict):
+      delta = end.get('count', 0) - (start.get('count', 0)
+                                     if isinstance(start, dict) else 0)
+      kind = 'histogram'
+    else:
+      continue  # gauges have no meaningful delta ranking
+    if delta:
+      deltas.append({'metric': name, 'kind': kind, 'delta': delta,
+                     'window_sec': round(window, 3)})
+  deltas.sort(key=lambda d: -abs(d['delta']))
+  return deltas[:top]
+
+
+def summarize(bundle: Dict[str, Any], max_events: Optional[int] = None,
+              top: int = 15) -> Dict[str, Any]:
+  """The machine-readable rendering (``--json``); JSON round-trips."""
+  return {
+      'kind': 'postmortem_summary',
+      'reason': bundle.get('reason'),
+      'exit_code': bundle.get('exit_code'),
+      'time': bundle.get('time'),
+      'pid': bundle.get('pid'),
+      'error': bundle.get('error'),
+      'topology': bundle.get('topology'),
+      'event_count': len(bundle.get('events', [])),
+      'timeline': timeline(bundle, max_events=max_events),
+      'slowest_spans': slowest_spans(bundle, top=top),
+      'metric_deltas': metric_deltas(bundle, top=top),
+      'breakdown_windows': bundle.get('breakdown_windows', []),
+  }
+
+
+def render(bundle: Dict[str, Any], path: str,
+           max_events: Optional[int] = 60, top: int = 15) -> str:
+  lines = []
+  t = bundle.get('time')
+  when = (time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime(t))
+          if t else '?')
+  lines.append(f'postmortem: {path}')
+  lines.append(f'  reason:    {bundle.get("reason")}'
+               + (f'  (exit {bundle["exit_code"]})'
+                  if bundle.get('exit_code') is not None else ''))
+  lines.append(f'  when:      {when}   pid {bundle.get("pid")}')
+  error = bundle.get('error')
+  if error:
+    lines.append(f'  error:     {error.get("type")}: '
+                 f'{error.get("message", "")[:160]}')
+  topology = bundle.get('topology')
+  if topology:
+    lines.append('  topology:  ' + ', '.join(
+        f'{k}={v}' for k, v in sorted(topology.items())))
+
+  deltas = metric_deltas(bundle, top=top)
+  if deltas:
+    lines.append('')
+    lines.append(f'top metric movement over the final '
+                 f'{deltas[0]["window_sec"]:.0f}s window:')
+    for d in deltas:
+      lines.append(f'  {d["delta"]:>+12d}  {d["metric"]}'
+                   + ('  (observations)' if d['kind'] == 'histogram'
+                      else ''))
+
+  spans = slowest_spans(bundle, top=top)
+  if spans:
+    lines.append('')
+    lines.append('slowest spans in the window:')
+    for s in spans:
+      lines.append(f'  {s["dur_ms"]:>12.3f} ms  {s["name"]}')
+
+  windows = bundle.get('breakdown_windows') or []
+  if windows:
+    lines.append('')
+    lines.append('last dispatch-breakdown windows (ms/dispatch):')
+    lines.append('        wall    host_wait  placement   device    callback')
+    for w in windows[-8:]:
+      lines.append(
+          '  %10.2f %10.2f %10.2f %10.2f %10.2f' % (
+              w.get('breakdown/wall_ms', 0.0),
+              w.get('breakdown/host_wait_ms', 0.0),
+              w.get('breakdown/placement_ms', 0.0),
+              w.get('breakdown/device_step_ms', 0.0),
+              w.get('breakdown/callback_ms', 0.0)))
+
+  events = timeline(bundle, max_events=max_events)
+  lines.append('')
+  lines.append(f'timeline (last {len(events)} of '
+               f'{len(bundle.get("events", []))} events; '
+               't-0 = moment of death):')
+  for e in events:
+    lines.append(f'  {e["offset_sec"]:>+9.3f}s  [{e["kind"]:>10s}] '
+                 f'{e["name"]}  {e["detail"]}')
+  return '\n'.join(lines)
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__,
+      formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser.add_argument('path', help='Bundle file, model dir, or '
+                                   'postmortem dir (newest bundle wins).')
+  parser.add_argument('--json', action='store_true',
+                      help='Machine-readable summary instead of text.')
+  parser.add_argument('--events', type=int, default=60,
+                      help='Timeline rows to show (most recent).')
+  parser.add_argument('--top', type=int, default=15,
+                      help='Rows in the delta/slow-span rankings.')
+  args = parser.parse_args(argv)
+  try:
+    path = find_bundle(args.path)
+    bundle = load_bundle(path)
+  except (OSError, ValueError) as e:
+    print(f'error: {e}', file=sys.stderr)
+    return 1
+  try:
+    if args.json:
+      print(json.dumps(summarize(bundle, max_events=args.events,
+                                 top=args.top),
+                       indent=2, sort_keys=True))
+    else:
+      print(render(bundle, path, max_events=args.events, top=args.top))
+  except BrokenPipeError:
+    # `... | head` closed the pipe: normal CLI usage, not an error.
+    try:
+      sys.stdout.close()
+    except OSError:
+      pass
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
