@@ -638,6 +638,151 @@ def bench_serving_plane(clients_sweep=(1, 8, 16, 32), headline_clients=32,
   }))
 
 
+def bench_serving_scale(duration_secs=2.0):
+  """Serving at scale: router, replica fleet, and honest overload.
+
+  Three lines riding the same CPU-mock operating point as
+  ``bench_serving_plane`` (the per-chip deltas land on BENCH_r06):
+
+  * ``serving_router_actions_per_sec`` — 3 models on one device behind
+    a ModelRouter, closed-loop clients spread round-robin across the
+    models (the multi-tenant aggregate).
+  * ``serving_fleet_actions_per_sec`` — 2 serving replicas behind the
+    front-door balancer, measured through the balancer's HTTP edge.
+  * ``serving_overload_p99_ms`` — open-loop Poisson load at a FIXED
+    1.5x overload factor over the measured single-plane capacity,
+    mixed-priority, with the router's admission control active. The
+    p99 includes scheduling lag (coordinated omission is the reason
+    the old closed-loop loadgen could not produce this number); shed
+    counts ride the line so the rejection behavior is visible.
+  """
+  import numpy as np
+
+  from tensor2robot_tpu.observability import metrics as metrics_lib
+  from tensor2robot_tpu.predictors import CheckpointPredictor
+  from tensor2robot_tpu.serving import Balancer, ModelRouter, ServingServer
+  from tensor2robot_tpu.serving import loadgen
+  from tensor2robot_tpu.serving import router as router_lib
+  from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+  def make_predictor():
+    predictor = CheckpointPredictor(
+        MockT2RModel(device_type='tpu', hidden_size=2048),
+        model_dir='/nonexistent')
+    predictor.init_randomly()
+    return predictor
+
+  def features_fn(i):
+    return {'measured_position':
+            np.full((1, 2), 0.01 * (i % 13 + 1), np.float32)}
+
+  # --- 3 models, one device, one router -----------------------------------
+  model_names = ['m0', 'm1', 'm2']
+  router = ModelRouter(
+      {name: make_predictor() for name in model_names},
+      max_batch=64, batch_deadline_ms=0.2, register_report=False)
+  model_fn = router_lib.round_robin_models(model_names)
+  with router:
+    compiles0 = metrics_lib.counter('serving/bucket_compiles').value
+    open_submit = loadgen.router_submit_fn(router, model_fn=model_fn)
+
+    def submit(features, _count=iter(range(10**9))):
+      return open_submit(next(_count), features, 'interactive')
+
+    report = loadgen.run_load(
+        submit, features_fn, num_clients=24, duration_secs=duration_secs)
+    recompiles = (metrics_lib.counter('serving/bucket_compiles').value -
+                  compiles0)
+  print(json.dumps({
+      'metric': 'serving_router_actions_per_sec',
+      'value': round(report.actions_per_sec, 1),
+      'unit': 'actions/sec',
+      'models': len(model_names),
+      'clients': report.clients,
+      'latency_ms_p50': round(report.latency_ms_p50, 2),
+      'latency_ms_p99': round(report.latency_ms_p99, 2),
+      'errors': report.errors,
+      'recompiles_after_warmup': recompiles,
+      'note': '3 models on one device behind ModelRouter, closed-loop '
+              'clients round-robin across models; CPU-mock proxy',
+  }))
+
+  # --- 2 replicas behind the balancer -------------------------------------
+  replicas = [
+      ServingServer(make_predictor(), max_batch=64, batch_deadline_ms=0.2,
+                    metrics_prefix=f'serving/bench_replica{i}',
+                    register_report=False).start()
+      for i in range(2)
+  ]
+  try:
+    with Balancer([('127.0.0.1', r.port) for r in replicas],
+                  register_report=False) as balancer:
+      fleet = loadgen.run_load(
+          loadgen.http_submit_fn('127.0.0.1', balancer.port),
+          features_fn, num_clients=16, duration_secs=duration_secs)
+      balancer_stats = balancer.report()
+  finally:
+    for replica in replicas:
+      replica.close()
+  print(json.dumps({
+      'metric': 'serving_fleet_actions_per_sec',
+      'value': round(fleet.actions_per_sec, 1),
+      'unit': 'actions/sec',
+      'replicas': 2,
+      'clients': fleet.clients,
+      'latency_ms_p50': round(fleet.latency_ms_p50, 2),
+      'latency_ms_p99': round(fleet.latency_ms_p99, 2),
+      'errors': fleet.errors,
+      'balancer_retries': balancer_stats['retries'],
+      'note': '2 replicas behind the least-outstanding balancer, measured '
+              'through the balancer HTTP edge; CPU-mock proxy',
+  }))
+
+  # --- honest overload: open-loop at a fixed 1.5x factor ------------------
+  overload_factor = 1.5
+  workers = 32
+  shed0 = metrics_lib.counter('serving/shed_requests').value
+  # max_batch below the worker count: saturated workers leave a real
+  # backlog behind the assembling batch, which is the admission
+  # controller's signal (a batch that swallows all concurrency would
+  # hide the overload from the queue).
+  with ModelRouter({'m': make_predictor()},
+                   max_batch=16, batch_deadline_ms=0.2,
+                   max_queue=128, shed_queue_fraction=0.1,
+                   register_report=False) as single:
+    submit1 = loadgen.router_submit_fn(single)
+    # Capacity probe with the SAME concurrency as the open-loop run: the
+    # ceiling those workers can actually sustain, so 1.5x of it is a
+    # genuine overload, not an artifact of a weaker probe.
+    capacity = loadgen.run_load(
+        lambda f, _c=iter(range(10**9)): submit1(next(_c), f,
+                                                 'interactive'),
+        features_fn, num_clients=workers,
+        duration_secs=duration_secs / 2).actions_per_sec
+    rate = max(overload_factor * capacity, 50.0)
+    overload = loadgen.run_open_loop(
+        submit1, features_fn, rate_rps=rate, duration_secs=duration_secs,
+        workers=workers, seed=17, best_effort_fraction=0.5)
+  shed = metrics_lib.counter('serving/shed_requests').value - shed0
+  print(json.dumps({
+      'metric': 'serving_overload_p99_ms',
+      'value': round(overload.latency_ms_p99, 2),
+      'unit': 'ms',
+      'overload_factor': overload_factor,
+      'capacity_actions_per_sec': round(capacity, 1),
+      'offered_rps': round(overload.offered_rps, 1),
+      'achieved_rps': round(overload.achieved_rps, 1),
+      'latency_ms_p50': round(overload.latency_ms_p50, 2),
+      'shed_requests': shed,
+      'errors': overload.errors,
+      'interactive_p99_ms': overload.classes.get(
+          'interactive', {}).get('latency_ms_p99', 0.0),
+      'note': 'open-loop Poisson at 1.5x measured capacity, 50% '
+              'best-effort; p99 INCLUDES scheduling lag (no coordinated '
+              'omission) and admission shedding is active',
+  }))
+
+
 def bench_native_reader():
   """Native interleave-reader throughput on generated shards — JSON line."""
   import os
@@ -927,6 +1072,14 @@ def main():
   except Exception as e:
     print(json.dumps({'metric': 'serving_actions_per_sec',
                       'error': repr(e)[:200]}))
+  # Router/fleet/overload lines (ISSUE 11): on TPU these already ran in
+  # the same --serving subprocess above; only the direct path runs here.
+  if not on_tpu:
+    try:
+      bench_serving_scale()
+    except Exception as e:
+      print(json.dumps({'metric': 'serving_router_actions_per_sec',
+                        'error': repr(e)[:200]}))
   try:
     bench_native_reader()
   except Exception as e:
@@ -1005,5 +1158,6 @@ if __name__ == '__main__':
 
   if '--serving' in sys.argv[1:]:
     bench_serving_plane()  # CPU-pinned subprocess entry (see main)
+    bench_serving_scale()
   else:
     main()

@@ -1,0 +1,88 @@
+"""Front-door balancer binary: M serving replicas behind one address.
+
+Proxies ``POST /v1/predict`` / ``/v1/models/<name>/predict`` to the
+healthy backend replica with the fewest outstanding requests, ejecting
+backends whose ``/healthz`` fails and re-admitting them when it
+recovers. Transport failures and 503s fail over to the next backend, so
+a rolling deploy of the replica tier (``run_serving`` drains on
+SIGTERM) never drops a client request. ``X-Request-Id`` and
+``X-Priority`` headers are forwarded; the request ID is echoed on every
+response status.
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_balancer \
+      --backend 10.0.0.1:8000 --backend 10.0.0.2:8000 \
+      --port 9000 --metricsz-port 9001
+
+``GET /healthz`` answers for the balancer itself (200 iff >= 1 healthy
+backend); ``GET /statz`` returns per-backend health/outstanding/traffic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--backend', action='append', default=[],
+                      metavar='HOST:PORT', required=True,
+                      help='Repeatable: one serving replica.')
+  parser.add_argument('--port', type=int, default=9000)
+  parser.add_argument('--host', default='127.0.0.1',
+                      help='Bind address; loopback by default.')
+  parser.add_argument('--health-interval-secs', type=float, default=0.5,
+                      help='Backend /healthz poll cadence.')
+  parser.add_argument('--eject-after', type=int, default=2,
+                      help='Consecutive health failures before ejection.')
+  parser.add_argument('--readmit-after', type=int, default=1,
+                      help='Consecutive health successes before '
+                           're-admission.')
+  parser.add_argument('--proxy-timeout-secs', type=float, default=30.0)
+  parser.add_argument('--metricsz-port', type=int, default=None,
+                      help='Also serve the metrics registry (incl. the '
+                           'balancer report section) at /metricsz.')
+  args = parser.parse_args(argv)
+  logging.basicConfig(
+      level=logging.INFO,
+      format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+
+  from tensor2robot_tpu.observability import metricsz
+  from tensor2robot_tpu.serving import Balancer
+
+  balancer = Balancer(
+      args.backend,
+      port=args.port,
+      host=args.host,
+      health_interval_secs=args.health_interval_secs,
+      eject_after=args.eject_after,
+      readmit_after=args.readmit_after,
+      proxy_timeout_secs=args.proxy_timeout_secs)
+
+  stop = threading.Event()
+
+  def handle_signal(signum, frame):
+    del frame
+    logging.info('Received signal %d; shutting down balancer.', signum)
+    stop.set()
+
+  previous = {sig: signal.signal(sig, handle_signal)
+              for sig in (signal.SIGTERM, signal.SIGINT)}
+  try:
+    with balancer:
+      metricsz.maybe_start(args.metricsz_port)
+      logging.info('Balancing %d backend(s) at %s',
+                   balancer.backend_count(), balancer.url)
+      stop.wait()
+  finally:
+    for sig, handler in previous.items():
+      signal.signal(sig, handler)
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,4 +1,4 @@
-"""Serving binary: batched multi-client action serving from an export root.
+"""Serving binary: batched multi-client action serving from export roots.
 
 Loads the newest committed export version (waiting for the trainer's
 first export when ``--restore-timeout-secs`` is set), warms every batch
@@ -8,12 +8,23 @@ export root's commit markers and swaps new versions in between dispatches
 with zero dropped requests (a torn or broken export leaves the last-good
 model serving).
 
-Usage:
+Single model:
   python -m tensor2robot_tpu.bin.run_serving \
       --export_dir /models/m/export/latest_exporter_numpy \
       --port 8000 --max-batch 64 --batch-deadline-ms 5 \
       --metricsz-port 8001 --compilation-cache-dir /var/cache/t2r-xla \
       --quantize int8
+
+Multi-model (ModelRouter: N export roots, one device, LRU paging under
+an HBM byte budget, priority-class admission control — best-effort
+sheds with 503 + Retry-After before interactive is ever refused):
+  python -m tensor2robot_tpu.bin.run_serving \
+      --model grasp=/models/grasp/export --model eval=/models/eval/export \
+      --hbm-budget-mb 4096 --shed-queue-fraction 0.25 --port 8000
+
+Named models serve at ``POST /v1/models/<name>/predict``; the priority
+class rides the ``X-Priority`` header. Replicas of this binary go behind
+``tensor2robot_tpu.bin.run_balancer``.
 
 SIGTERM/SIGINT drain: the HTTP listener stops, queued requests complete,
 then the process exits 0 — a fleet scheduler can roll the serving tier
@@ -31,9 +42,28 @@ import threading
 
 def main(argv=None):
   parser = argparse.ArgumentParser(description=__doc__)
-  parser.add_argument('--export_dir', required=True,
+  parser.add_argument('--export_dir', default=None,
                       help='Versioned export root (the trainer exporter '
-                           'output, e.g. .../export/latest_exporter_numpy).')
+                           'output, e.g. .../export/latest_exporter_numpy). '
+                           'Single-model mode; exclusive with --model.')
+  parser.add_argument('--model', action='append', default=[],
+                      metavar='NAME=EXPORT_DIR',
+                      help='Repeatable: serve EXPORT_DIR as model NAME '
+                           'behind a ModelRouter (multi-model mode). '
+                           'The first --model is the default model.')
+  parser.add_argument('--hbm-budget-mb', type=float, default=None,
+                      help='HBM byte budget for the router: models past '
+                           'the budget are paged out LRU (host params + '
+                           'compiled executables kept, so page-in is a '
+                           'device_put, never a recompile). Unset: all '
+                           'models stay resident.')
+  parser.add_argument('--shed-queue-fraction', type=float, default=0.25,
+                      help='Best-effort traffic sheds (503 + Retry-After) '
+                           'once a model\'s queue passes this fraction of '
+                           '--max-queue; interactive is only ever refused '
+                           'by the hard bound itself.')
+  parser.add_argument('--retry-after-secs', type=float, default=1.0,
+                      help='Retry-After hint on shed responses.')
   parser.add_argument('--port', type=int, default=8000)
   parser.add_argument('--host', default='127.0.0.1',
                       help='Bind address; loopback by default — serving '
@@ -95,23 +125,24 @@ def main(argv=None):
 
   from tensor2robot_tpu.observability import metricsz
   from tensor2robot_tpu.predictors import ExportedModelPredictor
-  from tensor2robot_tpu.serving import ServingServer
+  from tensor2robot_tpu.serving import ModelRouter, ServingServer
 
-  predictor = ExportedModelPredictor(
-      export_dir=args.export_dir, timeout=args.restore_timeout_secs)
-  if not predictor.restore():
-    logging.error('No committed export appeared under %r within %.1fs.',
-                  args.export_dir, args.restore_timeout_secs)
-    return 1
+  if bool(args.export_dir) == bool(args.model):
+    parser.error('pass exactly one of --export_dir or --model NAME=DIR '
+                 '(repeatable)')
+
+  def load_predictor(export_dir):
+    predictor = ExportedModelPredictor(
+        export_dir=export_dir, timeout=args.restore_timeout_secs)
+    if not predictor.restore():
+      logging.error('No committed export appeared under %r within %.1fs.',
+                    export_dir, args.restore_timeout_secs)
+      return None
+    return predictor
 
   reload_interval = (args.reload_interval_secs
                      if args.reload_interval_secs > 0 else None)
-  server = ServingServer(
-      predictor,
-      port=args.port,
-      host=args.host,
-      request_timeout_secs=args.request_timeout_secs,
-      compilation_cache_dir=args.compilation_cache_dir,
+  batcher_kwargs = dict(
       max_batch=args.max_batch,
       batch_deadline_ms=args.batch_deadline_ms,
       max_queue=args.max_queue,
@@ -121,6 +152,38 @@ def main(argv=None):
       quant_parity_rtol=args.quant_parity_rtol,
       request_trace_sample=args.request_trace_sample,
       postmortem_dir=args.postmortem_dir)
+  server_kwargs = dict(
+      port=args.port,
+      host=args.host,
+      request_timeout_secs=args.request_timeout_secs,
+      compilation_cache_dir=args.compilation_cache_dir)
+
+  if args.model:
+    predictors = {}
+    default_model = None
+    for spec in args.model:
+      name, sep, export_dir = spec.partition('=')
+      if not sep or not name or not export_dir:
+        parser.error(f'--model {spec!r} is not NAME=EXPORT_DIR')
+      predictor = load_predictor(export_dir)
+      if predictor is None:
+        return 1
+      predictors[name] = predictor
+      default_model = default_model or name
+    router = ModelRouter(
+        predictors,
+        hbm_budget_bytes=(None if args.hbm_budget_mb is None
+                          else int(args.hbm_budget_mb * 1e6)),
+        default_model=default_model,
+        shed_queue_fraction=args.shed_queue_fraction,
+        retry_after_secs=args.retry_after_secs,
+        **batcher_kwargs)
+    server = ServingServer(router=router, **server_kwargs)
+  else:
+    predictor = load_predictor(args.export_dir)
+    if predictor is None:
+      return 1
+    server = ServingServer(predictor, **server_kwargs, **batcher_kwargs)
 
   stop = threading.Event()
 
@@ -134,8 +197,12 @@ def main(argv=None):
   try:
     with server:
       metricsz.maybe_start(args.metricsz_port)
-      logging.info('Serving model version %d at %s',
-                   server.batcher.model_version, server.url)
+      if server.router is not None:
+        logging.info('Serving models %s at %s',
+                     server.router.versions(), server.url)
+      else:
+        logging.info('Serving model version %d at %s',
+                     server.batcher.model_version, server.url)
       stop.wait()
   finally:
     for sig, handler in previous.items():

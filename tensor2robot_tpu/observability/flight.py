@@ -32,9 +32,13 @@ Design constraints, in the observability tradition:
 Event shape: ``(time.time(), kind, name, detail)`` where ``kind`` is a
 coarse subsystem tag (``'span' | 'dispatch' | 'checkpoint' | 'swap' |
 'nonfinite' | 'budget' | 'shutdown' | 'liveness' | 'request' |
-'error'``), ``name`` a slash-scoped identifier like metric names, and
-``detail`` a short ``k=v``-style string (machine-greppable: the
-postmortem renderer parses ``dur_ms=`` / ``id=`` tokens out of it).
+'router' | 'balancer' | 'error'``), ``name`` a slash-scoped identifier
+like metric names, and ``detail`` a short ``k=v``-style string
+(machine-greppable: the postmortem renderer parses ``dur_ms=`` /
+``id=`` tokens out of it). ``'router'`` carries the serving router's
+page-in/page-out/shed decisions, ``'balancer'`` the front door's
+eject/readmit transitions — so a latency incident bundle names the
+paging and fleet-membership churn around it.
 """
 
 from __future__ import annotations

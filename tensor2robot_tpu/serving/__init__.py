@@ -1,11 +1,17 @@
-"""Batched multi-client serving plane (ROADMAP direction 1).
+"""Serving plane (ROADMAP direction 2): batched, multi-model, fleet-scale.
 
 ``batching`` — deadline-aware cross-client batch assembly, bucketed AOT
 dispatch over a stateless predictor core, hot model swap between
-dispatches. ``server`` — the stdlib-HTTP front door. ``loadgen`` — the
-synthetic-client load generator behind the serving bench lines.
+dispatches, HBM paging hooks. ``router`` — multi-model routing on one
+device: LRU paging under an HBM byte budget + priority-class admission
+control. ``server`` — the stdlib-HTTP front door (single model or a
+whole router). ``balancer`` — the front-door balancer over M serving
+replicas (least-outstanding pick, health ejection/readmission).
+``loadgen`` — closed-loop clients and open-loop Poisson arrivals behind
+the serving bench lines.
 """
 
+from tensor2robot_tpu.serving.balancer import Balancer
 from tensor2robot_tpu.serving.batching import (
     DynamicBatcher,
     JitBucketExecutor,
@@ -13,8 +19,10 @@ from tensor2robot_tpu.serving.batching import (
     RequestError,
     ServingError,
     ServingFuture,
+    SheddedError,
     bucket_for,
     default_buckets,
     pad_to_bucket,
 )
+from tensor2robot_tpu.serving.router import ModelRouter
 from tensor2robot_tpu.serving.server import ServingServer

@@ -51,12 +51,20 @@ Design points:
   so weights-only hot swaps still reuse compiled buckets and the
   zero-recompile guarantee is preserved.
 
-SLO metrics live in the process registry under ``serving/`` and are
-published through ``/metricsz`` via ``register_report_provider('serving',
-...)``: request/action counters, batch-size + request-latency histograms
-(p50/p99), a rolling ``serving/actions_per_sec`` gauge, queue depth,
-swap/compile counters, and the quantization block (``serving/param_bytes``
-gauge, ``serving/quant/*`` parity + compression gauges).
+SLO metrics live in the process registry under ``metrics_prefix``
+(default ``serving/`` — under a :class:`~tensor2robot_tpu.serving.router.
+ModelRouter` each model's batcher scopes to ``serving/model/<name>/``)
+and are published through ``/metricsz`` via ``register_report_provider``:
+request/action counters, batch-size + request-latency histograms
+(p50/p99), a rolling ``actions_per_sec`` gauge, queue depth,
+swap/compile counters, and the quantization block (``param_bytes``
+gauge, ``quant/*`` parity + compression gauges).
+
+Fleet hooks (ROADMAP direction 2): ``queue_depth`` and ``submit(...,
+on_done=...)`` feed the router's admission control and per-class SLOs;
+the executor's ``page_out()``/``page_in()`` pair implements HBM-budgeted
+model paging — host params and compiled bucket executables are KEPT
+across a page-out, so page-in is a ``device_put``, never a recompile.
 """
 
 from __future__ import annotations
@@ -82,6 +90,20 @@ class ServingError(Exception):
 
 class OverloadedError(ServingError):
   """The request queue is full (or the plane is shutting down)."""
+
+
+class SheddedError(OverloadedError):
+  """Admission control rejected this request (priority-class shedding).
+
+  Carries ``retry_after_secs`` so the HTTP edge can reply 503 with a
+  ``Retry-After`` header — the client contract is *back off and retry*,
+  not *fail*: shedding best-effort traffic is how the interactive robot
+  tier keeps its latency SLO under overload.
+  """
+
+  def __init__(self, message: str, retry_after_secs: float = 1.0):
+    super().__init__(message)
+    self.retry_after_secs = float(retry_after_secs)
 
 
 class RequestError(ServingError):
@@ -126,11 +148,13 @@ class _Request:
   """One client's queued examples + completion signal."""
 
   __slots__ = ('features', 'n', 'enqueue_time', 'event', 'outputs', 'error',
-               'model_version', 'request_id', 'traced', 'queued_wall')
+               'model_version', 'request_id', 'traced', 'queued_wall',
+               'on_done')
 
   def __init__(self, features: Dict[str, np.ndarray], n: int,
                enqueue_time: float, request_id: str = '',
-               traced: bool = False):
+               traced: bool = False,
+               on_done: Optional[Callable[['_Request'], None]] = None):
     self.features = features
     self.n = n
     self.enqueue_time = enqueue_time
@@ -140,6 +164,9 @@ class _Request:
     self.model_version: int = -1
     self.request_id = request_id
     self.traced = traced
+    # Completion hook (router SLO accounting): invoked on the dispatcher
+    # thread after the result is published, holding no batcher lock.
+    self.on_done = on_done
     # Wall-clock submit time for traced requests: the dispatcher records
     # the 'queued' flight event retroactively with this timestamp, so
     # client threads never touch the ring (no lock contention at the
@@ -188,7 +215,8 @@ class JitBucketExecutor:
 
   def __init__(self, serving: 'StatelessServingFn',
                buckets: Sequence[int],
-               compiled: Optional[Dict[int, Any]] = None):
+               compiled: Optional[Dict[int, Any]] = None,
+               label: str = 'serving'):
     import jax
 
     from tensor2robot_tpu.export.exporters import to_plain_tree
@@ -196,6 +224,7 @@ class JitBucketExecutor:
     self._fn = serving.fn
     self._feature_spec = serving.feature_spec
     self._buckets = tuple(buckets)
+    self._label = label
     self.program_key = serving.program_key
     self.version = serving.version
     self.params_ref = serving.params  # identity marker for swap detection
@@ -213,9 +242,16 @@ class JitBucketExecutor:
     self._param_shapes = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
         host_params)
+    # The host tree is KEPT across the executor's lifetime: it is what
+    # makes model paging (router.py) a `device_put`, never a reload or a
+    # recompile — compiled bucket executables survive a page-out.
+    self._host_params = host_params
     # Weights live on device across dispatches: re-uploading them per
-    # batch would dominate the dispatch at robot-scale models.
-    self._device_params = jax.device_put(host_params)
+    # batch would dominate the dispatch at robot-scale models. The page
+    # lock serializes paging decisions against in-flight dispatches (a
+    # page-out waits for the current dispatch, never tears one).
+    self._page_lock = threading.Lock()
+    self._device_params = jax.device_put(host_params)  # GUARDED_BY(self._page_lock)
     self._compiled: Dict[int, Any] = dict(compiled or {})
 
   def compatible_cache(self, serving: 'StatelessServingFn'
@@ -270,9 +306,57 @@ class JitBucketExecutor:
     for bucket in self._buckets:
       self.ensure_bucket(bucket)
 
+  # ------------------------------------------------------------- HBM paging
+
+  @property
+  def resident(self) -> bool:
+    """Whether the params are currently device-resident (HBM)."""
+    with self._page_lock:
+      return self._device_params is not None
+
+  def page_out(self) -> int:
+    """Releases the device-resident params (LRU eviction under an HBM
+    budget). Host params and every compiled bucket executable are KEPT,
+    so the matching page-in is a ``device_put`` — never a recompile.
+    Returns the HBM bytes released (0 when already paged out)."""
+    with self._page_lock:
+      if self._device_params is None:
+        return 0
+      self._device_params = None
+      metrics_lib.counter('serving/page_outs').inc()
+      flight.event('router', f'{self._label}/page_out',
+                   f'version={self.version} bytes={self.param_bytes}')
+      return self.param_bytes
+
+  def page_in(self) -> bool:
+    """Re-places host params on device; True iff a transfer happened."""
+    with self._page_lock:
+      if self._device_params is not None:
+        return False
+      self._page_in_locked()
+      return True
+
+  def _page_in_locked(self) -> None:  # HOLDS(self._page_lock)
+    import jax
+
+    t0 = time.perf_counter()
+    self._device_params = jax.device_put(self._host_params)
+    metrics_lib.counter('serving/page_ins').inc()
+    metrics_lib.histogram('serving/page_in_ms').observe(
+        1e3 * (time.perf_counter() - t0))
+    flight.event('router', f'{self._label}/page_in',
+                 f'version={self.version} bytes={self.param_bytes}')
+
   def execute(self, features: Dict[str, np.ndarray],
               bucket: int) -> Dict[str, np.ndarray]:
-    outputs = self.ensure_bucket(bucket)(self._device_params, features)
+    exe = self.ensure_bucket(bucket)
+    with self._page_lock:
+      # Auto page-in: a request queued for a model the router paged out
+      # after admission must never fail — correctness over budget (the
+      # router's accounting converges on the next submit).
+      if self._device_params is None:
+        self._page_in_locked()
+      outputs = exe(self._device_params, features)
     return {k: np.asarray(v) for k, v in outputs.items()}
 
 
@@ -285,6 +369,10 @@ class PredictCallableExecutor:
   own shape handling — so the zero-recompile guarantee does not apply.
   """
 
+  # Callable executors own no device-resident params: they are always
+  # "resident" and never pageable (router paging skips them).
+  resident = True
+
   def __init__(self, predictor):
     self._predictor = predictor
     self.program_key = ('predict_callable', id(predictor))
@@ -294,6 +382,12 @@ class PredictCallableExecutor:
 
   def warm(self) -> None:
     pass
+
+  def page_out(self) -> int:
+    return 0
+
+  def page_in(self) -> bool:
+    return False
 
   def compatible_cache(self, serving) -> Optional[Dict[int, Any]]:
     del serving
@@ -330,6 +424,8 @@ class DynamicBatcher:
                request_trace_sample: float = 0.0,
                slow_request_log_size: int = 10,
                postmortem_dir: Optional[str] = None,
+               metrics_prefix: str = 'serving',
+               register_report: bool = True,
                clock: Callable[[], float] = time.monotonic):
     if max_batch < 1:
       raise ValueError(f'max_batch must be >= 1, got {max_batch}')
@@ -394,7 +490,13 @@ class DynamicBatcher:
     self._rate_window: collections.deque = collections.deque()
     self._rate_span_s = 5.0
 
-    s = metrics_lib.scope('serving')
+    # Per-instance metric scope: a standalone plane keeps the historical
+    # 'serving' prefix; under a ModelRouter each model's batcher scopes
+    # to 'serving/model/<name>' so per-model SLOs are first-class (and N
+    # batchers in one process never clobber each other's gauges).
+    self._metrics_prefix = metrics_prefix.rstrip('/')
+    self._register_report = bool(register_report)
+    s = metrics_lib.scope(self._metrics_prefix)
     self._m_requests = s.counter('requests')
     self._m_actions = s.counter('actions')
     self._m_errors = s.counter('request_errors')
@@ -411,7 +513,7 @@ class DynamicBatcher:
     self._m_param_bytes = s.gauge('param_bytes')
     self._m_quant_rejects = s.counter('quant_parity_rejects')
     self._m_quant_errors = s.counter('quant_errors')
-    qs = metrics_lib.scope('serving/quant')
+    qs = metrics_lib.scope(self._metrics_prefix + '/quant')
     self._m_quant_active = qs.gauge('active')
     self._m_quant_bytes_full = qs.gauge('param_bytes_full')
     self._m_quant_bytes_ratio = qs.gauge('param_bytes_ratio')
@@ -447,7 +549,8 @@ class DynamicBatcher:
       self._reloader = threading.Thread(
           target=self._reload_loop, daemon=True, name='t2r-serving-reload')
       self._reloader.start()
-    metrics_lib.register_report_provider('serving', self.report)
+    if self._register_report:
+      metrics_lib.register_report_provider(self._metrics_prefix, self.report)
     return self
 
   def close(self) -> None:
@@ -464,7 +567,8 @@ class DynamicBatcher:
       self._dispatcher.join(timeout=60.0)
       # Only a STARTED batcher owns the provider slot; closing a
       # never-started one must not unregister a live sibling's report.
-      metrics_lib.unregister_report_provider('serving')
+      if self._register_report:
+        metrics_lib.unregister_report_provider(self._metrics_prefix)
 
   def __enter__(self) -> 'DynamicBatcher':
     return self.start()
@@ -488,8 +592,29 @@ class DynamicBatcher:
   def buckets(self) -> Tuple[int, ...]:
     return self._buckets
 
+  @property
+  def max_queue(self) -> int:
+    return self._max_queue
+
+  @property
+  def metrics_prefix(self) -> str:
+    return self._metrics_prefix
+
+  @property
+  def queue_depth(self) -> int:
+    """Live pending-request count (the router's admission signal)."""
+    with self._cond:
+      return len(self._pending)
+
+  def current_executor(self):
+    """The live model generation (router paging/accounting hook)."""
+    with self._cond:
+      return self._model
+
   def submit(self, features: Dict[str, np.ndarray],
-             request_id: Optional[str] = None) -> ServingFuture:
+             request_id: Optional[str] = None,
+             on_done: Optional[Callable[['_Request'], None]] = None
+             ) -> ServingFuture:
     """Queues one client's examples; returns a future for the batched
     dispatch. ``features`` values carry a leading batch dim and share
     it (a single example may omit it — the predictor's dim-expansion
@@ -512,7 +637,7 @@ class DynamicBatcher:
     rid = request_id if request_id else f'{self._id_prefix}-{seq}'
     traced = bool(self._trace_every) and seq % self._trace_every == 0
     request = _Request(features, int(n), self._clock(), request_id=rid,
-                       traced=traced)
+                       traced=traced, on_done=on_done)
     if traced:
       request.queued_wall = time.time()
     with self._cond:
@@ -564,12 +689,18 @@ class DynamicBatcher:
     until ``max_batch`` examples or ``batch_deadline_ms`` after
     assembly began — whichever comes first. Backlog drains without
     waiting (a busy dispatcher returns to a full queue and leaves with
-    a full batch immediately). Returns None on shutdown-and-drained."""
+    a full batch immediately). Returns None on shutdown-and-drained,
+    and an EMPTY batch when a staged model generation is waiting on an
+    otherwise idle plane — so a rolling deploy is adopted (and visible
+    in ``model_version``/healthz) without requiring traffic."""
     with self._cond:
-      while not self._pending and not self._closed:
+      while (not self._pending and not self._closed
+             and self._pending_model is None):
         self._cond.wait()
       if not self._pending:
-        return None  # closed and drained
+        if self._closed:
+          return None  # closed and drained
+        return []  # idle adoption: swap now, assemble later
       batch: List[_Request] = []
       total = 0
       deadline = self._clock() + self._deadline_s
@@ -622,11 +753,12 @@ class DynamicBatcher:
         self._m_swaps.inc()
         self._m_version.set(float(pending.version))
         self._m_param_bytes.set(float(pending.param_bytes))
-        flight.event('swap', 'serving/model_swap',
+        flight.event('swap', f'{self._metrics_prefix}/model_swap',
                      f'version={pending.version}')
         logging.info('Serving hot-swapped to model version %d',
                      pending.version)
-      self._execute(batch)
+      if batch:
+        self._execute(batch)
 
   def _execute(self, batch: List[_Request]) -> None:
     total = sum(r.n for r in batch)
@@ -637,12 +769,13 @@ class DynamicBatcher:
     # dispatch, not per request), keeping full-sample tracing within
     # the bench-pinned 3% overhead budget.
     traced = [r for r in batch if r.traced]
+    prefix = self._metrics_prefix
     if traced:
       assembled = f' batch={len(batch)} total={total}'
-      entries = [('request', 'serving/queued',
+      entries = [('request', f'{prefix}/queued',
                   f'id={r.request_id} n={r.n}', r.queued_wall)
                  for r in traced]
-      entries.extend(('request', 'serving/assembled',
+      entries.extend(('request', f'{prefix}/assembled',
                       'id=' + r.request_id + assembled) for r in traced)
       flight.events_many(entries)
     t0 = self._clock()
@@ -664,7 +797,7 @@ class DynamicBatcher:
       if traced:
         dispatched = f' bucket={bucket}'
         flight.events_many([
-            ('request', 'serving/dispatched',
+            ('request', f'{prefix}/dispatched',
              'id=' + r.request_id + dispatched) for r in traced])
       outputs = model.execute(features, bucket)
       offset = 0
@@ -695,12 +828,17 @@ class DynamicBatcher:
         self._note_slow(request, latency_ms, now)
         if request.traced:
           returned_events.append(
-              ('request', 'serving/returned',
+              ('request', f'{prefix}/returned',
                f'id={request.request_id} latency_ms={latency_ms:.3f} '
                f'error={int(request.error is not None)}'))
       flight.events_many(returned_events)
       for request in batch:
         request.event.set()
+        if request.on_done is not None:
+          try:
+            request.on_done(request)
+          except Exception:  # pylint: disable=broad-except
+            logging.exception('serving on_done callback failed')
 
   def _note_slow(self, request: _Request, latency_ms: float,
                  now: float) -> None:
@@ -751,7 +889,8 @@ class DynamicBatcher:
     serving = self._quantize_gate(source)
     compiled = (reuse_from.compatible_cache(serving)
                 if reuse_from is not None else None)
-    executor = JitBucketExecutor(serving, self._buckets, compiled=compiled)
+    executor = JitBucketExecutor(serving, self._buckets, compiled=compiled,
+                                 label=self._metrics_prefix)
     # Reload polling compares against the predictor's OWN generation,
     # not the derived quantized tree (see _same_generation).
     executor.source_params_ref = source.params
@@ -837,10 +976,13 @@ class DynamicBatcher:
       new_model.warm()  # compile before adoption: swap cost ~pointer swap
       with self._cond:
         self._pending_model = new_model
+        # Wake an idle dispatcher: a deploy must be adopted (and show in
+        # model_version / healthz) even when no traffic is flowing.
+        self._cond.notify_all()
       return True
     except Exception as e:  # pylint: disable=broad-except
       self._m_reload_errors.inc()
-      flight.event('error', 'serving/reload_failed', repr(e))
+      flight.event('error', f'{self._metrics_prefix}/reload_failed', repr(e))
       logging.warning(
           'Serving reload failed (%r); continuing on model version %d.',
           e, self.model_version)
@@ -859,7 +1001,7 @@ class DynamicBatcher:
     """Bundles a reload the PREDICTOR degraded to last-good internally."""
     if self._m_predictor_fallbacks.value <= fallbacks_before:
       return
-    flight.event('error', 'serving/reload_fallback',
+    flight.event('error', f'{self._metrics_prefix}/reload_fallback',
                  f'predictor kept last-good version={self.model_version}')
     from tensor2robot_tpu.observability import postmortem
 
@@ -887,9 +1029,11 @@ class DynamicBatcher:
   # ------------------------------------------------------------- reporting
 
   def report(self) -> Dict[str, Any]:
-    """The ``serving`` section of ``metrics.report()`` / ``/metricsz``."""
-    snap = metrics_lib.snapshot('serving/')
-    latency = snap.get('serving/request_latency_ms', {}) or {}
+    """The plane's section of ``metrics.report()`` / ``/metricsz``
+    (keyed by ``metrics_prefix``; ``'serving'`` for a standalone plane)."""
+    p = self._metrics_prefix
+    snap = metrics_lib.snapshot(p + '/')
+    latency = snap.get(f'{p}/request_latency_ms', {}) or {}
     return {
         'request_trace_sample': self._trace_sample,
         'request_latency_exemplars': latency.get('exemplars', {}),
@@ -898,30 +1042,30 @@ class DynamicBatcher:
         'batch_deadline_ms': self._deadline_s * 1e3,
         'buckets': list(self._buckets),
         'model_version': self.model_version,
-        'queue_depth': snap.get('serving/queue_depth', 0.0),
-        'requests': snap.get('serving/requests', 0),
-        'request_errors': snap.get('serving/request_errors', 0),
-        'actions': snap.get('serving/actions', 0),
-        'actions_per_sec': snap.get('serving/actions_per_sec', 0.0),
+        'queue_depth': snap.get(f'{p}/queue_depth', 0.0),
+        'requests': snap.get(f'{p}/requests', 0),
+        'request_errors': snap.get(f'{p}/request_errors', 0),
+        'actions': snap.get(f'{p}/actions', 0),
+        'actions_per_sec': snap.get(f'{p}/actions_per_sec', 0.0),
         'request_latency_ms_p50': latency.get('p50', 0.0),
         'request_latency_ms_p99': latency.get('p99', 0.0),
-        'batch_size': snap.get('serving/batch_size', {}),
-        'dispatches': snap.get('serving/dispatches', 0),
-        'padded_examples': snap.get('serving/padded_examples', 0),
-        'model_swaps': snap.get('serving/model_swaps', 0),
-        'reload_errors': snap.get('serving/reload_errors', 0),
+        'batch_size': snap.get(f'{p}/batch_size', {}),
+        'dispatches': snap.get(f'{p}/dispatches', 0),
+        'padded_examples': snap.get(f'{p}/padded_examples', 0),
+        'model_swaps': snap.get(f'{p}/model_swaps', 0),
+        'reload_errors': snap.get(f'{p}/reload_errors', 0),
         'bucket_compiles': snap.get('serving/bucket_compiles', 0),
         'quantize': self._quantize,
-        'quantized_active': bool(snap.get('serving/quant/active', 0.0)),
-        'param_bytes': int(snap.get('serving/param_bytes', 0.0)),
-        'quant_parity_rejects': snap.get('serving/quant_parity_rejects', 0),
-        'quant_errors': snap.get('serving/quant_errors', 0),
+        'quantized_active': bool(snap.get(f'{p}/quant/active', 0.0)),
+        'param_bytes': int(snap.get(f'{p}/param_bytes', 0.0)),
+        'quant_parity_rejects': snap.get(f'{p}/quant_parity_rejects', 0),
+        'quant_errors': snap.get(f'{p}/quant_errors', 0),
         'quant_param_bytes_full': int(
-            snap.get('serving/quant/param_bytes_full', 0.0)),
+            snap.get(f'{p}/quant/param_bytes_full', 0.0)),
         'quant_param_bytes_ratio': snap.get(
-            'serving/quant/param_bytes_ratio', 0.0),
+            f'{p}/quant/param_bytes_ratio', 0.0),
         'quant_parity_max_abs_err': snap.get(
-            'serving/quant/parity_max_abs_err', 0.0),
+            f'{p}/quant/parity_max_abs_err', 0.0),
         'quant_parity_max_rel_err': snap.get(
-            'serving/quant/parity_max_rel_err', 0.0),
+            f'{p}/quant/parity_max_rel_err', 0.0),
     }

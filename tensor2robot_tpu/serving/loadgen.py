@@ -1,13 +1,30 @@
-"""Synthetic multi-client load generator for the serving plane.
+"""Synthetic load generation for the serving plane: closed- AND open-loop.
 
-Drives N concurrent closed-loop clients (each waits for its response
-before sending the next request — the robot control-loop pattern) against
-either the in-process batcher (``inproc_submit_fn``: measures the
-batching plane itself) or the HTTP front door (``http_submit_fn``: adds
-the JSON/TCP edge). Latencies are recorded EXACTLY per request (the
-registry's power-of-two histogram is for live SLOs; a bench line wants
-true percentiles) and reduced to the report ``bench.py`` prints as
-``serving_actions_per_sec`` / ``serving_latency_ms_p50/p99``.
+Two generator shapes, because they answer different questions:
+
+* :func:`run_load` — N concurrent **closed-loop** clients (each waits
+  for its response before sending the next request — the robot
+  control-loop pattern). Right for *throughput* questions: the plane's
+  aggregate actions/s at a given concurrency.
+* :func:`run_open_loop` — **open-loop Poisson arrivals** at a
+  configured rate, independent of the system's responses. Right for
+  *latency* questions: a closed-loop client self-throttles the moment
+  the system slows down, silently excising the very overload samples a
+  p99 exists to capture (coordinated omission). Here every request has
+  a *scheduled* arrival time drawn from the arrival process, and its
+  recorded latency runs from that schedule — so queueing delay AND
+  generator scheduling lag land in the percentiles, which is what gives
+  the admission controller (router.py) something real to reject.
+  Arrival rates support burst multipliers and a diurnal trace mode
+  (piecewise rate multipliers across the run), and each arrival is
+  assigned a priority class (``best_effort_fraction``) so mixed-tenant
+  overload drills shed visibly.
+
+Latency samples are **bounded by construction**: a fixed-capacity
+uniform reservoir (Algorithm R) replaces the historical exact per-
+request lists, so a multi-hour soak holds the same memory as a 2-second
+bench while percentiles stay statistically exact-in-expectation
+(count/sum/min/max stay exact).
 
 Also provides the single-client serial baseline (``serial_baseline``):
 back-to-back ``predictor.predict()`` calls, one example each — the
@@ -17,15 +34,99 @@ the cross-client-batching speedup claim.
 
 from __future__ import annotations
 
+import itertools
+import math
+import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, NamedTuple, Optional
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence)
 
 import numpy as np
 
+DEFAULT_RESERVOIR_SIZE = 8192
+
+
+class ShedError(RuntimeError):
+  """The plane refused this request (503: shed / overloaded / draining).
+
+  Open-loop runs count sheds separately from errors — a shed is the
+  admission controller WORKING, not the plane failing.
+  """
+
+
+class Reservoir:
+  """Fixed-capacity uniform sample of a value stream (Algorithm R).
+
+  ``add`` is O(1) and thread-safe; ``seen``/``total``/``min``/``max``
+  stay exact while the percentile estimates are computed over a uniform
+  subsample of at most ``capacity`` values — bounded memory no matter
+  how long the load run soaks.
+  """
+
+  def __init__(self, capacity: int = DEFAULT_RESERVOIR_SIZE, seed: int = 0):
+    if capacity < 1:
+      raise ValueError(f'capacity must be >= 1, got {capacity}')
+    self._capacity = int(capacity)
+    self._rng = random.Random(seed)
+    self._lock = threading.Lock()
+    self._samples: List[float] = []  # GUARDED_BY(self._lock)
+    self._seen = 0  # GUARDED_BY(self._lock)
+    self._sum = 0.0  # GUARDED_BY(self._lock)
+    self._min = math.inf  # GUARDED_BY(self._lock)
+    self._max = -math.inf  # GUARDED_BY(self._lock)
+
+  @property
+  def capacity(self) -> int:
+    return self._capacity
+
+  @property
+  def seen(self) -> int:
+    with self._lock:
+      return self._seen
+
+  def add(self, value: float) -> None:
+    value = float(value)
+    with self._lock:
+      self._seen += 1
+      self._sum += value
+      if value < self._min:
+        self._min = value
+      if value > self._max:
+        self._max = value
+      if len(self._samples) < self._capacity:
+        self._samples.append(value)
+      else:
+        j = self._rng.randrange(self._seen)
+        if j < self._capacity:
+          self._samples[j] = value
+
+  def summary(self) -> Dict[str, float]:
+    """count/mean/min/max exact; p50/p99 over the uniform subsample."""
+    with self._lock:
+      samples = sorted(self._samples)
+      seen, total = self._seen, self._sum
+      lo, hi = self._min, self._max
+    if not seen:
+      return {'count': 0, 'mean': 0.0, 'min': 0.0, 'max': 0.0,
+              'p50': 0.0, 'p99': 0.0}
+    return {
+        'count': seen,
+        'mean': total / seen,
+        'min': lo,
+        'max': hi,
+        'p50': _percentile(samples, 0.50),
+        'p99': _percentile(samples, 0.99),
+    }
+
+  def percentile(self, fraction: float) -> float:
+    with self._lock:
+      samples = sorted(self._samples)
+    return _percentile(samples, fraction)
+
 
 class LoadReport(NamedTuple):
-  """One load run, reduced."""
+  """One closed-loop load run, reduced."""
 
   clients: int
   requests: int
@@ -49,12 +150,15 @@ class LoadReport(NamedTuple):
     }
 
 
-def _percentile(sorted_values: List[float], fraction: float) -> float:
+def _percentile(sorted_values: Sequence[float], fraction: float) -> float:
   if not sorted_values:
     return 0.0
   index = min(len(sorted_values) - 1,
               max(0, int(round(fraction * (len(sorted_values) - 1)))))
   return sorted_values[index]
+
+
+# ------------------------------------------------------------- submit shims
 
 
 def inproc_submit_fn(batcher, timeout: float = 30.0) -> Callable:
@@ -66,29 +170,74 @@ def inproc_submit_fn(batcher, timeout: float = 30.0) -> Callable:
   return submit
 
 
+def router_submit_fn(router, model_fn: Optional[Callable[[int], str]] = None,
+                     timeout: float = 30.0) -> Callable:
+  """Open-loop submit(index, features, priority) against a ModelRouter.
+
+  ``model_fn(index)`` picks the target model per arrival (e.g.
+  ``router.round_robin_models([...])``); None targets the default model.
+  Admission sheds surface as :class:`ShedError`.
+  """
+  from tensor2robot_tpu.serving import batching as batching_lib
+
+  def submit(index, features, priority):
+    model = model_fn(index) if model_fn is not None else None
+    try:
+      return router.submit(features, model=model,
+                           priority=priority).result(timeout=timeout)
+    except batching_lib.OverloadedError as e:
+      raise ShedError(str(e)) from e
+
+  return submit
+
+
 def http_submit_fn(host: str, port: int, timeout: float = 30.0) -> Callable:
-  """submit(features) -> outputs over HTTP (per-thread keep-alive conn)."""
+  """Closed-loop submit(features) -> outputs over HTTP (keep-alive)."""
+  open_submit = http_open_submit_fn(host, port, timeout=timeout)
+
+  def submit(features):
+    return open_submit(0, features, None)
+
+  return submit
+
+
+def http_open_submit_fn(host: str, port: int,
+                        model_fn: Optional[Callable[[int], str]] = None,
+                        timeout: float = 30.0) -> Callable:
+  """Open-loop submit(index, features, priority) over HTTP.
+
+  Per-thread keep-alive connections; named models route to
+  ``/v1/models/<name>/predict`` and the priority class rides the
+  ``X-Priority`` header (the balancer forwards both, plus
+  ``X-Request-Id``). A 503 raises :class:`ShedError`.
+  """
   import http.client
   import json
 
   local = threading.local()
 
-  def submit(features):
+  def submit(index, features, priority):
     conn = getattr(local, 'conn', None)
     if conn is None:
       conn = http.client.HTTPConnection(host, port, timeout=timeout)
       local.conn = conn
+    model = model_fn(index) if model_fn is not None else None
+    path = (f'/v1/models/{model}/predict' if model else '/v1/predict')
+    headers = {'Content-Type': 'application/json'}
+    if priority:
+      headers['X-Priority'] = priority
     body = json.dumps({
         'features': {k: np.asarray(v).tolist() for k, v in features.items()}
     })
     try:
-      conn.request('POST', '/v1/predict', body=body,
-                   headers={'Content-Type': 'application/json'})
+      conn.request('POST', path, body=body, headers=headers)
       response = conn.getresponse()
       payload = json.loads(response.read())
     except Exception:
       local.conn = None  # drop the broken keep-alive connection
       raise
+    if response.status == 503:
+      raise ShedError(str(payload.get('error', payload)))
     if response.status != 200:
       raise RuntimeError(
           f'HTTP {response.status}: {payload.get("error", payload)}')
@@ -97,23 +246,29 @@ def http_submit_fn(host: str, port: int, timeout: float = 30.0) -> Callable:
   return submit
 
 
+# ------------------------------------------------------------- closed loop
+
+
 def run_load(submit: Callable,
              features_fn: Callable[[int], Dict[str, np.ndarray]],
              num_clients: int,
              requests_per_client: Optional[int] = None,
              duration_secs: Optional[float] = None,
              examples_per_request: int = 1,
-             warmup_requests: int = 1) -> LoadReport:
+             warmup_requests: int = 1,
+             reservoir_size: int = DEFAULT_RESERVOIR_SIZE) -> LoadReport:
   """Runs N closed-loop clients; returns the reduced report.
 
   ``features_fn(client_index)`` builds that client's request (so clients
   can send distinct payloads — correctness checks ride the same run).
   Bound the run with EITHER ``requests_per_client`` or ``duration_secs``.
+  Latency storage is a bounded reservoir (``reservoir_size``), so long
+  soaks hold constant memory.
   """
   if (requests_per_client is None) == (duration_secs is None):
     raise ValueError(
         'exactly one of requests_per_client / duration_secs required')
-  latencies: List[List[float]] = [[] for _ in range(num_clients)]
+  latencies = Reservoir(reservoir_size)
   errors = [0] * num_clients
   stop_at: Optional[float] = None
   start_barrier = threading.Barrier(num_clients + 1)
@@ -135,7 +290,7 @@ def run_load(submit: Callable,
       t0 = time.monotonic()
       try:
         submit(features)
-        latencies[index].append(1e3 * (time.monotonic() - t0))
+        latencies.add(1e3 * (time.monotonic() - t0))
       except Exception:  # pylint: disable=broad-except
         errors[index] += 1
       sent += 1
@@ -152,19 +307,231 @@ def run_load(submit: Callable,
     thread.join()
   duration = max(time.monotonic() - t_start, 1e-9)
 
-  flat = sorted(x for per_client in latencies for x in per_client)
-  total_requests = len(flat)
-  total_errors = sum(errors)
+  stats = latencies.summary()
+  total_requests = stats['count']
   return LoadReport(
       clients=num_clients,
       requests=total_requests,
-      errors=total_errors,
+      errors=sum(errors),
       duration_s=duration,
       actions_per_sec=total_requests * examples_per_request / duration,
-      latency_ms_p50=_percentile(flat, 0.50),
-      latency_ms_p99=_percentile(flat, 0.99),
-      latency_ms_mean=(sum(flat) / total_requests) if total_requests else 0.0,
+      latency_ms_p50=stats['p50'],
+      latency_ms_p99=stats['p99'],
+      latency_ms_mean=stats['mean'],
   )
+
+
+# --------------------------------------------------------------- open loop
+
+
+def rate_multiplier(t: float,
+                    duration_secs: float,
+                    burst_factor: float = 1.0,
+                    burst_period_secs: Optional[float] = None,
+                    burst_duty: float = 0.2,
+                    rate_trace: Optional[Sequence[float]] = None) -> float:
+  """The arrival-rate multiplier at offset ``t``.
+
+  ``rate_trace`` is the diurnal mode: a sequence of multipliers spread
+  evenly across the run (e.g. a 24-entry trace models a day's shape in
+  miniature). ``burst_factor`` multiplies the rate during the first
+  ``burst_duty`` fraction of every ``burst_period_secs`` window —
+  composable with the trace.
+  """
+  m = 1.0
+  if rate_trace:
+    index = min(len(rate_trace) - 1,
+                int(t / max(duration_secs, 1e-9) * len(rate_trace)))
+    m *= float(rate_trace[index])
+  if burst_period_secs and burst_factor != 1.0:
+    if (t % burst_period_secs) < burst_duty * burst_period_secs:
+      m *= burst_factor
+  return m
+
+
+def poisson_arrivals(rate_rps: float,
+                     duration_secs: float,
+                     seed: int = 0,
+                     burst_factor: float = 1.0,
+                     burst_period_secs: Optional[float] = None,
+                     burst_duty: float = 0.2,
+                     rate_trace: Optional[Sequence[float]] = None
+                     ) -> List[float]:
+  """Arrival offsets in ``[0, duration_secs)`` from a (time-varying)
+  Poisson process. Deterministic for a given seed."""
+  if rate_rps <= 0:
+    raise ValueError(f'rate_rps must be > 0, got {rate_rps}')
+  rng = random.Random(seed)
+  arrivals: List[float] = []
+  t = 0.0
+  while True:
+    rate = rate_rps * rate_multiplier(
+        t, duration_secs, burst_factor=burst_factor,
+        burst_period_secs=burst_period_secs, burst_duty=burst_duty,
+        rate_trace=rate_trace)
+    if rate <= 0.0:
+      # A zero-rate trace interval: step past it at base-rate
+      # resolution WITHOUT emitting an arrival.
+      t += 1.0 / rate_rps
+      if t >= duration_secs:
+        return arrivals
+      continue
+    t += rng.expovariate(rate)
+    if t >= duration_secs:
+      return arrivals
+    arrivals.append(t)
+
+
+class OpenLoopReport(NamedTuple):
+  """One open-loop run, reduced. Latencies INCLUDE scheduling lag:
+  every sample runs from the request's scheduled Poisson arrival, so
+  overload shows up in the percentiles instead of silently stretching
+  inter-arrival gaps (coordinated omission)."""
+
+  offered_rps: float
+  achieved_rps: float
+  duration_s: float
+  arrivals: int
+  ok: int
+  shed: int
+  errors: int
+  latency_ms_p50: float
+  latency_ms_p99: float
+  latency_ms_mean: float
+  latency_ms_max: float
+  classes: Dict[str, Dict[str, Any]]
+
+  def as_dict(self) -> Dict[str, Any]:
+    return {
+        'offered_rps': round(self.offered_rps, 2),
+        'achieved_rps': round(self.achieved_rps, 2),
+        'duration_s': round(self.duration_s, 3),
+        'arrivals': self.arrivals,
+        'ok': self.ok,
+        'shed': self.shed,
+        'errors': self.errors,
+        'latency_ms_p50': round(self.latency_ms_p50, 2),
+        'latency_ms_p99': round(self.latency_ms_p99, 2),
+        'latency_ms_mean': round(self.latency_ms_mean, 2),
+        'latency_ms_max': round(self.latency_ms_max, 2),
+        'classes': self.classes,
+    }
+
+
+def run_open_loop(submit: Callable,
+                  features_fn: Callable[[int], Dict[str, np.ndarray]],
+                  rate_rps: float,
+                  duration_secs: float,
+                  workers: int = 32,
+                  seed: int = 0,
+                  best_effort_fraction: float = 0.0,
+                  burst_factor: float = 1.0,
+                  burst_period_secs: Optional[float] = None,
+                  burst_duty: float = 0.2,
+                  rate_trace: Optional[Sequence[float]] = None,
+                  reservoir_size: int = DEFAULT_RESERVOIR_SIZE,
+                  warmup_requests: int = 1) -> OpenLoopReport:
+  """Open-loop Poisson load: ``submit(index, features, priority)``.
+
+  Arrivals are scheduled ahead of time from the seeded Poisson process;
+  ``workers`` threads consume them in order, sleeping until each
+  request's scheduled instant (or sending immediately when already
+  late — the lag then lands in that request's latency). ``submit``
+  raising :class:`ShedError` counts as a shed, any other exception as an
+  error. ``best_effort_fraction`` of arrivals carry the
+  ``'best_effort'`` class, the rest ``'interactive'`` — per-class
+  outcome counts and percentiles ride the report.
+  """
+  if not 0.0 <= best_effort_fraction <= 1.0:
+    raise ValueError(f'best_effort_fraction must be in [0, 1], got '
+                     f'{best_effort_fraction!r}')
+  arrivals = poisson_arrivals(
+      rate_rps, duration_secs, seed=seed, burst_factor=burst_factor,
+      burst_period_secs=burst_period_secs, burst_duty=burst_duty,
+      rate_trace=rate_trace)
+  class_rng = random.Random(seed + 1)
+  priorities = ['best_effort' if class_rng.random() < best_effort_fraction
+                else 'interactive' for _ in arrivals]
+  class_names = sorted(set(priorities)) or ['interactive']
+
+  overall = Reservoir(reservoir_size)
+  per_class = {name: Reservoir(reservoir_size, seed=seed + 2)
+               for name in class_names}
+  counts_lock = threading.Lock()
+  counts = {name: {'arrivals': 0, 'ok': 0, 'shed': 0, 'errors': 0}
+            for name in class_names}  # GUARDED_BY(counts_lock)
+  next_index = itertools.count()
+
+  for i in range(warmup_requests):
+    try:
+      submit(i, features_fn(i), 'interactive')
+    except Exception:  # pylint: disable=broad-except
+      pass
+
+  t0 = time.monotonic()
+
+  def worker() -> None:
+    while True:
+      i = next(next_index)
+      if i >= len(arrivals):
+        return
+      scheduled = t0 + arrivals[i]
+      now = time.monotonic()
+      if now < scheduled:
+        time.sleep(scheduled - now)
+      priority = priorities[i]
+      outcome = 'ok'
+      try:
+        submit(i, features_fn(i), priority)
+      except ShedError:
+        outcome = 'shed'
+      except Exception:  # pylint: disable=broad-except
+        outcome = 'errors'
+      latency_ms = 1e3 * (time.monotonic() - scheduled)
+      if outcome == 'ok':
+        overall.add(latency_ms)
+        per_class[priority].add(latency_ms)
+      with counts_lock:
+        counts[priority]['arrivals'] += 1
+        counts[priority][outcome] += 1
+
+  threads = [threading.Thread(target=worker, daemon=True)
+             for _ in range(max(1, int(workers)))]
+  for thread in threads:
+    thread.start()
+  for thread in threads:
+    thread.join()
+  wall = max(time.monotonic() - t0, 1e-9)
+
+  stats = overall.summary()
+  with counts_lock:
+    totals = {k: sum(c[k] for c in counts.values())
+              for k in ('ok', 'shed', 'errors')}
+    classes = {}
+    for name in class_names:
+      cstats = per_class[name].summary()
+      classes[name] = dict(
+          counts[name],
+          latency_ms_p50=round(cstats['p50'], 2),
+          latency_ms_p99=round(cstats['p99'], 2),
+      )
+  return OpenLoopReport(
+      offered_rps=len(arrivals) / max(duration_secs, 1e-9),
+      achieved_rps=totals['ok'] / wall,
+      duration_s=wall,
+      arrivals=len(arrivals),
+      ok=totals['ok'],
+      shed=totals['shed'],
+      errors=totals['errors'],
+      latency_ms_p50=stats['p50'],
+      latency_ms_p99=stats['p99'],
+      latency_ms_mean=stats['mean'],
+      latency_ms_max=stats['max'] if stats['count'] else 0.0,
+      classes=classes,
+  )
+
+
+# ---------------------------------------------------------------- baseline
 
 
 def serial_baseline(predictor,

@@ -7,6 +7,12 @@ no RPC stack. Each connection thread only parses JSON and blocks on a
 work stays on the batcher's single dispatcher thread, so N concurrent
 clients become one padded device dispatch per assembly window.
 
+The server fronts either ONE model (``ServingServer(predictor, ...)``,
+the historical shape) or a whole :class:`~tensor2robot_tpu.serving.
+router.ModelRouter` (``ServingServer(router=router, ...)``) — the
+multi-model/multi-tenant plane with HBM-budgeted paging and priority
+admission.
+
 Endpoints:
 
 * ``POST /v1/predict`` — body ``{"features": {<name>: <nested lists>}}``
@@ -18,14 +24,22 @@ Endpoints:
   one is generated) and echoed back as the same response header on every
   status — the handle that joins a client log line to the plane's
   latency exemplars, slow-request log, and flight-ring trace slice.
-* ``GET /healthz`` — liveness + loaded model version.
-* ``GET /statz`` — the batcher's ``serving`` report (same document the
-  registry's ``/metricsz`` embeds via ``register_report_provider``),
-  including the bounded slow-request log and latency exemplars.
+* ``POST /v1/models/<name>/predict`` — same contract against a named
+  model (router mode; a single-model server only knows its one model).
+* ``X-Priority: interactive|best_effort`` request header — the
+  admission-control class (router mode; default ``interactive``).
+  Best-effort traffic is shed first under queue pressure: 503 with a
+  ``Retry-After`` header.
+* ``GET /healthz`` — liveness + loaded model version(s); the balancer's
+  ejection/readmission signal.
+* ``GET /statz`` — the plane's report (same document the registry's
+  ``/metricsz`` embeds via ``register_report_provider``), including the
+  bounded slow-request log and latency exemplars; router mode nests
+  per-model sections plus paging/admission SLOs.
 
-Status codes: 400 malformed request, 404 unknown path, 503 queue full /
-shutting down (back off and retry), 504 request timed out in the plane,
-500 dispatch failure.
+Status codes: 400 malformed request, 404 unknown path/model, 503 shed /
+queue full / shutting down (back off and honor ``Retry-After``), 504
+request timed out in the plane, 500 dispatch failure.
 """
 
 from __future__ import annotations
@@ -33,6 +47,7 @@ from __future__ import annotations
 import http.server
 import json
 import logging
+import math
 import threading
 from typing import Any, Dict, Optional
 
@@ -40,27 +55,30 @@ import numpy as np
 
 from tensor2robot_tpu.serving import batching as batching_lib
 
+_MODELS_PREFIX = '/v1/models/'
+_PREDICT_SUFFIX = '/predict'
+
 
 class _Handler(http.server.BaseHTTPRequestHandler):
-  """Thin JSON adapter over the batcher; never touches the device."""
+  """Thin JSON adapter over the batcher/router; never touches the device."""
 
   protocol_version = 'HTTP/1.1'  # keep-alive: clients reuse connections
 
   def log_message(self, format, *args):  # noqa: A002 - stdlib signature
     del format, args  # a load test would spam one line per request
 
-  @property
-  def _batcher(self) -> batching_lib.DynamicBatcher:
-    return self.server.batcher  # type: ignore[attr-defined]
-
   def _reply(self, code: int, payload: Dict[str, Any],
-             request_id: Optional[str] = None) -> None:
+             request_id: Optional[str] = None,
+             retry_after_secs: Optional[float] = None) -> None:
     body = json.dumps(payload).encode()
     self.send_response(code)
     self.send_header('Content-Type', 'application/json')
     self.send_header('Content-Length', str(len(body)))
     if request_id:
       self.send_header('X-Request-Id', request_id)
+    if retry_after_secs is not None:
+      self.send_header('Retry-After',
+                       str(max(1, int(math.ceil(retry_after_secs)))))
     self.end_headers()
     try:
       self.wfile.write(body)
@@ -69,14 +87,35 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
   def do_GET(self):  # noqa: N802 - stdlib naming
     path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    router = self.server.router  # type: ignore[attr-defined]
+    batcher = self.server.batcher  # type: ignore[attr-defined]
     if path == '/healthz':
-      self._reply(200, {'status': 'ok',
-                        'model_version': self._batcher.model_version})
+      if router is not None:
+        versions = router.versions()
+        self._reply(200, {'status': 'ok', 'models': versions,
+                          'model_version': versions.get(
+                              router.default_model, -1)})
+      else:
+        self._reply(200, {'status': 'ok',
+                          'model_version': batcher.model_version})
     elif path == '/statz':
-      self._reply(200, self._batcher.report())
+      plane = router if router is not None else batcher
+      self._reply(200, plane.report())
     else:
       self._reply(404, {'error': f'unknown path {path!r}',
-                        'endpoints': ['/v1/predict', '/healthz', '/statz']})
+                        'endpoints': ['/v1/predict',
+                                      '/v1/models/<name>/predict',
+                                      '/healthz', '/statz']})
+
+  def _route(self, path: str) -> Optional[str]:
+    """Predict path → model name ('' = default) or None (not predict)."""
+    if path == '/v1/predict':
+      return ''
+    if path.startswith(_MODELS_PREFIX) and path.endswith(_PREDICT_SUFFIX):
+      name = path[len(_MODELS_PREFIX):-len(_PREDICT_SUFFIX)]
+      if name and '/' not in name:
+        return name
+    return None
 
   def do_POST(self):  # noqa: N802 - stdlib naming
     path = self.path.split('?', 1)[0].rstrip('/')
@@ -84,10 +123,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     # trace convention) or let the batcher mint one; either way it is
     # echoed on EVERY reply below so the client can quote it.
     request_id = (self.headers.get('X-Request-Id') or '').strip() or None
-    if path != '/v1/predict':
+    model = self._route(path)
+    if model is None:
       self._reply(404, {'error': f'unknown path {path!r}'},
                   request_id=request_id)
       return
+    priority = (self.headers.get('X-Priority') or '').strip() or None
     try:
       length = int(self.headers.get('Content-Length', 0))
       payload = json.loads(self.rfile.read(length) or b'{}')
@@ -99,10 +140,33 @@ class _Handler(http.server.BaseHTTPRequestHandler):
       self._reply(400, {'error': f'malformed request: {e}'},
                   request_id=request_id)
       return
+    router = self.server.router  # type: ignore[attr-defined]
     try:
-      future = self._batcher.submit(features, request_id=request_id)
+      if router is not None:
+        future = router.submit(
+            features, model=model or None,
+            priority=priority or 'interactive', request_id=request_id)
+      else:
+        if model or (priority not in (None, 'interactive')):
+          # A single-model plane has no router: a named model or a
+          # non-default priority class is a contract the caller holds
+          # that this server cannot honor — fail loudly, don't ignore.
+          self._reply(
+              404 if model else 400,
+              {'error': 'this server fronts a single model with no '
+                        'admission classes (no router configured)'},
+              request_id=request_id)
+          return
+        future = self.server.batcher.submit(  # type: ignore[attr-defined]
+            features, request_id=request_id)
+    except batching_lib.SheddedError as e:
+      self._reply(503, {'error': str(e), 'shed': True},
+                  request_id=request_id,
+                  retry_after_secs=e.retry_after_secs)
+      return
     except batching_lib.OverloadedError as e:
-      self._reply(503, {'error': str(e)}, request_id=request_id)
+      self._reply(503, {'error': str(e)}, request_id=request_id,
+                  retry_after_secs=1.0)
       return
     except batching_lib.RequestError as e:
       self._reply(400, {'error': str(e)}, request_id=request_id)
@@ -127,7 +191,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 class ServingServer:
-  """Batcher + HTTP server lifecycle as one unit.
+  """Batcher/router + HTTP server lifecycle as one unit.
 
   ``port=0`` binds an ephemeral port (read ``.port``/``.url`` after
   :meth:`start`); the bind is loopback by default — serving beyond the
@@ -135,22 +199,30 @@ class ServingServer:
   the listener stops, queued requests drain, the last response leaves
   before threads die.
 
-  Batcher knobs (``max_batch``, ``batch_deadline_ms``, ``max_queue``,
+  Single-model: ``ServingServer(predictor, **batcher_kwargs)`` (knobs:
+  ``max_batch``, ``batch_deadline_ms``, ``max_queue``,
   ``reload_interval_secs``, ``quantize='int8'``/``'fp8'`` + its
   ``quant_parity_*`` band — see :class:`~tensor2robot_tpu.serving.
-  batching.DynamicBatcher`) pass through ``**batcher_kwargs``; the
-  ``/statz`` report includes the quantization block (mode, active,
-  ``param_bytes``, parity errors, byte ratio).
+  batching.DynamicBatcher`). Multi-model: ``ServingServer(router=
+  ModelRouter(...))`` — the router owns its batchers; batcher kwargs are
+  rejected here (configure them on the router).
   """
 
   def __init__(self,
-               predictor,
+               predictor=None,
                port: int = 0,
                host: str = '127.0.0.1',
                request_timeout_secs: float = 30.0,
                compilation_cache_dir: Optional[str] = None,
                timeseries_interval_secs: float = 10.0,
+               router=None,
                **batcher_kwargs):
+    if (predictor is None) == (router is None):
+      raise ValueError('pass exactly one of predictor= or router=')
+    if router is not None and batcher_kwargs:
+      raise ValueError(
+          f'batcher kwargs {sorted(batcher_kwargs)} are configured on the '
+          'ModelRouter, not the server, in router mode')
     # Persistent compile cache first: bucket warmup is the serving
     # plane's restart cost, and a cache hit turns each bucket compile
     # into a deserialize (utils/compilation_cache.py).
@@ -163,15 +235,22 @@ class ServingServer:
     from tensor2robot_tpu.observability import timeseries
 
     timeseries.maybe_start(timeseries_interval_secs or None)
-    self._batcher = batching_lib.DynamicBatcher(predictor, **batcher_kwargs)
+    self._router = router
+    self._batcher = (None if router is not None else
+                     batching_lib.DynamicBatcher(predictor,
+                                                 **batcher_kwargs))
     self._requested = (host, int(port))
     self._request_timeout_secs = request_timeout_secs
     self._httpd: Optional[http.server.ThreadingHTTPServer] = None
     self._thread: Optional[threading.Thread] = None
 
   @property
-  def batcher(self) -> batching_lib.DynamicBatcher:
+  def batcher(self) -> Optional[batching_lib.DynamicBatcher]:
     return self._batcher
+
+  @property
+  def router(self):
+    return self._router
 
   @property
   def port(self) -> Optional[int]:
@@ -187,20 +266,28 @@ class ServingServer:
   def start(self) -> 'ServingServer':
     if self._httpd is not None:
       return self
-    self._batcher.start()
+    if self._router is not None:
+      self._router.start()
+    else:
+      self._batcher.start()
     self._httpd = http.server.ThreadingHTTPServer(self._requested, _Handler)
     self._httpd.daemon_threads = True
     self._httpd.batcher = self._batcher  # type: ignore[attr-defined]
+    self._httpd.router = self._router  # type: ignore[attr-defined]
     self._httpd.request_timeout_secs = (  # type: ignore[attr-defined]
         self._request_timeout_secs)
     self._thread = threading.Thread(
         target=self._httpd.serve_forever, kwargs={'poll_interval': 0.2},
         daemon=True, name='t2r-serving-http')
     self._thread.start()
-    logging.info(
-        'Serving plane listening at %s (max_batch=%d, deadline=%.1fms, '
-        'buckets=%s)', self.url, self._batcher._max_batch,  # pylint: disable=protected-access
-        self._batcher._deadline_s * 1e3, list(self._batcher.buckets))  # pylint: disable=protected-access
+    if self._router is not None:
+      logging.info('Serving plane listening at %s (models=%s)',
+                   self.url, self._router.models())
+    else:
+      logging.info(
+          'Serving plane listening at %s (max_batch=%d, deadline=%.1fms, '
+          'buckets=%s)', self.url, self._batcher._max_batch,  # pylint: disable=protected-access
+          self._batcher._deadline_s * 1e3, list(self._batcher.buckets))  # pylint: disable=protected-access
     return self
 
   def close(self) -> None:
@@ -211,7 +298,10 @@ class ServingServer:
         self._thread.join(timeout=10.0)
       self._httpd = None
       self._thread = None
-    self._batcher.close()
+    if self._router is not None:
+      self._router.close()
+    else:
+      self._batcher.close()
 
   def __enter__(self) -> 'ServingServer':
     return self.start()

@@ -1,0 +1,620 @@
+"""Serving-at-scale tests: multi-model router (HBM-budgeted LRU paging,
+priority-class admission), the front-door balancer (least-outstanding
+pick, health ejection/readmission, X-Request-Id propagation), and the
+open-loop load generator (Poisson arrivals, bounded reservoirs,
+scheduling-lag-honest latency).
+
+Ends with the tier-1 acceptance drill: 3 models × 2 replicas surviving a
+zero-downtime rolling deploy under sustained mixed-priority open-loop
+load — zero dropped interactive requests, best-effort visibly shed, and
+LRU paging under an HBM budget that fits only 2 of 3 models with the
+bucket-compile counter flat across page-in.
+
+Marker: ``router`` (tier-1; ``tools/run_tier1.sh -m router`` selects).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import export as export_lib
+from tensor2robot_tpu import quantize as quant_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.predictors import (AbstractPredictor,
+                                         CheckpointPredictor,
+                                         ExportedModelPredictor)
+from tensor2robot_tpu.serving import balancer as balancer_lib
+from tensor2robot_tpu.serving import batching as batching_lib
+from tensor2robot_tpu.serving import loadgen
+from tensor2robot_tpu.serving import router as router_lib
+from tensor2robot_tpu.serving import server as server_lib
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.train import Trainer, TrainerConfig
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+pytestmark = pytest.mark.router
+
+
+def _loaded_predictor(hidden_size: int = 16):
+  predictor = CheckpointPredictor(
+      MockT2RModel(device_type='tpu', hidden_size=hidden_size),
+      model_dir='/nonexistent')
+  predictor.init_randomly()
+  return predictor
+
+
+def _features(value: float, n: int = 1):
+  return {'measured_position': np.full((n, 2), value, np.float32)}
+
+
+class _GatedPredictor(AbstractPredictor):
+  """Callable predictor whose dispatch blocks on an event — the
+  deterministic way to hold a backlog in the queue."""
+
+  def __init__(self, release: threading.Event):
+    self._release = release
+
+  def predict(self, features):
+    self._release.wait(timeout=30.0)
+    return {'echo': np.asarray(features['x'])}
+
+  def get_feature_specification(self):
+    spec = SpecStruct()
+    spec['x'] = TensorSpec(shape=(2,), dtype=np.float32, name='x')
+    return spec
+
+  def restore(self):
+    return True
+
+  @property
+  def is_loaded(self):
+    return True
+
+  @property
+  def global_step(self):
+    return 1
+
+
+# ------------------------------------------------------------ loadgen units
+
+
+class TestReservoir:
+
+  def test_bounded_and_exact_below_capacity(self):
+    r = loadgen.Reservoir(capacity=8)
+    for v in [5.0, 1.0, 9.0, 3.0]:
+      r.add(v)
+    s = r.summary()
+    assert s['count'] == 4 and s['min'] == 1.0 and s['max'] == 9.0
+    assert s['mean'] == pytest.approx(4.5)
+    assert s['p50'] == 3.0 or s['p50'] == 5.0
+
+  def test_storage_stays_bounded_over_long_streams(self):
+    r = loadgen.Reservoir(capacity=64, seed=3)
+    for v in range(100_000):
+      r.add(float(v))
+    assert len(r._samples) == 64  # the satellite contract: no growth
+    s = r.summary()
+    assert s['count'] == 100_000
+    assert s['min'] == 0.0 and s['max'] == 99_999.0  # extremes exact
+    # The sampled p50 of a uniform ramp lands near the middle.
+    assert 20_000 < s['p50'] < 80_000
+
+
+class TestPoissonArrivals:
+
+  def test_deterministic_and_rate_shaped(self):
+    a1 = loadgen.poisson_arrivals(100.0, 2.0, seed=7)
+    a2 = loadgen.poisson_arrivals(100.0, 2.0, seed=7)
+    assert a1 == a2
+    assert a1 == sorted(a1)
+    assert all(0.0 <= t < 2.0 for t in a1)
+    # ~200 expected; Poisson sd ~14 — a generous band, seeded anyway.
+    assert 140 < len(a1) < 270
+
+  def test_burst_multiplier_raises_arrival_count(self):
+    base = loadgen.poisson_arrivals(50.0, 2.0, seed=1)
+    burst = loadgen.poisson_arrivals(
+        50.0, 2.0, seed=1, burst_factor=4.0, burst_period_secs=0.5,
+        burst_duty=0.5)
+    # Half of every window at 4x => ~2.5x the arrivals.
+    assert len(burst) > 1.5 * len(base)
+
+  def test_diurnal_trace_shapes_the_run(self):
+    # Quiet first half, busy second half.
+    arrivals = loadgen.poisson_arrivals(
+        100.0, 2.0, seed=2, rate_trace=[0.1, 2.0])
+    first = sum(1 for t in arrivals if t < 1.0)
+    second = len(arrivals) - first
+    assert second > 4 * max(first, 1)
+
+  def test_zero_rate_trace_interval_produces_no_arrivals(self):
+    arrivals = loadgen.poisson_arrivals(
+        100.0, 2.0, seed=2, rate_trace=[0.0, 1.0])
+    assert arrivals  # the busy half still fires
+    assert all(t >= 1.0 for t in arrivals)
+
+
+def test_open_loop_latency_includes_scheduling_lag():
+  """One worker + a 20 ms service at 10x its capacity: a closed-loop
+  client would report ~20 ms forever; the open-loop report must show
+  the queueing delay the offered rate actually causes."""
+
+  def submit(index, features, priority):
+    del index, features, priority
+    time.sleep(0.02)
+    return {}
+
+  report = loadgen.run_open_loop(
+      submit, lambda i: {}, rate_rps=200.0, duration_secs=0.4,
+      workers=1, seed=5, warmup_requests=0)
+  assert report.arrivals > 30
+  assert report.errors == 0 and report.shed == 0
+  # Service is 20 ms; the p99 must carry the backlog, not the service.
+  assert report.latency_ms_p99 > 100.0
+  assert report.latency_ms_p50 > report.latency_ms_mean / 10  # sanity
+
+
+def test_open_loop_counts_sheds_separately_from_errors():
+  calls = []
+
+  def submit(index, features, priority):
+    calls.append(priority)
+    if priority == 'best_effort':
+      raise loadgen.ShedError('shed')
+    return {}
+
+  report = loadgen.run_open_loop(
+      submit, lambda i: {}, rate_rps=300.0, duration_secs=0.3,
+      workers=4, seed=9, best_effort_fraction=0.5, warmup_requests=0)
+  assert report.shed > 0 and report.errors == 0
+  assert report.classes['best_effort']['shed'] == report.shed
+  assert report.classes['interactive']['ok'] == report.ok
+  assert report.ok + report.shed == report.arrivals
+
+
+# ----------------------------------------------------------------- routing
+
+
+class TestModelRouter:
+
+  def test_routes_to_named_models_and_default(self):
+    # Distinct widths: genuinely different models, so routing (and its
+    # per-model bucket executables) is observable in the outputs.
+    preds = {'alpha': _loaded_predictor(hidden_size=16),
+             'beta': _loaded_predictor(hidden_size=32)}
+    with router_lib.ModelRouter(
+        preds, max_batch=8, batch_deadline_ms=1.0,
+        register_report=False) as router:
+      out_a = router.submit(_features(0.2), model='alpha').result(30.0)
+      out_b = router.submit(_features(0.2), model='beta').result(30.0)
+      want_a = preds['alpha'].predict(_features(0.2))
+      want_b = preds['beta'].predict(_features(0.2))
+      np.testing.assert_allclose(out_a['a_predicted'],
+                                 want_a['a_predicted'], rtol=2e-5)
+      np.testing.assert_allclose(out_b['a_predicted'],
+                                 want_b['a_predicted'], rtol=2e-5)
+      # Independently initialized models: routing is observable.
+      assert not np.allclose(out_a['a_predicted'], out_b['a_predicted'])
+      # Default model is the first by construction order.
+      default = router.submit(_features(0.2)).result(30.0)
+      np.testing.assert_array_equal(default['a_predicted'],
+                                    out_a['a_predicted'])
+      with pytest.raises(batching_lib.RequestError):
+        router.submit(_features(0.2), model='nope')
+      with pytest.raises(batching_lib.RequestError):
+        router.submit(_features(0.2), priority='platinum')
+      assert router.versions() == {'alpha': 0, 'beta': 0}
+
+  def test_per_model_metric_scopes(self):
+    with router_lib.ModelRouter(
+        {'m0': _loaded_predictor(), 'm1': _loaded_predictor()},
+        max_batch=4, batch_deadline_ms=1.0,
+        register_report=False) as router:
+      before = metrics_lib.counter('serving/model/m1/requests').value
+      router.submit(_features(0.3), model='m1').result(30.0)
+      assert metrics_lib.counter(
+          'serving/model/m1/requests').value == before + 1
+      report = router.report()
+      assert set(report['models']) == {'m0', 'm1'}
+      assert report['models']['m1']['requests'] >= 1
+
+  def test_admission_sheds_best_effort_before_interactive(self):
+    release = threading.Event()
+    shed = metrics_lib.counter('serving/shed_requests')
+    shed0 = shed.value
+    batcher = None
+    try:
+      with router_lib.ModelRouter(
+          {'m': _GatedPredictor(release)}, max_batch=1,
+          batch_deadline_ms=1.0, max_queue=10,
+          shed_queue_fraction=0.2,  # shed_at = 2
+          retry_after_secs=3.0, register_report=False) as router:
+        assert router.shed_at == 2
+        batcher = router.batcher('m')
+        feats = {'x': np.zeros((1, 2), np.float32)}
+        futures = [router.submit(feats) for _ in range(4)]
+        deadline = time.monotonic() + 10.0
+        while batcher.queue_depth < 2 and time.monotonic() < deadline:
+          time.sleep(0.01)  # first request in flight, backlog queued
+        assert batcher.queue_depth >= 2
+        with pytest.raises(batching_lib.SheddedError) as excinfo:
+          router.submit(feats, priority='best_effort')
+        assert excinfo.value.retry_after_secs == 3.0
+        assert shed.value == shed0 + 1
+        # Interactive is NOT shed by policy — only the hard queue bound.
+        futures.append(router.submit(feats))
+        release.set()
+        for future in futures:
+          future.result(30.0)
+        report = router.report()
+        assert report['shed_requests'] >= 1
+        assert report['classes']['best_effort']['shed'] >= 1
+        assert report['classes']['interactive']['shed'] == 0
+        assert report['classes']['interactive']['ok'] >= 5
+    finally:
+      release.set()
+
+  def test_lru_paging_under_hbm_budget(self):
+    preds = {f'm{i}': _loaded_predictor() for i in range(3)}
+    per_model = quant_lib.param_bytes(
+        preds['m0'].stateless_serving_fn().params)
+    compiles = metrics_lib.counter('serving/bucket_compiles')
+    page_ins = metrics_lib.counter('serving/page_ins')
+    pi0 = page_ins.value
+    with router_lib.ModelRouter(
+        preds, hbm_budget_bytes=2 * per_model + per_model // 2,
+        max_batch=8, batch_deadline_ms=1.0,
+        register_report=False) as router:
+      # The budget fits 2 of 3: one model paged out right after start.
+      assert len(router.resident_models()) == 2
+      warm = compiles.value
+      for i in range(12):
+        out = router.submit(_features(0.1 * i, n=1 + i % 3),
+                            model=f'm{i % 3}').result(30.0)
+        assert out['a_predicted'].shape == (1 + i % 3,)
+      # Cycling 3 models through 2 slots forced page-ins…
+      assert page_ins.value > pi0
+      # …while the executables were REUSED: page-in is a device_put,
+      # never a recompile (the acceptance pin).
+      assert compiles.value == warm
+      assert len(router.resident_models()) == 2
+      report = router.report()
+      assert report['hbm_budget_bytes'] == 2 * per_model + per_model // 2
+      assert report['page_ins'] > 0 and report['page_outs'] > 0
+      for i in range(3):  # correctness after all that paging
+        got = router.submit(_features(0.5), model=f'm{i}').result(30.0)
+        want = preds[f'm{i}'].predict(_features(0.5))
+        np.testing.assert_allclose(got['a_predicted'],
+                                   want['a_predicted'], rtol=2e-5)
+
+  def test_no_budget_keeps_all_models_resident(self):
+    with router_lib.ModelRouter(
+        {f'm{i}': _loaded_predictor() for i in range(3)},
+        max_batch=4, batch_deadline_ms=1.0,
+        register_report=False) as router:
+      for i in range(6):
+        router.submit(_features(0.1), model=f'm{i % 3}').result(30.0)
+      assert len(router.resident_models()) == 3
+
+
+# ------------------------------------------------------------- HTTP routing
+
+
+def _post(url, path, payload, headers=None):
+  req = urllib.request.Request(
+      url + path, data=json.dumps(payload).encode(),
+      headers=dict({'Content-Type': 'application/json'}, **(headers or {})))
+  try:
+    with urllib.request.urlopen(req, timeout=30) as r:
+      return r.status, json.loads(r.read()), dict(r.headers)
+  except urllib.error.HTTPError as e:
+    return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_http_routes_models_and_priorities():
+  router = router_lib.ModelRouter(
+      {'a': _loaded_predictor(), 'b': _loaded_predictor()},
+      max_batch=8, batch_deadline_ms=1.0, register_report=False)
+  with server_lib.ServingServer(router=router) as server:
+    url = server.url
+    status, body, headers = _post(
+        url, '/v1/models/b/predict',
+        {'features': {'measured_position': [[0.1, 0.2]]}},
+        headers={'X-Request-Id': 'drill-42', 'X-Priority': 'interactive'})
+    assert status == 200 and body['request_id'] == 'drill-42'
+    assert headers.get('X-Request-Id') == 'drill-42'
+    status, body, _ = _post(url, '/v1/models/nope/predict',
+                            {'measured_position': [0.1, 0.2]})
+    assert status == 400 and 'unknown model' in body['error']
+    status, body, _ = _post(url, '/v1/predict',
+                            {'measured_position': [0.1, 0.2]},
+                            headers={'X-Priority': 'platinum'})
+    assert status == 400 and 'priority' in body['error']
+    with urllib.request.urlopen(url + '/healthz', timeout=30) as r:
+      health = json.loads(r.read())
+    assert health['status'] == 'ok'
+    assert health['models'] == {'a': 0, 'b': 0}
+    with urllib.request.urlopen(url + '/statz', timeout=30) as r:
+      statz = json.loads(r.read())
+    assert set(statz['models']) == {'a', 'b'}
+    assert 'classes' in statz and 'page_ins' in statz
+
+
+# ---------------------------------------------------------------- balancer
+
+
+class TestBalancer:
+
+  def test_least_outstanding_spreads_and_echoes_request_id(self):
+    s1 = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+        metrics_prefix='serving/bal_r0', register_report=False).start()
+    s2 = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+        metrics_prefix='serving/bal_r1', register_report=False).start()
+    try:
+      with balancer_lib.Balancer(
+          [('127.0.0.1', s1.port), ('127.0.0.1', s2.port)],
+          register_report=False) as bal:
+        url = bal.url
+        # X-Request-Id survives the hop on success AND on error paths.
+        status, body, headers = _post(
+            url, '/v1/predict',
+            {'features': {'measured_position': [[0.1, 0.2]]}},
+            headers={'X-Request-Id': 'fleet-7'})
+        assert status == 200
+        assert headers.get('X-Request-Id') == 'fleet-7'
+        assert body['request_id'] == 'fleet-7'
+        status, _, headers = _post(url, '/v1/bogus', {},
+                                   headers={'X-Request-Id': 'fleet-8'})
+        assert status == 404 and headers.get('X-Request-Id') == 'fleet-8'
+        # No client id: the balancer mints one and still echoes it.
+        status, body, headers = _post(
+            url, '/v1/predict', {'measured_position': [0.1, 0.2]})
+        assert status == 200
+        assert headers.get('X-Request-Id', '').startswith('lb')
+        assert body['request_id'] == headers['X-Request-Id']
+        # Traffic reaches BOTH replicas (least-outstanding, tie by index
+        # round-robins through the release/pick cycle under load).
+        report = loadgen.run_load(
+            loadgen.http_submit_fn('127.0.0.1', bal.port),
+            lambda i: _features(0.01 * (i + 1)),
+            num_clients=8, requests_per_client=10)
+        assert report.errors == 0
+        statz = bal.report()
+        assert statz['backends_healthy'] == 2
+        assert all(b['proxied'] > 0 for b in statz['backends'])
+    finally:
+      s1.close()
+      s2.close()
+
+  def test_ejection_failover_and_readmission(self):
+    s1 = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+        metrics_prefix='serving/ej_r0', register_report=False).start()
+    s2 = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+        metrics_prefix='serving/ej_r1', register_report=False).start()
+    port2 = s2.port
+    with balancer_lib.Balancer(
+        [('127.0.0.1', s1.port), ('127.0.0.1', port2)],
+        health_interval_secs=0.1, eject_after=2, readmit_after=1,
+        register_report=False) as bal:
+      submit = loadgen.http_submit_fn('127.0.0.1', bal.port)
+      submit(_features(0.1))
+      s2.close()  # replica goes down mid-fleet
+      # Every request keeps succeeding: transport failures fail over.
+      for i in range(20):
+        submit(_features(0.01 * (i + 1)))
+      deadline = time.monotonic() + 10.0
+      while (bal.healthy_backend_count() > 1 and
+             time.monotonic() < deadline):
+        time.sleep(0.05)
+      assert bal.healthy_backend_count() == 1  # ejected
+      assert metrics_lib.counter('balancer/ejections').value >= 1
+      # Restart on the same port → health probes re-admit it.
+      s2b = server_lib.ServingServer(
+          _loaded_predictor(), port=port2, max_batch=8,
+          batch_deadline_ms=1.0, metrics_prefix='serving/ej_r2',
+          register_report=False).start()
+      try:
+        assert balancer_lib.wait_healthy(bal, 2, timeout_secs=10.0)
+        assert metrics_lib.counter('balancer/readmissions').value >= 1
+        for i in range(8):
+          submit(_features(0.01 * (i + 1)))
+      finally:
+        s2b.close()
+    s1.close()
+
+  def test_initial_health_is_probed_not_assumed(self):
+    """A balancer started before its replicas exist must report 0
+    healthy backends (evidence from the synchronous start-up probe
+    round), then admit the replica once it actually listens — the
+    fleet-bring-up race the verify drive hit."""
+    placeholder = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=4, batch_deadline_ms=1.0,
+        metrics_prefix='serving/boot_r0', register_report=False).start()
+    port = placeholder.port
+    placeholder.close()  # nothing listens on `port` now
+    with balancer_lib.Balancer(
+        [('127.0.0.1', port)], health_interval_secs=0.1,
+        readmit_after=1, register_report=False) as bal:
+      assert bal.healthy_backend_count() == 0  # truthful from the start
+      replica = server_lib.ServingServer(
+          _loaded_predictor(), port=port, max_batch=4,
+          batch_deadline_ms=1.0, metrics_prefix='serving/boot_r1',
+          register_report=False).start()
+      try:
+        assert balancer_lib.wait_healthy(bal, 1, timeout_secs=10.0)
+        loadgen.http_submit_fn('127.0.0.1', bal.port)(_features(0.2))
+      finally:
+        replica.close()
+
+  def test_all_backends_down_is_503_with_retry_after(self):
+    s1 = server_lib.ServingServer(
+        _loaded_predictor(), max_batch=4, batch_deadline_ms=1.0,
+        metrics_prefix='serving/down_r0', register_report=False).start()
+    port = s1.port
+    with balancer_lib.Balancer(
+        [('127.0.0.1', port)], health_interval_secs=0.1,
+        eject_after=1, register_report=False) as bal:
+      s1.close()
+      deadline = time.monotonic() + 10.0
+      while bal.healthy_backend_count() and time.monotonic() < deadline:
+        time.sleep(0.05)
+      status, body, headers = _post(
+          bal.url, '/v1/predict', {'measured_position': [0.1, 0.2]},
+          headers={'X-Request-Id': 'doomed-1'})
+      assert status == 503
+      assert headers.get('Retry-After')
+      assert headers.get('X-Request-Id') == 'doomed-1'
+      assert 'error' in body
+
+
+# ----------------------------------------------- the tier-1 acceptance drill
+
+
+def test_fleet_rolling_deploy_drill(tmp_path):
+  """3 models × 2 replicas behind the balancer survive a zero-downtime
+  rolling deploy under sustained mixed-priority open-loop load:
+
+  * ZERO dropped interactive requests (errors AND sheds both zero) —
+    across a hot-swap deploy of all three models and a full replica
+    restart;
+  * best-effort traffic visibly shed (``serving/shed_requests`` > 0);
+  * an HBM budget fitting 2 of 3 models forces LRU paging while the
+    bucket-compile counter stays flat (executables reused across both
+    page-ins and the weights-only deploy).
+  """
+  model = MockT2RModel(device_type='tpu')
+  config = TrainerConfig(
+      model_dir=str(tmp_path / 'train'), max_train_steps=5,
+      save_interval_steps=5, eval_interval_steps=0, log_interval_steps=0,
+      async_checkpoints=False)
+  trainer = Trainer(model, config)
+  gen = MockInputGenerator(batch_size=8)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
+  exporter = export_lib.ModelExporter()
+  roots = {name: str(tmp_path / f'export_{name}')
+           for name in ('m0', 'm1', 'm2')}
+  for root in roots.values():
+    exporter.export(model, trainer.state, root, version=1)
+
+  def make_router():
+    preds = {}
+    for name, root in roots.items():
+      predictor = ExportedModelPredictor(root)
+      assert predictor.restore()
+      preds[name] = predictor
+    per_model = quant_lib.param_bytes(
+        preds['m0'].stateless_serving_fn().params)
+    # max_batch=2 + a 5 ms assembly window: a block of same-model
+    # arrivals (see model_fn below) reliably leaves a backlog behind
+    # the assembling batch, which is what admission control keys on.
+    return router_lib.ModelRouter(
+        preds, hbm_budget_bytes=2 * per_model + per_model // 2,
+        shed_queue_fraction=0.01,  # shed_at = 1: shed on ANY backlog
+        max_batch=2, batch_deadline_ms=5.0, max_queue=256,
+        reload_interval_secs=0.2, register_report=False)
+
+  shed_counter = metrics_lib.counter('serving/shed_requests')
+  compiles = metrics_lib.counter('serving/bucket_compiles')
+  page_ins = metrics_lib.counter('serving/page_ins')
+  shed0, pages0 = shed_counter.value, page_ins.value
+
+  replica_a = server_lib.ServingServer(router=make_router()).start()
+  replica_b = server_lib.ServingServer(router=make_router()).start()
+  port_b = replica_b.port
+  warm_compiles = compiles.value
+
+  def model_fn(index):
+    # Blocks of 8 consecutive arrivals per model: burst traffic piles
+    # onto ONE batcher at a time (forcing visible backlog → shedding)
+    # while still cycling all three models (forcing LRU paging).
+    return f'm{(index // 8) % 3}'
+
+  try:
+    with balancer_lib.Balancer(
+        [('127.0.0.1', replica_a.port), ('127.0.0.1', port_b)],
+        health_interval_secs=0.1, eject_after=2, readmit_after=1,
+        register_report=False) as bal:
+      submit = loadgen.http_open_submit_fn(
+          '127.0.0.1', bal.port, model_fn=model_fn)
+      result = {}
+
+      def load_phase(key, duration):
+        result[key] = loadgen.run_open_loop(
+            submit, lambda i: _features(0.01 * (i % 7 + 1)),
+            rate_rps=200.0, duration_secs=duration, workers=24,
+            seed=11, best_effort_fraction=0.5, burst_factor=4.0,
+            burst_period_secs=0.5, burst_duty=0.3)
+
+      # Phase 1: sustained mixed load while ALL THREE models deploy v2
+      # (the rolling deploy IS the commit-marker hot-swap path).
+      thread = threading.Thread(target=load_phase, args=('deploy', 5.0),
+                                daemon=True)
+      thread.start()
+      time.sleep(0.8)  # traffic flowing against v1
+      for root in roots.values():
+        exporter.export(
+            model, trainer.state.replace(step=trainer.state.step + 100),
+            root, version=2)
+        time.sleep(0.3)  # staggered: a ROLLING deploy, not a flag day
+      deadline = time.monotonic() + 20.0
+      want = {'m0': 105, 'm1': 105, 'm2': 105}
+      while time.monotonic() < deadline:
+        if (replica_a.router.versions() == want and
+            replica_b.router.versions() == want):
+          break
+        time.sleep(0.1)
+      assert replica_a.router.versions() == want  # deployed under load
+      assert replica_b.router.versions() == want
+      thread.join(timeout=60.0)
+      assert not thread.is_alive()
+      deploy = result['deploy']
+
+      # Zero dropped interactive requests through the deploy…
+      interactive = deploy.classes['interactive']
+      assert interactive['errors'] == 0, deploy.as_dict()
+      assert interactive['shed'] == 0, deploy.as_dict()
+      assert interactive['ok'] == interactive['arrivals']
+      # …while best-effort was visibly shed (the acceptance counter; a
+      # CLIENT-visible shed additionally needs every replica to shed the
+      # same request — common under the bursts, but not asserted).
+      assert shed_counter.value > shed0, deploy.as_dict()
+      # …and the 3-over-2 HBM budget paged models with ZERO recompiles
+      # (page-in = device_put; deploy = weights-only executable reuse).
+      assert page_ins.value > pages0
+      assert compiles.value == warm_compiles
+      assert len(replica_a.router.resident_models()) == 2
+      assert len(replica_b.router.resident_models()) == 2
+
+      # Phase 2: restart replica B entirely (process-level roll). The
+      # balancer ejects it on failure evidence, fails traffic over, and
+      # re-admits the reborn replica — still zero interactive drops.
+      thread = threading.Thread(target=load_phase, args=('restart', 3.0),
+                                daemon=True)
+      thread.start()
+      time.sleep(0.5)
+      replica_b.close()
+      replica_b = server_lib.ServingServer(
+          router=make_router(), port=port_b).start()
+      assert balancer_lib.wait_healthy(bal, 2, timeout_secs=15.0)
+      thread.join(timeout=60.0)
+      assert not thread.is_alive()
+      restart = result['restart']
+      interactive = restart.classes['interactive']
+      assert interactive['errors'] == 0, restart.as_dict()
+      assert interactive['shed'] == 0, restart.as_dict()
+      assert restart.ok > 0
+  finally:
+    replica_a.close()
+    replica_b.close()

@@ -313,6 +313,33 @@ class TestHotSwap:
       assert out['a_predicted'].shape == (1,)
 
 
+def test_idle_plane_adopts_staged_swap_without_traffic(tmp_path):
+  """A rolling deploy must land on an IDLE replica too: the staged
+  generation is adopted by the dispatcher without waiting for the next
+  request, so model_version / healthz advertise the new version
+  (found by the fleet verify drive: an idle replica kept reporting the
+  old version until traffic arrived)."""
+  trainer, model = _trained_trainer(tmp_path)
+  root = str(tmp_path / 'export')
+  exporter = export_lib.ModelExporter()
+  exporter.export(model, trainer.state, root, version=1)
+  predictor = ExportedModelPredictor(root)
+  assert predictor.restore()
+  with batching_lib.DynamicBatcher(
+      predictor, max_batch=4, batch_deadline_ms=1.0,
+      reload_interval_secs=0.05) as batcher:
+    assert batcher.model_version == 5
+    exporter.export(
+        model, trainer.state.replace(step=trainer.state.step + 100),
+        root, version=2)
+    deadline = time.time() + 20.0
+    while batcher.model_version != 105 and time.time() < deadline:
+      time.sleep(0.05)  # NO submits: the plane is idle the whole time
+    assert batcher.model_version == 105
+    out = batcher.submit(_features(0.4)).result(30.0)
+    assert out['a_predicted'].shape == (1,)
+
+
 def test_program_key_stable_across_weights_only_exports(tmp_path):
   """Two export versions of the same model are the same PROGRAM: the
   canonical fingerprint (loc-stripped StableHLO — raw artifact bytes
@@ -559,6 +586,83 @@ def test_restart_to_first_step_gauge(tmp_path):
   value = gauge.value
   _trained_trainer(tmp_path / 'second', steps=2)
   assert gauge.value == value
+
+
+class TestCloseDrainsBacklog:
+  """``close()`` under ACTIVE backpressure: the queue is at its bound
+  (new submits 503ing) and the in-flight dispatch is stuck — close must
+  still complete every queued request before stopping the dispatcher.
+  Earlier drills only closed idle or lightly-loaded batchers."""
+
+  class _Gated(AbstractPredictor):
+    """Dispatch blocks until ``release`` fires — a deterministic
+    backlog."""
+
+    def __init__(self, release):
+      self._release = release
+
+    def predict(self, features):
+      self._release.wait(timeout=30.0)
+      return {'echo': np.asarray(features['x'])}
+
+    def get_feature_specification(self):
+      spec = SpecStruct()
+      spec['x'] = TensorSpec(shape=(2,), dtype=np.float32, name='x')
+      return spec
+
+    def restore(self):
+      return True
+
+    @property
+    def is_loaded(self):
+      return True
+
+    @property
+    def global_step(self):
+      return 1
+
+  def test_close_completes_full_backlog_under_backpressure(self):
+    release = threading.Event()
+    batcher = batching_lib.DynamicBatcher(
+        self._Gated(release), max_batch=2, batch_deadline_ms=1.0,
+        max_queue=6, metrics_prefix='serving/drain_drill',
+        register_report=False)
+    batcher.start()
+    try:
+      futures = []
+      overloaded = 0
+      for i in range(12):
+        try:
+          futures.append(batcher.submit(
+              {'x': np.full((1, 2), float(i), np.float32)}))
+        except batching_lib.OverloadedError:
+          overloaded += 1
+      # The queue hit its bound while the dispatcher was stuck: this IS
+      # active backpressure, not a lightly-loaded close.
+      assert overloaded >= 1
+      assert len(futures) >= 6
+      assert batcher.queue_depth >= 6
+
+      closer = threading.Thread(target=batcher.close, daemon=True)
+      closer.start()
+      time.sleep(0.2)
+      assert closer.is_alive()  # close() is WAITING on the backlog
+      # Submits during the drain are refused, not queued forever.
+      with pytest.raises(batching_lib.OverloadedError):
+        batcher.submit({'x': np.zeros((1, 2), np.float32)})
+      release.set()
+      closer.join(timeout=60.0)
+      assert not closer.is_alive()
+      # EVERY accepted request completed — none dropped by the drain.
+      for i, future in enumerate(futures):
+        out = future.result(timeout=1.0)
+        np.testing.assert_array_equal(
+            out['echo'], np.full((1, 2), float(i), np.float32))
+      with pytest.raises(batching_lib.OverloadedError):
+        batcher.submit({'x': np.zeros((1, 2), np.float32)})
+    finally:
+      release.set()
+      batcher.close()
 
 
 class TestModelHandoffAtomicity:
