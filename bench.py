@@ -655,6 +655,10 @@ def bench_serving_scale(duration_secs=2.0):
     p99 includes scheduling lag (coordinated omission is the reason
     the old closed-loop loadgen could not produce this number); shed
     counts ride the line so the rejection behavior is visible.
+  * ``tracing_fleet_overhead`` — cross-process request tracing at
+    sample=1.0 through the balancer→replica path vs untraced,
+    interleaved A-B-A-B slices (the serving_flight_overhead method);
+    acceptance ≥ 0.97x untraced.
   """
   import numpy as np
 
@@ -780,6 +784,53 @@ def bench_serving_scale(duration_secs=2.0):
       'note': 'open-loop Poisson at 1.5x measured capacity, 50% '
               'best-effort; p99 INCLUDES scheduling lag (no coordinated '
               'omission) and admission shedding is active',
+  }))
+
+  # --- fleet tracing overhead pin (ISSUE 12 acceptance) -------------------
+  # Cross-process request tracing at sample=1.0 (traceparent minted per
+  # request by the loadgen, balancer proxy/attempt spans, replica
+  # ingress + batcher request/queued/dispatch spans, all into the span
+  # indexes) vs the untraced fleet path. Same interleaved A-B-A-B method
+  # as serving_flight_overhead: alternating slices against ONE live
+  # fleet cancel the CPU drift that dwarfs the effect between
+  # non-adjacent runs. Acceptance >= 0.97x untraced.
+  replicas = [
+      ServingServer(make_predictor(), max_batch=64, batch_deadline_ms=0.2,
+                    metrics_prefix=f'serving/trace_replica{i}',
+                    register_report=False).start()
+      for i in range(2)
+  ]
+  try:
+    with Balancer([('127.0.0.1', r.port) for r in replicas],
+                  register_report=False) as balancer:
+      untraced_submit = loadgen.http_submit_fn('127.0.0.1', balancer.port)
+      traced_submit = loadgen.http_submit_fn('127.0.0.1', balancer.port,
+                                             trace_sample=1.0)
+      slices = {'untraced': [], 'traced': []}
+      for _ in range(2):
+        for name, submit in (('untraced', untraced_submit),
+                             ('traced', traced_submit)):
+          slices[name].append(loadgen.run_load(
+              submit, features_fn, num_clients=16,
+              duration_secs=duration_secs / 2).actions_per_sec)
+  finally:
+    for replica in replicas:
+      replica.close()
+  untraced_aps = sum(slices['untraced']) / len(slices['untraced'])
+  traced_aps = sum(slices['traced']) / len(slices['traced'])
+  print(json.dumps({
+      'metric': 'tracing_fleet_overhead',
+      'value': round(traced_aps / untraced_aps, 4) if untraced_aps else None,
+      'unit': 'traced/untraced actions-per-sec ratio',
+      'clients': 16,
+      'replicas': 2,
+      'traced_actions_per_sec': round(traced_aps, 1),
+      'untraced_actions_per_sec': round(untraced_aps, 1),
+      'trace_sample': 1.0,
+      'note': 'traceparent on EVERY request through the balancer->replica '
+              'path (proxy/attempt/ingress/batcher spans recorded), '
+              'interleaved A-B-A-B slices; acceptance >= 0.97x untraced; '
+              'device-step path re-measures on chip (BENCH_r06)',
   }))
 
 

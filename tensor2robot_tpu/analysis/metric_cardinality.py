@@ -1,0 +1,312 @@
+"""Metric-cardinality checker.
+
+The classic metrics-registry leak: a metric NAME built from
+request-scoped data — ``counter(f'requests/{request_id}')``,
+``histogram('latency_' + model_from_the_wire)`` — creates one registry
+entry per distinct value, and the process-global registry never drops
+an entry, so an unbounded label domain is an unbounded memory leak that
+also floods every ``/metricsz`` scrape and time-series sample. (The
+time-series ring snapshots the WHOLE registry every 10 s: registry
+growth multiplies across the history ring.)
+
+One finding family, ``dynamic-metric-name``: a registry metric-creating
+call (``counter``/``gauge``/``histogram``/``scope`` — module functions
+or scope methods) whose name argument is *built* from an f-string or
+``+``-concatenation containing a runtime-variable part. Bare-variable
+names are not flagged (passing a name through a helper is the registry
+API's own shape); the checker targets construction sites, where the
+cardinality decision actually lives.
+
+A dynamic part is ALLOWED (config-scoped, not request-scoped) when it
+is:
+
+* a ``self.``/``cls.`` attribute — instance configuration, bounded by
+  instance count (``f'{self._metrics_prefix}/quant'``);
+* a name (or attribute) matching the **allowlisted scope pattern**
+  ``(prefix|scope|name)$`` — the sanctioned scope-plumbing spelling
+  (``Scope.counter(self._prefix + name)``);
+* a loop variable over ``range(...)`` (per-host gauges: bounded by
+  topology), a module-level constant tuple/list (``for p in
+  PRIORITIES``), or ``.items()``/``.keys()`` of a local dict DISPLAY
+  with constant keys (the trainer's breakdown-scalars publish loop);
+* a local bound only to constants.
+
+Deliberately capped dynamic scopes are allowlisted by their static
+name prefix (:data:`ALLOWED_SCOPE_PREFIXES`): ``resilience/
+data_errors/`` is the ErrorBudget's per-source accounting, capped at 32
+sources in code (``utils/retry.py``) — the cap is the defense, the
+allowlist records that it was reviewed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'metric-cardinality'
+
+# Metric-creating call names (last dotted segment).
+_METRIC_METHODS = {'counter', 'gauge', 'histogram'}
+_SCOPE_METHODS = {'scope'}
+
+# The allowlisted scope pattern: dynamic parts spelled as scope
+# plumbing are config-time prefixes, not request data.
+_ALLOWED_NAME_RE = re.compile(r'(^|_)(prefix|scope|name)$')
+
+# Static name prefixes whose dynamic tails are a reviewed, explicitly
+# CAPPED design (the code bounds the label domain itself).
+ALLOWED_SCOPE_PREFIXES = (
+    # ErrorBudget per-source error counters: capped at 32 sources +
+    # an overflow bucket in utils/retry.py.
+    'resilience/data_errors/',
+)
+
+
+def _is_metric_call(node: ast.Call) -> bool:
+  callee = core.call_name(node)
+  if callee is None:
+    return False
+  last = callee.rsplit('.', 1)[-1]
+  if last in _METRIC_METHODS:
+    return True
+  # .scope(...) only as an attribute call: a bare scope() elsewhere is
+  # someone else's function.
+  return last in _SCOPE_METHODS and '.' in callee
+
+
+def _concat_parts(node: ast.AST, out: List[ast.AST]) -> bool:
+  """Flattens a +-chain; True iff the whole tree is names/constants."""
+  if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+    return (_concat_parts(node.left, out) and
+            _concat_parts(node.right, out))
+  out.append(node)
+  return True
+
+
+def _built_parts(arg: ast.AST) -> Optional[List[ast.AST]]:
+  """The pieces of a CONSTRUCTED name (f-string / concat), else None."""
+  if isinstance(arg, ast.JoinedStr):
+    return [v.value for v in arg.values
+            if isinstance(v, ast.FormattedValue)]
+  if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+    parts: List[ast.AST] = []
+    _concat_parts(arg, parts)
+    return [p for p in parts if not isinstance(p, ast.Constant)]
+  return None
+
+
+def _static_prefix(arg: ast.AST) -> str:
+  """The leading constant text of a constructed name."""
+  if isinstance(arg, ast.JoinedStr):
+    out = []
+    for value in arg.values:
+      if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        out.append(value.value)
+      else:
+        break
+    return ''.join(out)
+  if isinstance(arg, ast.BinOp):
+    parts: List[ast.AST] = []
+    _concat_parts(arg, parts)
+    out = []
+    for part in parts:
+      if isinstance(part, ast.Constant) and isinstance(part.value, str):
+        out.append(part.value)
+      else:
+        break
+    return ''.join(out)
+  return ''
+
+
+def _module_constants(module: core.ModuleInfo) -> Set[str]:
+  """Module-level names bound to constant containers/values.
+
+  Resolved to a fixpoint so ``PRIORITIES = (INTERACTIVE, BEST_EFFORT)``
+  — a tuple of names that are themselves module constants — counts.
+  """
+  consts: Set[str] = set()
+  assigns = [n for n in module.tree.body if isinstance(n, ast.Assign)]
+  changed = True
+  while changed:
+    changed = False
+    for node in assigns:
+      if not _is_constant_container(node.value, consts):
+        continue
+      for target in node.targets:
+        if isinstance(target, ast.Name) and target.id not in consts:
+          consts.add(target.id)
+          changed = True
+  return consts
+
+
+def _is_constant_element(node: ast.AST, consts: Set[str]) -> bool:
+  return (isinstance(node, ast.Constant) or
+          (isinstance(node, ast.Name) and node.id in consts))
+
+
+def _is_constant_container(node: ast.AST,
+                           consts: Optional[Set[str]] = None) -> bool:
+  consts = consts or set()
+  if _is_constant_element(node, consts):
+    return True
+  if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+    return all(_is_constant_element(e, consts) for e in node.elts)
+  if isinstance(node, ast.Dict):
+    return all(k is not None and _is_constant_element(k, consts)
+               for k in node.keys)
+  return False
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+  if isinstance(target, ast.Name):
+    return {target.id}
+  if isinstance(target, (ast.Tuple, ast.List)):
+    out: Set[str] = set()
+    for element in target.elts:
+      out |= _target_names(element)
+    return out
+  return set()
+
+
+def _scope_nodes(module: core.ModuleInfo, fn: Optional[ast.AST]):
+  if fn is not None:
+    yield from core.walk_scope(fn)
+  else:
+    # Module level: walk everything except function/class bodies.
+    yield from core.walk_scope(module.tree)
+
+
+def _dict_has_constant_keys(module: core.ModuleInfo, name: str,
+                            fn: Optional[ast.AST]) -> bool:
+  """Is ``name`` a local bound (only) to dict displays with constant
+  keys? (The ``for key in out.items():`` publish-loop idiom.)"""
+  found = False
+  for node in _scope_nodes(module, fn):
+    if not isinstance(node, ast.Assign):
+      continue
+    if not any(name in _target_names(t) for t in node.targets):
+      continue
+    if isinstance(node.value, ast.Dict) and all(
+        k is not None and isinstance(k, ast.Constant)
+        for k in node.value.keys):
+      found = True
+    else:
+      return False
+  return found
+
+
+def _bounded_iterable(module: core.ModuleInfo, iterable: ast.AST,
+                      fn: Optional[ast.AST], consts: Set[str]) -> bool:
+  if isinstance(iterable, ast.Call):
+    callee = core.call_name(iterable)
+    if callee in ('range', 'enumerate', 'sorted', 'reversed'):
+      # range(n): values are ints bounded by config; the others wrap an
+      # inner iterable — recurse on it.
+      if callee == 'range':
+        return True
+      return bool(iterable.args) and _bounded_iterable(
+          module, iterable.args[0], fn, consts)
+    if (isinstance(iterable.func, ast.Attribute) and
+        iterable.func.attr in ('items', 'keys', 'values')):
+      base = iterable.func.value
+      if isinstance(base, ast.Name):
+        return (base.id in consts or
+                _dict_has_constant_keys(module, base.id, fn))
+    return False
+  if isinstance(iterable, ast.Name):
+    return iterable.id in consts
+  return _is_constant_container(iterable, consts)
+
+
+def _name_bounded(module: core.ModuleInfo, name: str,
+                  fn: Optional[ast.AST], consts: Set[str]) -> bool:
+  """Can ``name`` only hold config-bounded values in this scope?"""
+  if name in consts:
+    return True
+  if fn is not None and isinstance(fn, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+    arg_names = {a.arg for a in (fn.args.posonlyargs + fn.args.args +
+                                 fn.args.kwonlyargs)}
+    if fn.args.vararg is not None:
+      arg_names.add(fn.args.vararg.arg)
+    if fn.args.kwarg is not None:
+      arg_names.add(fn.args.kwarg.arg)
+    if name in arg_names:
+      return False  # caller-supplied: the classic leak shape
+  bindings_seen = False
+  for node in _scope_nodes(module, fn):
+    if isinstance(node, ast.For):
+      if name in _target_names(node.target):
+        bindings_seen = True
+        if not _bounded_iterable(module, node.iter, fn, consts):
+          return False
+    elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)):
+      for comp in node.generators:
+        if name in _target_names(comp.target):
+          bindings_seen = True
+          if not _bounded_iterable(module, comp.iter, fn, consts):
+            return False
+    elif isinstance(node, ast.Assign):
+      if any(name in _target_names(t) for t in node.targets):
+        bindings_seen = True
+        if not _is_constant_container(node.value, consts):
+          return False
+  return bindings_seen
+
+
+def _part_allowed(module: core.ModuleInfo, part: ast.AST,
+                  fn: Optional[ast.AST], consts: Set[str]) -> bool:
+  if isinstance(part, ast.Constant):
+    return True
+  text = core.expr_text(part)
+  if text is not None and (text.startswith('self.') or
+                           text.startswith('cls.')):
+    return True  # instance configuration: bounded by instance count
+  if isinstance(part, ast.Name):
+    if _ALLOWED_NAME_RE.search(part.id):
+      return True  # the allowlisted scope-plumbing pattern
+    return _name_bounded(module, part.id, fn, consts)
+  if isinstance(part, ast.Attribute):
+    return bool(_ALLOWED_NAME_RE.search(part.attr))
+  return False  # calls, subscripts, conditionals: runtime data
+
+
+def check(module: core.ModuleInfo,
+          program: core.Program) -> List[core.Finding]:
+  del program
+  consts = _module_constants(module)
+  findings: List[core.Finding] = []
+  for node in ast.walk(module.tree):
+    if not isinstance(node, ast.Call) or not _is_metric_call(node):
+      continue
+    if not node.args:
+      continue
+    arg = node.args[0]
+    parts = _built_parts(arg)
+    if not parts:
+      continue
+    prefix = _static_prefix(arg)
+    if any(prefix.startswith(allowed)
+           for allowed in ALLOWED_SCOPE_PREFIXES):
+      continue
+    fn = module.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    bad = [part for part in parts
+           if not _part_allowed(module, part, fn, consts)]
+    if not bad:
+      continue
+    rendered = ', '.join(
+        filter(None, (core.expr_text(p) or type(p).__name__ for p in bad)))
+    findings.append(core.Finding(
+        rule=RULE, check='dynamic-metric-name',
+        path=module.rel_path, line=node.lineno,
+        symbol=core.qualname(module, node) or '<module>',
+        message=(f'metric name built from runtime-variable part(s) '
+                 f'[{rendered}]: every distinct value becomes a '
+                 'permanent registry entry (unbounded label '
+                 'cardinality); scope per-instance names through a '
+                 'config-time prefix or cap the domain explicitly')))
+  return findings

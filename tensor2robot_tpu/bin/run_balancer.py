@@ -15,7 +15,12 @@ Usage:
       --port 9000 --metricsz-port 9001
 
 ``GET /healthz`` answers for the balancer itself (200 iff >= 1 healthy
-backend); ``GET /statz`` returns per-backend health/outstanding/traffic.
+backend); ``GET /statz`` returns per-backend health/outstanding/traffic
+plus the fleet-wide slow-request log (top-k merged live from every
+healthy backend, with backend attribution); ``GET /tracez`` serves the
+balancer's span index — a client ``traceparent`` header records the
+proxy hop and every backend attempt under the fleet-wide trace id
+(assemble with ``tools/assemble_trace.py``).
 """
 
 from __future__ import annotations
@@ -43,6 +48,9 @@ def main(argv=None):
                       help='Consecutive health successes before '
                            're-admission.')
   parser.add_argument('--proxy-timeout-secs', type=float, default=30.0)
+  parser.add_argument('--fleet-slow-k', type=int, default=10,
+                      help='Rows in the /statz fleet-wide slow-request '
+                           'merge (0 disables the backend scrape).')
   parser.add_argument('--metricsz-port', type=int, default=None,
                       help='Also serve the metrics registry (incl. the '
                            'balancer report section) at /metricsz.')
@@ -61,7 +69,8 @@ def main(argv=None):
       health_interval_secs=args.health_interval_secs,
       eject_after=args.eject_after,
       readmit_after=args.readmit_after,
-      proxy_timeout_secs=args.proxy_timeout_secs)
+      proxy_timeout_secs=args.proxy_timeout_secs,
+      fleet_slow_k=args.fleet_slow_k)
 
   stop = threading.Event()
 

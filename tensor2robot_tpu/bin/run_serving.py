@@ -116,8 +116,27 @@ def main(argv=None):
   parser.add_argument('--postmortem-dir', default=None,
                       help='Directory for incident bundles: a reload '
                            'failure falling back to the last-good model '
-                           'dumps flight events + metrics history here '
-                           '(render with tools/postmortem.py).')
+                           'dumps flight events + metrics history here; '
+                           '--slo / --anomaly-watch escalations write '
+                           'LIVE bundles to the same place (render with '
+                           'tools/postmortem.py).')
+  parser.add_argument('--slo', action='store_true',
+                      help='Run the SLO burn-rate engine over the '
+                           'serving objectives (per-class availability + '
+                           'interactive latency threshold): multi-window '
+                           'burn alerts land in /statz, /metricsz, the '
+                           'flight ring, and — with --postmortem-dir — '
+                           'one rate-limited live forensics bundle.')
+  parser.add_argument('--slo-latency-threshold-ms', type=float,
+                      default=512.0,
+                      help='Interactive latency SLO threshold (good '
+                           'request = at or under this).')
+  parser.add_argument('--anomaly-watch', action='store_true',
+                      help='Watch serving time-series signals (request '
+                           'p99, queue depth, shed rate, page-in time) '
+                           'with robust median/MAD detectors; anomalies '
+                           'flag flight events and escalate to live '
+                           'bundles.')
   args = parser.parse_args(argv)
   logging.basicConfig(
       level=logging.INFO,
@@ -194,9 +213,26 @@ def main(argv=None):
 
   previous = {sig: signal.signal(sig, handle_signal)
               for sig in (signal.SIGTERM, signal.SIGINT)}
+  engine = None
+  watch = None
   try:
     with server:
       metricsz.maybe_start(args.metricsz_port)
+      if args.slo:
+        from tensor2robot_tpu.observability import slo as slo_lib
+
+        models = (server.router.models()
+                  if server.router is not None else [])
+        engine = slo_lib.SLOEngine(
+            slo_lib.serving_objectives(
+                models=models,
+                latency_threshold_ms=args.slo_latency_threshold_ms),
+            postmortem_dir=args.postmortem_dir).start()
+      if args.anomaly_watch:
+        from tensor2robot_tpu.observability import anomaly as anomaly_lib
+
+        watch = anomaly_lib.AnomalyWatch(
+            postmortem_dir=args.postmortem_dir).start()
       if server.router is not None:
         logging.info('Serving models %s at %s',
                      server.router.versions(), server.url)
@@ -205,6 +241,10 @@ def main(argv=None):
                      server.batcher.model_version, server.url)
       stop.wait()
   finally:
+    if watch is not None:
+      watch.stop()
+    if engine is not None:
+      engine.stop()
     for sig, handler in previous.items():
       signal.signal(sig, handler)
   return 0

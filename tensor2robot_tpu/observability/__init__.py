@@ -21,8 +21,19 @@
 * :mod:`~tensor2robot_tpu.observability.timeseries` — periodic registry
   snapshots in a bounded ring (``/metricsz?history=1``).
 * :mod:`~tensor2robot_tpu.observability.postmortem` — one-file incident
-  bundles written on every abnormal-exit path; rendered by
-  ``tools/postmortem.py``.
+  bundles written on every abnormal-exit path (and, ``live=True``, from
+  running processes); rendered by ``tools/postmortem.py``.
+* :mod:`~tensor2robot_tpu.observability.slo` — declarative availability
+  / latency-threshold objectives evaluated with multi-window burn rates
+  off the time-series ring; alert transitions emit flight events and
+  live forensics bundles.
+* :mod:`~tensor2robot_tpu.observability.anomaly` — robust median/MAD
+  detectors over selected time-series signals, escalating anomalies to
+  flight events and live bundles.
+
+Cross-process request tracing (``traceparent`` contexts, the bounded
+``/tracez`` span index, ``tools/assemble_trace.py``) lives in
+:mod:`~tensor2robot_tpu.observability.tracing`.
 
 The trainer's per-dispatch step-time breakdown (host wait / H2D
 placement / device step / callbacks, ``examples_per_sec``,
@@ -30,24 +41,27 @@ placement / device step / callbacks, ``examples_per_sec``,
 ``train/trainer.py`` and the README "Observability" section.
 """
 
-from tensor2robot_tpu.observability import (flight, memory, metrics,
-                                            metricsz, postmortem,
-                                            timeseries, tracing)
+from tensor2robot_tpu.observability import (anomaly, flight, memory,
+                                            metrics, metricsz, postmortem,
+                                            slo, timeseries, tracing)
 from tensor2robot_tpu.observability.flight import FlightRecorder
 from tensor2robot_tpu.observability.memory import (device_memory_peak_mb,
                                                    device_memory_stats,
                                                    memory_scalars)
 from tensor2robot_tpu.observability.metrics import (Counter, Gauge,
                                                     Histogram, Registry)
+from tensor2robot_tpu.observability.anomaly import AnomalyWatch
+from tensor2robot_tpu.observability.slo import Objective, SLOEngine
 from tensor2robot_tpu.observability.timeseries import TimeSeriesRecorder
-from tensor2robot_tpu.observability.tracing import (capture,
+from tensor2robot_tpu.observability.tracing import (TraceContext, capture,
                                                     dump_chrome_trace, span,
                                                     step_annotation)
 
 __all__ = [
-    'flight', 'memory', 'metrics', 'metricsz', 'postmortem', 'timeseries',
-    'tracing', 'Counter', 'FlightRecorder', 'Gauge', 'Histogram',
-    'Registry', 'TimeSeriesRecorder', 'capture', 'device_memory_peak_mb',
-    'device_memory_stats', 'dump_chrome_trace', 'memory_scalars', 'span',
-    'step_annotation',
+    'anomaly', 'flight', 'memory', 'metrics', 'metricsz', 'postmortem',
+    'slo', 'timeseries', 'tracing', 'AnomalyWatch', 'Counter',
+    'FlightRecorder', 'Gauge', 'Histogram', 'Objective', 'Registry',
+    'SLOEngine', 'TimeSeriesRecorder', 'TraceContext', 'capture',
+    'device_memory_peak_mb', 'device_memory_stats', 'dump_chrome_trace',
+    'memory_scalars', 'span', 'step_annotation',
 ]

@@ -32,13 +32,18 @@ Design constraints, in the observability tradition:
 Event shape: ``(time.time(), kind, name, detail)`` where ``kind`` is a
 coarse subsystem tag (``'span' | 'dispatch' | 'checkpoint' | 'swap' |
 'nonfinite' | 'budget' | 'shutdown' | 'liveness' | 'request' |
-'router' | 'balancer' | 'error'``), ``name`` a slash-scoped identifier
-like metric names, and ``detail`` a short ``k=v``-style string
-(machine-greppable: the postmortem renderer parses ``dur_ms=`` /
-``id=`` tokens out of it). ``'router'`` carries the serving router's
-page-in/page-out/shed decisions, ``'balancer'`` the front door's
-eject/readmit transitions — so a latency incident bundle names the
-paging and fleet-membership churn around it.
+'router' | 'balancer' | 'slo' | 'anomaly' | 'error'``), ``name`` a
+slash-scoped identifier like metric names, and ``detail`` a short
+``k=v``-style string (machine-greppable: the postmortem renderer parses
+``dur_ms=`` / ``id=`` tokens out of it). ``'router'`` carries the
+serving router's page-in/page-out/shed decisions, ``'balancer'`` the
+front door's eject/readmit transitions — so a latency incident bundle
+names the paging and fleet-membership churn around it. ``'slo'``
+carries burn-rate alert/clear transitions (``observability/slo.py``),
+``'anomaly'`` the anomaly watch's detections (``observability/
+anomaly.py``) — both also escalate to rate-limited LIVE postmortem
+bundles. Traced requests' ``'request'`` events carry a ``trace=`` token
+joining the ring to the cross-process ``/tracez`` span index.
 """
 
 from __future__ import annotations

@@ -128,7 +128,9 @@ class Histogram:
     self._min = math.inf  # GUARDED_BY(self._lock)
     self._max = -math.inf  # GUARDED_BY(self._lock)
     self._buckets: Dict[int, int] = {}  # GUARDED_BY(self._lock)
-    self._exemplars: Dict[int, str] = {}  # GUARDED_BY(self._lock)
+    # bucket exponent -> (label, observed value, wall time): one exemplar
+    # per bucket (the latest), per the OpenMetrics model.
+    self._exemplars: Dict[int, tuple] = {}  # GUARDED_BY(self._lock)
 
   def observe(self, value: float, exemplar: Optional[str] = None) -> None:
     value = float(value)
@@ -144,7 +146,7 @@ class Histogram:
       e = math.frexp(value)[1] if value > 0.0 else -1075
       self._buckets[e] = self._buckets.get(e, 0) + 1
       if exemplar is not None:
-        self._exemplars[e] = str(exemplar)
+        self._exemplars[e] = (str(exemplar), value, time.time())
 
   def _percentile_locked(self, fraction: float) -> float:  # HOLDS(self._lock)
     if self._count == 0:
@@ -180,6 +182,12 @@ class Histogram:
     with self._lock:
       return dict(self._buckets)
 
+  def bucket_exemplars(self) -> Dict[int, tuple]:
+    """``{frexp exponent: (label, value, wall_time)}`` — the OpenMetrics
+    exposition attaches these to the matching ``_bucket`` lines."""
+    with self._lock:
+      return dict(self._exemplars)
+
   def snapshot(self):
     with self._lock:
       if self._count == 0:
@@ -194,11 +202,17 @@ class Histogram:
           'p50': self._percentile_locked(0.50),
           'p90': self._percentile_locked(0.90),
           'p99': self._percentile_locked(0.99),
+          # Raw bucket counts (string exponents: JSON round-trip-stable).
+          # Windowed consumers — the SLO engine's latency-threshold
+          # objectives, the anomaly watch's windowed p99 — difference
+          # two snapshots' buckets to get the distribution BETWEEN them,
+          # which lifetime percentiles cannot provide.
+          'buckets': {str(e): c for e, c in sorted(self._buckets.items())},
       }
       if self._exemplars:
         out['exemplars'] = {
-            repr(self.bucket_upper(e)): label
-            for e, label in sorted(self._exemplars.items())
+            repr(self.bucket_upper(e)): entry[0]
+            for e, entry in sorted(self._exemplars.items())
         }
       return out
 

@@ -20,6 +20,12 @@ Endpoints:
                              snapshots (``observability/timeseries.py``)
   ``/metricsz?format=prom``  OpenMetrics/Prometheus text exposition, so
                              standard scrapers work without a JSON shim
+                             (histogram buckets carry request-id
+                             exemplars: ``# {trace_id="..."} value ts``)
+  ``/tracez``                this process's bounded span index
+                             (``?trace_id=`` / ``?request_id=`` filter;
+                             ``?probe=1`` returns only the clock/service
+                             header — the assembler's offset probe)
   ``/healthz``               ``{"status": "ok"}`` — liveness probe
 """
 
@@ -55,14 +61,33 @@ def _prom_num(value: float) -> str:
   return repr(value) if isinstance(value, float) else str(value)
 
 
+_EXEMPLAR_LABEL_RE = re.compile(r'[^\x20-\x7e]')
+
+
+def _exemplar_suffix(entry: Optional[tuple]) -> str:
+  """The OpenMetrics exemplar clause for one bucket line, or ''.
+
+  Format (OpenMetrics 1.0): `` # {trace_id="<label>"} <value> <ts>`` —
+  the label is the request/trace id the serving plane attached to the
+  observation, so scrape-side tooling can jump from a p99 bucket
+  straight to ``/tracez?request_id=...``.
+  """
+  if not entry:
+    return ''
+  label, value, ts = entry
+  label = _EXEMPLAR_LABEL_RE.sub('_', str(label)).replace('"', '_')[:128]
+  return f' # {{trace_id="{label}"}} {_prom_num(float(value))} {ts:.3f}'
+
+
 def prom_exposition(registry: Optional[metrics_lib.Registry] = None) -> str:
   """The registry as Prometheus/OpenMetrics text exposition (v0.0.4).
 
   Mapping: ``Counter`` → ``<name>_total`` counter; ``Gauge`` → gauge;
   ``Histogram`` → cumulative ``<name>_bucket{le="..."}`` series over the
-  power-of-two buckets plus ``_sum``/``_count``. Slash scopes become
-  underscores (``serving/request_latency_ms`` →
-  ``serving_request_latency_ms``).
+  power-of-two buckets plus ``_sum``/``_count``, each bucket carrying
+  its stored exemplar (request id + observed value + wall time) when
+  one exists. Slash scopes become underscores
+  (``serving/request_latency_ms`` → ``serving_request_latency_ms``).
   """
   registry = registry if registry is not None else metrics_lib.registry
   lines: List[str] = []
@@ -77,13 +102,15 @@ def prom_exposition(registry: Optional[metrics_lib.Registry] = None) -> str:
     elif isinstance(metric, metrics_lib.Histogram):
       snap = metric.snapshot()
       buckets = metric.bucket_counts()
+      exemplars = metric.bucket_exemplars()
       lines.append(f'# TYPE {pname} histogram')
       cumulative = 0
       for exponent in sorted(buckets):
         cumulative += buckets[exponent]
         upper = metrics_lib.Histogram.bucket_upper(exponent)
         lines.append(
-            f'{pname}_bucket{{le="{_prom_num(float(upper))}"}} {cumulative}')
+            f'{pname}_bucket{{le="{_prom_num(float(upper))}"}} {cumulative}'
+            + _exemplar_suffix(exemplars.get(exponent)))
       lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
       lines.append(f'{pname}_sum {_prom_num(float(snap["sum"]))}')
       lines.append(f'{pname}_count {snap["count"]}')
@@ -128,11 +155,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self._reply(200, timeseries.history())
       else:
         self._reply(200, metrics_lib.report())
+    elif path == '/tracez':
+      from tensor2robot_tpu.observability import tracing
+
+      self._reply(200, tracing.tracez_document(
+          trace_id=query.get('trace_id', [None])[0] or None,
+          request_id=query.get('request_id', [None])[0] or None,
+          probe_only=query.get('probe', [''])[0] not in ('', '0')))
     elif path == '/healthz':
       self._reply(200, {'status': 'ok'})
     else:
       self._reply(404, {'error': f'unknown path {path!r}',
-                        'endpoints': ['/metricsz', '/healthz']})
+                        'endpoints': ['/metricsz', '/tracez', '/healthz']})
 
 
 class MetricsServer:

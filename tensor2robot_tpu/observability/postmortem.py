@@ -17,6 +17,17 @@ observability surface at the moment of death:
 Render with ``tools/postmortem.py`` (timeline, top metric deltas,
 slowest spans; ``--json`` for machines).
 
+**Live bundles** (``dump(..., live=True)``): the same bundle dumped
+from a *running* process — the SLO engine's burn-rate alerts
+(``observability/slo.py``) and the anomaly watch
+(``observability/anomaly.py``) escalate to one, turning the crash-only
+forensics plane into an incident plane. Same writer, same atomic
+tmp+rename, same per-(directory, reason) rate limit — an alerting
+condition that persists coalesces into one bundle per interval instead
+of spraying the disk. The bundle records ``live: true`` so the renderer
+anchors its timeline at "moment of capture" rather than
+"moment of death".
+
 Contract with the exit paths that call this: **bounded and harmless.**
 ``dump`` never raises (an observability failure must not mask the real
 one), rate-limits to one bundle per (directory, reason) per
@@ -106,12 +117,15 @@ def dump(model_dir: Optional[str],
          error: Optional[BaseException] = None,
          topology: Optional[Dict[str, Any]] = None,
          extra: Optional[Dict[str, Any]] = None,
-         window_secs: float = DEFAULT_WINDOW_SECS) -> Optional[str]:
+         window_secs: float = DEFAULT_WINDOW_SECS,
+         live: bool = False) -> Optional[str]:
   """Writes one postmortem bundle; returns its path (None if skipped).
 
   Never raises; rate-limited per (model_dir, reason). ``model_dir`` of
   None/'' skips quietly — library embedders without a run directory
-  still get the terminal log line, just no bundle.
+  still get the terminal log line, just no bundle. ``live=True`` marks
+  a forensics capture from a process that keeps running (SLO burn /
+  anomaly escalation) rather than an exit path.
   """
   if not model_dir:
     return None
@@ -122,6 +136,7 @@ def dump(model_dir: Optional[str],
         'kind': 'postmortem',
         'version': 1,
         'reason': reason,
+        'live': bool(live),
         'exit_code': exit_code,
         'time': time.time(),
         'pid': os.getpid(),

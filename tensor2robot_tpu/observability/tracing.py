@@ -26,23 +26,45 @@ ts/dur containment). :func:`step_annotation` wraps
 ``jax.profiler.StepTraceAnnotation`` so trainer dispatches carry step
 markers in captured traces (TensorBoard's step-time view keys off
 them).
+
+**Cross-process request tracing** (the fleet half of this module): a
+request entering the fleet carries a W3C-``traceparent``-style context —
+a 32-hex trace id shared by every hop plus the 16-hex span id of the
+hop that forwarded it (:class:`TraceContext`,
+:func:`parse_traceparent`/:func:`format_traceparent`). Each process
+records its finished spans (balancer proxy + per-backend attempts,
+serving ingress, batcher request/queued/dispatch) into a bounded
+process-global :class:`SpanIndex` served at ``GET /tracez`` by every
+fleet HTTP surface (serving server, balancer, ``/metricsz``).
+``tools/assemble_trace.py`` then scrapes every process, estimates each
+backend's clock offset from probe round-trips, and merges one causally
+ordered cross-process timeline for a trace id — including a retried
+request whose one trace spans a failed AND a succeeded replica. Span
+recording follows the flight-ring cost discipline: bounded preallocated
+ring, batched ``record_spans`` (one lock per dispatch, not per
+request), and nothing at all on untraced requests.
 """
 
 from __future__ import annotations
 
+import binascii
 import contextlib
 import gzip
 import json
 import os
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Sequence
 
 from tensor2robot_tpu.observability import flight, metrics
 
 __all__ = [
     'span', 'step_annotation', 'start_capture', 'stop_capture', 'capture',
     'capturing', 'chrome_trace', 'dump_chrome_trace',
+    'TraceContext', 'parse_traceparent', 'format_traceparent',
+    'mint_trace_id', 'mint_span_id', 'SpanIndex', 'span_index',
+    'record_span', 'record_spans', 'spans', 'set_service', 'service',
+    'tracez_document', 'TRACEPARENT_HEADER',
 ]
 
 # perf_counter epoch for event timestamps: Chrome trace wants µs from an
@@ -219,6 +241,229 @@ def dump_chrome_trace(path: str,
     with open(path, 'w') as f:
       json.dump(trace, f)
   return path
+
+
+# --------------------------------------------------- cross-process tracing
+
+
+TRACEPARENT_HEADER = 'traceparent'
+
+# W3C trace-context version we emit; parsing accepts any version whose
+# field layout matches (version-format forward compatibility).
+_TRACEPARENT_VERSION = '00'
+
+
+class TraceContext(NamedTuple):
+  """One hop's trace coordinates: the fleet-wide trace id plus the span
+  id of the hop that forwarded the request (the next span's parent)."""
+
+  trace_id: str
+  span_id: str
+
+  def child(self) -> 'TraceContext':
+    """A fresh context under the same trace (for the next hop)."""
+    return TraceContext(self.trace_id, mint_span_id())
+
+
+def mint_trace_id() -> str:
+  return binascii.hexlify(os.urandom(16)).decode()
+
+
+def mint_span_id() -> str:
+  return binascii.hexlify(os.urandom(8)).decode()
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+  """``00-<trace_id>-<span_id>-01`` (sampled flag always set: a context
+  only exists for requests someone chose to trace)."""
+  return f'{_TRACEPARENT_VERSION}-{ctx.trace_id}-{ctx.span_id}-01'
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+  """A :class:`TraceContext` from a ``traceparent`` header, or None.
+
+  Malformed headers are None, never an error — tracing must not turn a
+  bad client header into a failed request.
+  """
+  if not header:
+    return None
+  parts = header.strip().split('-')
+  if len(parts) < 3:
+    return None
+  trace_id, span_id = parts[1], parts[2]
+  if len(trace_id) != 32 or len(span_id) != 16:
+    return None
+  try:
+    int(trace_id, 16), int(span_id, 16)
+  except ValueError:
+    return None
+  if trace_id == '0' * 32 or span_id == '0' * 16:
+    return None
+  return TraceContext(trace_id, span_id)
+
+
+class SpanIndex:
+  """Bounded ring of finished spans, queryable by trace/request id.
+
+  Same retention policy as the flight ring (keep the LAST N, overwrite
+  in place): ``/tracez`` is an incident surface — the recent story
+  matters, old spans age out. Span shape (a plain dict, JSON-ready):
+  ``trace_id / span_id / parent_id / name / kind / start / end /
+  request_id / detail / service`` with wall-clock start/end so spans
+  from different processes land on comparable axes (modulo the clock
+  offset ``tools/assemble_trace.py`` estimates and removes).
+  """
+
+  def __init__(self, capacity: int = 4096):
+    if capacity < 1:
+      raise ValueError(f'capacity must be >= 1, got {capacity}')
+    self._capacity = int(capacity)
+    self._lock = threading.Lock()
+    self._slots: List[Optional[dict]] = [None] * self._capacity  # GUARDED_BY(self._lock)
+    self._next = 0  # GUARDED_BY(self._lock)
+    self._recorded = 0  # GUARDED_BY(self._lock)
+
+  @property
+  def capacity(self) -> int:
+    return self._capacity
+
+  @property
+  def recorded(self) -> int:
+    with self._lock:
+      return self._recorded
+
+  def record(self, span_dict: dict) -> None:
+    with self._lock:
+      self._slots[self._next] = span_dict
+      self._next = (self._next + 1) % self._capacity
+      self._recorded += 1
+
+  def record_many(self, span_dicts: Sequence[dict]) -> None:
+    """Batched record: one lock for a whole dispatch's spans."""
+    if not span_dicts:
+      return
+    with self._lock:
+      for span_dict in span_dicts:
+        self._slots[self._next] = span_dict
+        self._next = (self._next + 1) % self._capacity
+      self._recorded += len(span_dicts)
+
+  def spans(self, trace_id: Optional[str] = None,
+            request_id: Optional[str] = None,
+            last_secs: Optional[float] = None) -> List[dict]:
+    """Matching spans oldest → newest (copies; safe to mutate)."""
+    with self._lock:
+      if self._recorded >= self._capacity:
+        raw = self._slots[self._next:] + self._slots[:self._next]
+      else:
+        raw = self._slots[:self._next]
+    cutoff = None if last_secs is None else time.time() - last_secs
+    out = []
+    for entry in raw:
+      if entry is None:
+        continue
+      if trace_id is not None and entry.get('trace_id') != trace_id:
+        continue
+      if request_id is not None and entry.get('request_id') != request_id:
+        continue
+      if cutoff is not None and entry.get('end', 0.0) < cutoff:
+        continue
+      out.append(dict(entry))
+    return out
+
+  def clear(self) -> None:
+    with self._lock:
+      self._slots = [None] * self._capacity
+      self._next = 0
+      self._recorded = 0
+
+
+# Process-global index (flight-recorder style): every subsystem's spans
+# land in one ring so /tracez serves the whole process's story.
+_SPAN_INDEX = SpanIndex()
+
+# Human label for this process in assembled fleet timelines ('balancer',
+# 'replica-8001', ...). Plain str write: racing readers see old or new,
+# both valid.
+_service = f'pid-{os.getpid()}'
+
+_SPANS_COUNTER = metrics.counter('tracing/spans')
+
+
+def span_index() -> SpanIndex:
+  return _SPAN_INDEX
+
+
+def set_service(name: str) -> None:
+  """Labels this process's spans in assembled fleet timelines."""
+  global _service
+  _service = str(name)
+
+
+def service() -> str:
+  return _service
+
+
+def record_span(name: str,
+                kind: str,
+                trace_id: str,
+                span_id: str,
+                parent_id: str,
+                start: float,
+                end: float,
+                request_id: str = '',
+                detail: str = '',
+                service_label: Optional[str] = None) -> None:
+  """Records one finished span into the process-global index."""
+  _SPAN_INDEX.record({
+      'trace_id': trace_id, 'span_id': span_id, 'parent_id': parent_id,
+      'name': name, 'kind': kind, 'start': start, 'end': end,
+      'request_id': request_id, 'detail': detail,
+      'service': service_label if service_label is not None else _service,
+  })
+  _SPANS_COUNTER.inc()
+
+
+def record_spans(span_dicts: Sequence[dict],
+                 service_label: Optional[str] = None) -> None:
+  """Batched :func:`record_span` (one ring lock per call). Each dict
+  must already carry the span fields; ``service`` is filled if absent."""
+  if not span_dicts:
+    return
+  label = service_label if service_label is not None else _service
+  for span_dict in span_dicts:
+    span_dict.setdefault('service', label)
+  _SPAN_INDEX.record_many(span_dicts)
+  _SPANS_COUNTER.inc(len(span_dicts))
+
+
+def spans(trace_id: Optional[str] = None,
+          request_id: Optional[str] = None,
+          last_secs: Optional[float] = None) -> List[dict]:
+  return _SPAN_INDEX.spans(trace_id=trace_id, request_id=request_id,
+                           last_secs=last_secs)
+
+
+def tracez_document(trace_id: Optional[str] = None,
+                    request_id: Optional[str] = None,
+                    probe_only: bool = False) -> Dict[str, Any]:
+  """The ``GET /tracez`` reply document.
+
+  Always carries the server's wall clock (``now``) — the assembler's
+  clock-offset probe reads it against its own send/receive timestamps
+  (offset ≈ server_now − (t_send+t_recv)/2, error ≤ RTT/2).
+  ``probe_only`` skips the span payload so offset probes stay cheap.
+  """
+  doc: Dict[str, Any] = {
+      'kind': 'tracez',
+      'service': _service,
+      'pid': os.getpid(),
+      'now': time.time(),
+  }
+  if not probe_only:
+    doc['spans'] = _SPAN_INDEX.spans(trace_id=trace_id,
+                                     request_id=request_id)
+  return doc
 
 
 def step_annotation(step: int, name: str = 'train'):

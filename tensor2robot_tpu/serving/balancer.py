@@ -32,12 +32,24 @@ Behavior:
   every reply, any status — so PR-10 request tracing and latency
   exemplars survive the replica indirection end to end, and a retried
   request keeps ONE id across backends.
+* **Trace propagation.** A client ``traceparent`` header (W3C-style
+  trace id + parent span id, ``observability/tracing.py``) is honored:
+  the balancer records a ``balancer/proxy`` span plus one
+  ``balancer/attempt`` span per backend tried — a failed-over request's
+  trace names the failed AND the succeeded replica — and forwards each
+  attempt's own span id downstream, so the backend's ingress span
+  parents correctly. Spans land in the process ``/tracez`` index;
+  ``tools/assemble_trace.py`` merges them with the replicas' into one
+  cross-process timeline.
 
 Not proxied: ``GET /healthz`` answers for the balancer itself (healthy
-iff ≥ 1 backend is), ``GET /statz`` returns the balancer's own report
-(per-backend health/outstanding/traffic). Metrics live under
-``balancer/*``; ejection/readmission decisions land in the flight ring
-(kind ``'balancer'``).
+iff ≥ 1 backend is), ``GET /statz`` returns the balancer's own report —
+including the top-k **fleet-wide slow-request log** merged live from
+every healthy backend's ``/statz`` with backend attribution, so one
+front-door scrape names the worst requests anywhere in the fleet.
+``GET /tracez`` serves the balancer's own span index. Metrics live
+under ``balancer/*``; ejection/readmission decisions land in the
+flight ring (kind ``'balancer'``).
 """
 
 from __future__ import annotations
@@ -50,10 +62,12 @@ import logging
 import os
 import threading
 import time
+import urllib.parse
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 
 # Headers copied from the client request onto the proxied request.
 _FORWARD_HEADERS = ('Content-Type', 'X-Priority')
@@ -116,7 +130,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
       pass
 
   def do_GET(self):  # noqa: N802 - stdlib naming
-    path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    parsed = urllib.parse.urlparse(self.path)
+    path = parsed.path.rstrip('/') or '/'
+    query = urllib.parse.parse_qs(parsed.query)
     if path == '/healthz':
       healthy = self._balancer.healthy_backend_count()
       code = 200 if healthy else 503
@@ -125,17 +141,24 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                          'backends_total': self._balancer.backend_count()})
     elif path == '/statz':
       self._reply(200, self._balancer.report())
+    elif path == '/tracez':
+      self._reply(200, tracing.tracez_document(
+          trace_id=query.get('trace_id', [None])[0] or None,
+          request_id=query.get('request_id', [None])[0] or None,
+          probe_only=query.get('probe', [''])[0] not in ('', '0')))
     else:
       self._reply(404, {'error': f'unknown path {path!r}',
                         'endpoints': ['/v1/predict',
                                       '/v1/models/<name>/predict',
-                                      '/healthz', '/statz']})
+                                      '/healthz', '/statz', '/tracez']})
 
   def do_POST(self):  # noqa: N802 - stdlib naming
     balancer = self._balancer
     path = self.path.split('?', 1)[0]
     rid = ((self.headers.get('X-Request-Id') or '').strip()
            or balancer.mint_request_id())
+    trace = tracing.parse_traceparent(
+        self.headers.get(tracing.TRACEPARENT_HEADER))
     try:
       length = int(self.headers.get('Content-Length', 0))
     except (TypeError, ValueError):
@@ -146,7 +169,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
       value = self.headers.get(name)
       if value:
         headers[name] = value
-    status, payload, retry_after = balancer.proxy(path, body, headers)
+    status, payload, retry_after = balancer.proxy(
+        path, body, headers, trace=trace, request_id=rid)
     self._reply(status, payload, request_id=rid, retry_after=retry_after)
 
 
@@ -167,7 +191,8 @@ class Balancer:
                readmit_after: int = 1,
                proxy_timeout_secs: float = 30.0,
                retry_after_secs: float = 1.0,
-               register_report: bool = True):
+               register_report: bool = True,
+               fleet_slow_k: int = 10):
     if not backends:
       raise ValueError('Balancer needs at least one backend')
     self._lock = threading.Lock()
@@ -188,6 +213,9 @@ class Balancer:
     self._proxy_timeout = float(proxy_timeout_secs)
     self._retry_after = str(max(1, int(round(retry_after_secs))))
     self._register_report = bool(register_report)
+    self._fleet_slow_k = max(0, int(fleet_slow_k))
+    # Span-index attribution label; refined with the bound port at start.
+    self._service = 'balancer'
     self._req_seq = itertools.count(1)
     self._id_prefix = f'lb{os.getpid():x}'
     # Per-(thread, backend) keep-alive connections; a proxy thread
@@ -227,6 +255,7 @@ class Balancer:
     self._httpd = http.server.ThreadingHTTPServer(self._requested, _Handler)
     self._httpd.daemon_threads = True
     self._httpd.balancer = self  # type: ignore[attr-defined]
+    self._service = f'balancer-{self._httpd.server_address[1]}'
     self._thread = threading.Thread(
         target=self._httpd.serve_forever, kwargs={'poll_interval': 0.2},
         daemon=True, name='t2r-balancer-http')
@@ -356,15 +385,54 @@ class Balancer:
       if conn is not None:
         conn.close()
 
-  def proxy(self, path: str, body: bytes, headers: Dict[str, str]
+  def proxy(self, path: str, body: bytes, headers: Dict[str, str],
+            trace: Optional[tracing.TraceContext] = None,
+            request_id: str = ''
             ) -> Tuple[int, bytes, Optional[str]]:
     """One client request → (status, body, retry_after_header).
 
     Walks healthy backends best-first: transport failures and 503s move
     on to the next untried backend; the final result (or the last 503,
     or a 502/503 when nothing answered) is relayed.
+
+    ``trace`` records a ``balancer/proxy`` span plus one
+    ``balancer/attempt`` span per backend tried (each forwarding ITS
+    span id downstream as the new ``traceparent`` parent), so a
+    failed-over request's assembled timeline shows every replica it
+    touched.
     """
     self._m_requests.inc()
+    if trace is None:
+      return self._proxy_walk(path, body, headers, None, '', request_id)
+    proxy_span = tracing.mint_span_id()
+    start = time.time()
+    result: Optional[Tuple[int, bytes, Optional[str]]] = None
+    try:
+      result = self._proxy_walk(path, body, headers, trace, proxy_span,
+                                request_id)
+      return result
+    finally:
+      status = result[0] if result is not None else 502
+      tracing.record_span(
+          'balancer/proxy', 'balancer', trace.trace_id, proxy_span,
+          trace.span_id, start, time.time(), request_id=request_id,
+          detail=f'status={status}', service_label=self._service)
+
+  def _note_attempt_span(self, trace: Optional[tracing.TraceContext],
+                         proxy_span: str, attempt_span: str,
+                         attempt_start: float, backend: _Backend,
+                         outcome: str, request_id: str) -> None:
+    if trace is None:
+      return
+    tracing.record_span(
+        'balancer/attempt', 'balancer', trace.trace_id, attempt_span,
+        proxy_span, attempt_start, time.time(), request_id=request_id,
+        detail=f'backend={backend.address} {outcome}',
+        service_label=self._service)
+
+  def _proxy_walk(self, path: str, body: bytes, headers: Dict[str, str],
+                  trace: Optional[tracing.TraceContext], proxy_span: str,
+                  request_id: str) -> Tuple[int, bytes, Optional[str]]:
     tried: set = set()
     last_503: Optional[Tuple[int, bytes, Optional[str]]] = None
     while True:
@@ -380,11 +448,29 @@ class Balancer:
         return (503, json.dumps({'error': 'no healthy backends'}).encode(),
                 self._retry_after)
       tried.add(backend.index)
+      attempt_headers = headers
+      attempt_span = ''
+      attempt_start = 0.0
+      if trace is not None:
+        # Each attempt forwards its OWN span id: the backend's ingress
+        # span parents on the attempt that actually reached it.
+        attempt_span = tracing.mint_span_id()
+        attempt_start = time.time()
+        attempt_headers = dict(headers)
+        attempt_headers[tracing.TRACEPARENT_HEADER] = (
+            tracing.format_traceparent(
+                tracing.TraceContext(trace.trace_id, attempt_span)))
       try:
         try:
           status, payload, retry_after = self._proxy_once(
-              backend, path, body, headers)
+              backend, path, body, attempt_headers)
+          self._note_attempt_span(trace, proxy_span, attempt_span,
+                                  attempt_start, backend,
+                                  f'status={status}', request_id)
         except _TRANSPORT_ERRORS as e:
+          self._note_attempt_span(trace, proxy_span, attempt_span,
+                                  attempt_start, backend,
+                                  f'error={type(e).__name__}', request_id)
           self._drop_connection(backend)
           self._note_transport_failure(backend)
           self._m_retries.inc()
@@ -438,6 +524,48 @@ class Balancer:
 
   # ------------------------------------------------------------- reporting
 
+  def fleet_slow_requests(self, k: Optional[int] = None
+                          ) -> List[Dict[str, Any]]:
+    """Top-k slowest completed requests FLEET-WIDE, with attribution.
+
+    Scrapes every healthy backend's ``/statz`` (bounded per-backend
+    timeout, fresh connections — a slow replica must not wedge the
+    front door's own report), collects each plane's bounded
+    slow-request log (single-model ``slow_requests`` or the router's
+    per-model logs), tags every entry with its backend address (and
+    model), and merges by latency. One front-door scrape thus names the
+    worst requests anywhere in the fleet.
+    """
+    k = self._fleet_slow_k if k is None else int(k)
+    if k <= 0:
+      return []
+    with self._lock:
+      backends = [(b.address, b.host, b.port)
+                  for b in self._backends if b.healthy]
+    merged: List[Dict[str, Any]] = []
+    for address, host, port in backends:
+      conn = None
+      try:
+        conn = http.client.HTTPConnection(
+            host, port, timeout=max(self._health_interval, 0.5))
+        conn.request('GET', '/statz')
+        response = conn.getresponse()
+        doc = json.loads(response.read())
+      except _TRANSPORT_ERRORS + (ValueError,):
+        continue
+      finally:
+        if conn is not None:
+          conn.close()
+      for entry in doc.get('slow_requests') or []:
+        merged.append(dict(entry, backend=address))
+      for model, sub in (doc.get('models') or {}).items():
+        if not isinstance(sub, dict):
+          continue
+        for entry in sub.get('slow_requests') or []:
+          merged.append(dict(entry, backend=address, model=model))
+    merged.sort(key=lambda e: -float(e.get('latency_ms', 0.0)))
+    return merged[:k]
+
   def report(self) -> Dict[str, Any]:
     snap = metrics_lib.snapshot('balancer/')
     with self._lock:
@@ -452,6 +580,7 @@ class Balancer:
     return {
         'backends': backends,
         'backends_healthy': sum(1 for b in backends if b['healthy']),
+        'fleet_slow_requests': self.fleet_slow_requests(),
         'requests': snap.get('balancer/requests', 0),
         'proxied': snap.get('balancer/proxied', 0),
         'retries': snap.get('balancer/retries', 0),

@@ -82,6 +82,7 @@ import numpy as np
 
 from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
 
 
 class ServingError(Exception):
@@ -149,12 +150,13 @@ class _Request:
 
   __slots__ = ('features', 'n', 'enqueue_time', 'event', 'outputs', 'error',
                'model_version', 'request_id', 'traced', 'queued_wall',
-               'on_done')
+               'on_done', 'trace')
 
   def __init__(self, features: Dict[str, np.ndarray], n: int,
                enqueue_time: float, request_id: str = '',
                traced: bool = False,
-               on_done: Optional[Callable[['_Request'], None]] = None):
+               on_done: Optional[Callable[['_Request'], None]] = None,
+               trace: Optional[tracing.TraceContext] = None):
     self.features = features
     self.n = n
     self.enqueue_time = enqueue_time
@@ -164,6 +166,10 @@ class _Request:
     self.model_version: int = -1
     self.request_id = request_id
     self.traced = traced
+    # Cross-process trace context (trace id + the upstream hop's span
+    # id): a request carrying one records request/queued/dispatch spans
+    # into the process span index (/tracez) under the fleet-wide trace.
+    self.trace = trace
     # Completion hook (router SLO accounting): invoked on the dispatcher
     # thread after the result is published, holding no batcher lock.
     self.on_done = on_done
@@ -467,6 +473,10 @@ class DynamicBatcher:
     self._req_seq = itertools.count(1)
     self._id_prefix = f'r{os.getpid():x}'
     self._postmortem_dir = postmortem_dir
+    # Fleet-timeline label for this batcher's spans (the serving server
+    # stamps 'replica-<port>' / the model name at start); None falls
+    # back to the process-wide tracing.service().
+    self.service_label: Optional[str] = None
     # Bounded sampled slow-request log: top-k completed requests by
     # latency, surfaced in /statz so a p99 outlier names its request.
     self._slow_k = max(0, int(slow_request_log_size))
@@ -613,7 +623,8 @@ class DynamicBatcher:
 
   def submit(self, features: Dict[str, np.ndarray],
              request_id: Optional[str] = None,
-             on_done: Optional[Callable[['_Request'], None]] = None
+             on_done: Optional[Callable[['_Request'], None]] = None,
+             trace: Optional[tracing.TraceContext] = None
              ) -> ServingFuture:
     """Queues one client's examples; returns a future for the batched
     dispatch. ``features`` values carry a leading batch dim and share
@@ -624,7 +635,12 @@ class DynamicBatcher:
     ``request_id`` (e.g. an ingress ``X-Request-Id``) labels the request
     through the latency exemplars, the slow-request log, and — for
     sampled requests — its flight-ring lifecycle trace; omitted, a
-    process-unique one is generated (``ServingFuture.request_id``)."""
+    process-unique one is generated (``ServingFuture.request_id``).
+    ``trace`` (a :class:`~tensor2robot_tpu.observability.tracing.
+    TraceContext` from an ingress ``traceparent`` header) additionally
+    records the request's spans into the process ``/tracez`` index
+    under the fleet-wide trace id — and implies a full lifecycle trace
+    regardless of ``request_trace_sample`` (the client asked)."""
     features = self._validate(features)
     sizes = {np.shape(v)[0] if np.ndim(v) else 1 for v in features.values()}
     if len(sizes) != 1:
@@ -635,9 +651,10 @@ class DynamicBatcher:
           f'request batch {n} outside [1, max_batch={self._max_batch}]')
     seq = next(self._req_seq)
     rid = request_id if request_id else f'{self._id_prefix}-{seq}'
-    traced = bool(self._trace_every) and seq % self._trace_every == 0
+    traced = (trace is not None or
+              (bool(self._trace_every) and seq % self._trace_every == 0))
     request = _Request(features, int(n), self._clock(), request_id=rid,
-                       traced=traced, on_done=on_done)
+                       traced=traced, on_done=on_done, trace=trace)
     if traced:
       request.queued_wall = time.time()
     with self._cond:
@@ -769,16 +786,21 @@ class DynamicBatcher:
     # dispatch, not per request), keeping full-sample tracing within
     # the bench-pinned 3% overhead budget.
     traced = [r for r in batch if r.traced]
+    ctx_traced = [r for r in batch if r.trace is not None]
     prefix = self._metrics_prefix
+    assembled_wall = time.time() if traced else 0.0
     if traced:
       assembled = f' batch={len(batch)} total={total}'
       entries = [('request', f'{prefix}/queued',
-                  f'id={r.request_id} n={r.n}', r.queued_wall)
+                  f'id={r.request_id} n={r.n}'
+                  + (f' trace={r.trace.trace_id}' if r.trace else ''),
+                  r.queued_wall)
                  for r in traced]
       entries.extend(('request', f'{prefix}/assembled',
                       'id=' + r.request_id + assembled) for r in traced)
       flight.events_many(entries)
     t0 = self._clock()
+    bucket = total  # refined below; pre-bound for the error path
     try:
       if len(batch) == 1:
         features = batch[0].features
@@ -832,6 +854,40 @@ class DynamicBatcher:
                f'id={request.request_id} latency_ms={latency_ms:.3f} '
                f'error={int(request.error is not None)}'))
       flight.events_many(returned_events)
+      if ctx_traced:
+        # Spans under the fleet-wide trace id, batched into the process
+        # span index with ONE ring lock (flight-events discipline): the
+        # request span parents on the upstream hop's span id, its
+        # queued/dispatch children decompose where the time went.
+        now_wall = time.time()
+        span_dicts = []
+        for request in ctx_traced:
+          trace_id = request.trace.trace_id
+          request_span = tracing.mint_span_id()
+          error = int(request.error is not None)
+          span_dicts.append({
+              'trace_id': trace_id, 'span_id': request_span,
+              'parent_id': request.trace.span_id,
+              'name': f'{prefix}/request', 'kind': 'serving',
+              'start': request.queued_wall, 'end': now_wall,
+              'request_id': request.request_id,
+              'detail': (f'n={request.n} version={request.model_version} '
+                         f'error={error}')})
+          span_dicts.append({
+              'trace_id': trace_id, 'span_id': tracing.mint_span_id(),
+              'parent_id': request_span,
+              'name': f'{prefix}/queued', 'kind': 'serving',
+              'start': request.queued_wall, 'end': assembled_wall,
+              'request_id': request.request_id,
+              'detail': f'batch={len(batch)} total={total}'})
+          span_dicts.append({
+              'trace_id': trace_id, 'span_id': tracing.mint_span_id(),
+              'parent_id': request_span,
+              'name': f'{prefix}/dispatch', 'kind': 'serving',
+              'start': assembled_wall, 'end': now_wall,
+              'request_id': request.request_id,
+              'detail': f'bucket={bucket}'})
+        tracing.record_spans(span_dicts, service_label=self.service_label)
       for request in batch:
         request.event.set()
         if request.on_done is not None:

@@ -191,29 +191,44 @@ def router_submit_fn(router, model_fn: Optional[Callable[[int], str]] = None,
   return submit
 
 
-def http_submit_fn(host: str, port: int, timeout: float = 30.0) -> Callable:
+def http_submit_fn(host: str, port: int, timeout: float = 30.0,
+                   trace_sample: float = 0.0) -> Callable:
   """Closed-loop submit(features) -> outputs over HTTP (keep-alive)."""
-  open_submit = http_open_submit_fn(host, port, timeout=timeout)
+  open_submit = http_open_submit_fn(host, port, timeout=timeout,
+                                    trace_sample=trace_sample)
+  seq = itertools.count()
 
   def submit(features):
-    return open_submit(0, features, None)
+    return open_submit(next(seq), features, None)
 
   return submit
 
 
 def http_open_submit_fn(host: str, port: int,
                         model_fn: Optional[Callable[[int], str]] = None,
-                        timeout: float = 30.0) -> Callable:
+                        timeout: float = 30.0,
+                        trace_sample: float = 0.0) -> Callable:
   """Open-loop submit(index, features, priority) over HTTP.
 
   Per-thread keep-alive connections; named models route to
   ``/v1/models/<name>/predict`` and the priority class rides the
   ``X-Priority`` header (the balancer forwards both, plus
   ``X-Request-Id``). A 503 raises :class:`ShedError`.
+
+  ``trace_sample`` mints a fresh ``traceparent`` context (trace id +
+  root span id) on every Nth request — the loadgen is the fleet's trace
+  ingress, so a sampled request's balancer hop, failed/succeeded
+  backend attempts, and batcher lifecycle all record spans under ONE
+  trace id, assemblable with ``tools/assemble_trace.py``.
   """
   import http.client
   import json
 
+  from tensor2robot_tpu.observability import tracing
+
+  if not 0.0 <= float(trace_sample) <= 1.0:
+    raise ValueError(f'trace_sample must be in [0, 1], got {trace_sample!r}')
+  trace_every = (int(round(1.0 / trace_sample)) if trace_sample > 0 else 0)
   local = threading.local()
 
   def submit(index, features, priority):
@@ -226,6 +241,10 @@ def http_open_submit_fn(host: str, port: int,
     headers = {'Content-Type': 'application/json'}
     if priority:
       headers['X-Priority'] = priority
+    if trace_every and index % trace_every == 0:
+      headers[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(
+          tracing.TraceContext(tracing.mint_trace_id(),
+                               tracing.mint_span_id()))
     body = json.dumps({
         'features': {k: np.asarray(v).tolist() for k, v in features.items()}
     })

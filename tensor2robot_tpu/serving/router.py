@@ -221,8 +221,8 @@ class ModelRouter:
              features: Dict[str, Any],
              model: Optional[str] = None,
              priority: str = INTERACTIVE,
-             request_id: Optional[str] = None
-             ) -> batching_lib.ServingFuture:
+             request_id: Optional[str] = None,
+             trace=None) -> batching_lib.ServingFuture:
     """Admission → paging → the model's batcher.
 
     Raises :class:`~tensor2robot_tpu.serving.batching.RequestError` for
@@ -252,7 +252,7 @@ class ModelRouter:
             retry_after_secs=self._retry_after)
     self._touch_and_page(entry)
     return entry.batcher.submit(
-        features, request_id=request_id,
+        features, request_id=request_id, trace=trace,
         on_done=self._completion_hook(priority))
 
   def _completion_hook(self, priority: str) -> Callable:

@@ -49,10 +49,14 @@ import json
 import logging
 import math
 import threading
+import time
+import urllib.parse
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.observability import slo as slo_lib
+from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.serving import batching as batching_lib
 
 _MODELS_PREFIX = '/v1/models/'
@@ -86,7 +90,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
       pass  # client gave up; the batch result is already accounted
 
   def do_GET(self):  # noqa: N802 - stdlib naming
-    path = self.path.split('?', 1)[0].rstrip('/') or '/'
+    parsed = urllib.parse.urlparse(self.path)
+    path = parsed.path.rstrip('/') or '/'
+    query = urllib.parse.parse_qs(parsed.query)
     router = self.server.router  # type: ignore[attr-defined]
     batcher = self.server.batcher  # type: ignore[attr-defined]
     if path == '/healthz':
@@ -100,12 +106,21 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                           'model_version': batcher.model_version})
     elif path == '/statz':
       plane = router if router is not None else batcher
-      self._reply(200, plane.report())
+      doc = plane.report()
+      engine = slo_lib.global_engine()
+      if engine is not None:
+        doc['slo'] = engine.report()
+      self._reply(200, doc)
+    elif path == '/tracez':
+      self._reply(200, tracing.tracez_document(
+          trace_id=query.get('trace_id', [None])[0] or None,
+          request_id=query.get('request_id', [None])[0] or None,
+          probe_only=query.get('probe', [''])[0] not in ('', '0')))
     else:
       self._reply(404, {'error': f'unknown path {path!r}',
                         'endpoints': ['/v1/predict',
                                       '/v1/models/<name>/predict',
-                                      '/healthz', '/statz']})
+                                      '/healthz', '/statz', '/tracez']})
 
   def _route(self, path: str) -> Optional[str]:
     """Predict path → model name ('' = default) or None (not predict)."""
@@ -123,10 +138,30 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     # trace convention) or let the batcher mint one; either way it is
     # echoed on EVERY reply below so the client can quote it.
     request_id = (self.headers.get('X-Request-Id') or '').strip() or None
+    # Ingress trace context: a traceparent header puts this request's
+    # ingress span (and the batcher's request/queued/dispatch spans
+    # below it) into the process /tracez index under the fleet-wide
+    # trace id — every status, including sheds: the failed replica of a
+    # retried request must show up in the assembled timeline.
+    ctx = tracing.parse_traceparent(
+        self.headers.get(tracing.TRACEPARENT_HEADER))
+    ingress_start = time.time() if ctx else 0.0
+    ingress_span = tracing.mint_span_id() if ctx else ''
+
+    def reply(code, payload, request_id=None, **kwargs):
+      self._reply(code, payload, request_id=request_id, **kwargs)
+      if ctx is not None:
+        tracing.record_span(
+            'server/request', 'server', ctx.trace_id, ingress_span,
+            ctx.span_id, ingress_start, time.time(),
+            request_id=request_id or '',
+            detail=f'status={code} path={path}',
+            service_label=getattr(self.server, 'service_label', None))
+
     model = self._route(path)
     if model is None:
-      self._reply(404, {'error': f'unknown path {path!r}'},
-                  request_id=request_id)
+      reply(404, {'error': f'unknown path {path!r}'},
+            request_id=request_id)
       return
     priority = (self.headers.get('X-Priority') or '').strip() or None
     try:
@@ -137,52 +172,55 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         raise ValueError('body must carry a non-empty feature dict')
       features = {k: np.asarray(v) for k, v in raw.items()}
     except (ValueError, TypeError) as e:
-      self._reply(400, {'error': f'malformed request: {e}'},
-                  request_id=request_id)
+      reply(400, {'error': f'malformed request: {e}'},
+            request_id=request_id)
       return
     router = self.server.router  # type: ignore[attr-defined]
+    child_ctx = (tracing.TraceContext(ctx.trace_id, ingress_span)
+                 if ctx is not None else None)
     try:
       if router is not None:
         future = router.submit(
             features, model=model or None,
-            priority=priority or 'interactive', request_id=request_id)
+            priority=priority or 'interactive', request_id=request_id,
+            trace=child_ctx)
       else:
         if model or (priority not in (None, 'interactive')):
           # A single-model plane has no router: a named model or a
           # non-default priority class is a contract the caller holds
           # that this server cannot honor — fail loudly, don't ignore.
-          self._reply(
+          reply(
               404 if model else 400,
               {'error': 'this server fronts a single model with no '
                         'admission classes (no router configured)'},
               request_id=request_id)
           return
         future = self.server.batcher.submit(  # type: ignore[attr-defined]
-            features, request_id=request_id)
+            features, request_id=request_id, trace=child_ctx)
     except batching_lib.SheddedError as e:
-      self._reply(503, {'error': str(e), 'shed': True},
-                  request_id=request_id,
-                  retry_after_secs=e.retry_after_secs)
+      reply(503, {'error': str(e), 'shed': True},
+            request_id=request_id,
+            retry_after_secs=e.retry_after_secs)
       return
     except batching_lib.OverloadedError as e:
-      self._reply(503, {'error': str(e)}, request_id=request_id,
-                  retry_after_secs=1.0)
+      reply(503, {'error': str(e)}, request_id=request_id,
+            retry_after_secs=1.0)
       return
     except batching_lib.RequestError as e:
-      self._reply(400, {'error': str(e)}, request_id=request_id)
+      reply(400, {'error': str(e)}, request_id=request_id)
       return
     request_id = future.request_id
     timeout = self.server.request_timeout_secs  # type: ignore[attr-defined]
     try:
       outputs = future.result(timeout=timeout)
     except TimeoutError as e:
-      self._reply(504, {'error': str(e)}, request_id=request_id)
+      reply(504, {'error': str(e)}, request_id=request_id)
       return
     except batching_lib.ServingError as e:
-      self._reply(500, {'error': str(e)}, request_id=request_id)
+      reply(500, {'error': str(e)}, request_id=request_id)
       return
     examples = next(iter(outputs.values())).shape[0] if outputs else 0
-    self._reply(200, {
+    reply(200, {
         'outputs': {k: np.asarray(v).tolist() for k, v in outputs.items()},
         'model_version': future.model_version,
         'examples': int(examples),
@@ -276,6 +314,17 @@ class ServingServer:
     self._httpd.router = self._router  # type: ignore[attr-defined]
     self._httpd.request_timeout_secs = (  # type: ignore[attr-defined]
         self._request_timeout_secs)
+    # Fleet-timeline attribution: this replica's spans (ingress + its
+    # batchers') carry one service label, so an assembled cross-process
+    # trace names WHICH replica served (or refused) each hop — even when
+    # several replicas share one test process and its span index.
+    service = f'replica-{self.port}'
+    self._httpd.service_label = service  # type: ignore[attr-defined]
+    if self._router is not None:
+      for name in self._router.models():
+        self._router.batcher(name).service_label = service
+    else:
+      self._batcher.service_label = service
     self._thread = threading.Thread(
         target=self._httpd.serve_forever, kwargs={'poll_interval': 0.2},
         daemon=True, name='t2r-serving-http')

@@ -1,0 +1,634 @@
+"""Fleet observability drills: cross-process trace assembly, the SLO
+burn-rate engine, and the anomaly watch.
+
+The three legs of ISSUE 12, each drilled end to end:
+
+* **Tracing** — a traceparent-carrying request through a real balancer
+  + 2-replica fleet (including a forced backend failover) yields, via
+  ``tools/assemble_trace.py``, ONE merged timeline with balancer,
+  failed-backend, and succeeded-backend spans under one trace id,
+  causally ordered; a fake fleet with injected asymmetric clock skew
+  stays causally ordered after probe-based offset correction.
+* **SLO** — an injected overload burns the best-effort availability
+  budget: the fast-window burn alert lands in the flight ring and
+  ``/statz``, and exactly ONE rate-limited live bundle is written.
+* **Anomaly** — an injected latency regression on the time-series ring
+  is flagged within 2 detector windows with zero false positives on
+  the steady segment, and escalates to a live bundle.
+
+Marker: ``obs`` (tier-1; ``tools/run_tier1.sh -m obs`` selects).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.observability import anomaly as anomaly_lib
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import metricsz
+from tensor2robot_tpu.observability import postmortem as postmortem_lib
+from tensor2robot_tpu.observability import slo as slo_lib
+from tensor2robot_tpu.observability import timeseries
+from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.predictors import AbstractPredictor, CheckpointPredictor
+from tensor2robot_tpu.serving import balancer as balancer_lib
+from tensor2robot_tpu.serving import batching as batching_lib
+from tensor2robot_tpu.serving import loadgen
+from tensor2robot_tpu.serving import router as router_lib
+from tensor2robot_tpu.serving import server as server_lib
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+from tools import assemble_trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+  """Process-global surfaces (flight ring, span index, postmortem rate
+  limits, global SLO engine) start each drill on a clean slate."""
+  flight.recorder().clear()
+  flight.set_enabled(True)
+  tracing.span_index().clear()
+  postmortem_lib._reset_rate_limit_for_tests()
+  slo_lib.set_global_engine(None)
+  yield
+  slo_lib.set_global_engine(None)
+  timeseries.stop_global()
+
+
+def _loaded_predictor(hidden_size: int = 16):
+  predictor = CheckpointPredictor(
+      MockT2RModel(device_type='tpu', hidden_size=hidden_size),
+      model_dir='/nonexistent')
+  predictor.init_randomly()
+  return predictor
+
+
+def _features(value: float, n: int = 1):
+  return {'measured_position': np.full((n, 2), value, np.float32)}
+
+
+class _GatedPredictor(AbstractPredictor):
+  """Dispatch blocks on an event: deterministic queue backlogs."""
+
+  def __init__(self, release: threading.Event):
+    self._release = release
+
+  def predict(self, features):
+    self._release.wait(timeout=30.0)
+    return {'echo': np.asarray(features['measured_position'])}
+
+  def get_feature_specification(self):
+    spec = SpecStruct()
+    spec['measured_position'] = TensorSpec(shape=(2,), dtype=np.float32,
+                                           name='measured_position')
+    return spec
+
+  def restore(self):
+    return True
+
+  @property
+  def is_loaded(self):
+    return True
+
+  @property
+  def global_step(self):
+    return 1
+
+
+# ------------------------------------------------------------ trace context
+
+
+class TestTraceContext:
+
+  def test_traceparent_round_trip(self):
+    ctx = tracing.TraceContext(tracing.mint_trace_id(),
+                               tracing.mint_span_id())
+    header = tracing.format_traceparent(ctx)
+    assert re.fullmatch(r'00-[0-9a-f]{32}-[0-9a-f]{16}-01', header)
+    assert tracing.parse_traceparent(header) == ctx
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+  def test_malformed_headers_parse_to_none(self):
+    for bad in (None, '', 'garbage', '00-abc-def-01',
+                '00-' + 'g' * 32 + '-' + '0' * 16 + '-01',
+                '00-' + '0' * 32 + '-' + 'a' * 16 + '-01'):
+      assert tracing.parse_traceparent(bad) is None
+
+
+class TestSpanIndex:
+
+  def test_ring_is_bounded_and_filters(self):
+    index = tracing.SpanIndex(capacity=8)
+    for i in range(20):
+      index.record({'trace_id': f't{i % 2}', 'span_id': f's{i}',
+                    'parent_id': '', 'name': 'x', 'kind': 'k',
+                    'start': float(i), 'end': float(i) + 0.5,
+                    'request_id': f'r{i}', 'detail': ''})
+    assert index.recorded == 20
+    assert len(index.spans()) == 8  # last 8 only
+    t0 = index.spans(trace_id='t0')
+    assert t0 and all(s['trace_id'] == 't0' for s in t0)
+    assert [s['request_id'] for s in index.spans(request_id='r19')] == \
+        ['r19']
+
+  def test_tracez_served_from_metricsz_endpoint(self):
+    trace_id = tracing.mint_trace_id()
+    tracing.record_span('unit/span', 'test', trace_id,
+                        tracing.mint_span_id(), '', 1.0, 2.0,
+                        request_id='rq-1')
+    server = metricsz.MetricsServer(port=0).start()
+    try:
+      base = f'http://127.0.0.1:{server.port}'
+      with urllib.request.urlopen(base + '/tracez?probe=1',
+                                  timeout=10) as response:
+        probe = json.loads(response.read())
+      assert probe['kind'] == 'tracez' and 'now' in probe
+      assert 'spans' not in probe  # probes stay cheap
+      with urllib.request.urlopen(
+          base + f'/tracez?trace_id={trace_id}', timeout=10) as response:
+        doc = json.loads(response.read())
+      assert [s['name'] for s in doc['spans']] == ['unit/span']
+      assert doc['spans'][0]['request_id'] == 'rq-1'
+    finally:
+      server.close()
+
+
+# ------------------------------------------- the fleet trace acceptance drill
+
+
+def test_fleet_trace_drill_with_forced_failover(tmp_path):
+  """One traced open-loop request through the balancer to a 2-replica
+  fleet, with replica A forced to refuse (503, queue full): the
+  assembled timeline contains balancer, failed-backend, and
+  succeeded-backend spans under ONE trace id, causally ordered after
+  clock-offset correction."""
+  release = threading.Event()
+  replica_a = server_lib.ServingServer(
+      _GatedPredictor(release), max_batch=1, batch_deadline_ms=1.0,
+      max_queue=1, metrics_prefix='serving/ftrace_a',
+      register_report=False).start()
+  replica_b = server_lib.ServingServer(
+      _loaded_predictor(), max_batch=4, batch_deadline_ms=1.0,
+      metrics_prefix='serving/ftrace_b', register_report=False).start()
+  inflight = []
+  try:
+    # Fill replica A: one request in flight (gated), one in the queue —
+    # the next arrival gets 503 (OverloadedError), which is the forced
+    # failover the drill requires.
+    inflight.append(replica_a.batcher.submit(_features(0.0)))
+    deadline = time.monotonic() + 10.0
+    while replica_a.batcher.queue_depth > 0 and time.monotonic() < deadline:
+      time.sleep(0.01)
+    inflight.append(replica_a.batcher.submit(_features(0.0)))
+    with balancer_lib.Balancer(
+        [('127.0.0.1', replica_a.port), ('127.0.0.1', replica_b.port)],
+        register_report=False) as balancer:
+      ctx = tracing.TraceContext(tracing.mint_trace_id(),
+                                 tracing.mint_span_id())
+      request = urllib.request.Request(
+          balancer.url + '/v1/predict',
+          data=json.dumps({'features': {
+              'measured_position': [[0.1, 0.2]]}}).encode(),
+          headers={'Content-Type': 'application/json',
+                   'X-Request-Id': 'fleet-trace-1',
+                   'traceparent': tracing.format_traceparent(ctx)})
+      with urllib.request.urlopen(request, timeout=30) as response:
+        body = json.loads(response.read())
+      assert body['request_id'] == 'fleet-trace-1'
+      release.set()
+      for future in inflight:
+        future.result(30.0)
+
+      endpoints = [balancer.port, replica_a.port, replica_b.port]
+      processes = [assemble_trace.fetch_process(
+          '127.0.0.1', port, trace_id=ctx.trace_id)
+          for port in endpoints]
+      # --request resolution finds the same trace fleet-wide.
+      assert assemble_trace.resolve_trace_id(
+          processes, 'fleet-trace-1') == ctx.trace_id
+      assembled = assemble_trace.assemble(processes, ctx.trace_id)
+
+      spans = assembled['spans']
+      assert spans and all(s['trace_id'] == ctx.trace_id for s in spans)
+      by_name = {}
+      for span in spans:
+        by_name.setdefault(span['name'], []).append(span)
+      # Balancer: one proxy span + one attempt per backend tried.
+      assert len(by_name['balancer/proxy']) == 1
+      attempts = by_name['balancer/attempt']
+      assert sorted(d.split()[-1] for d in
+                    (a['detail'] for a in attempts)) == \
+          ['status=200', 'status=503']
+      # The FAILED backend recorded its refusal under the same trace...
+      ingress = by_name['server/request']
+      failed = [s for s in ingress if 'status=503' in s['detail']]
+      succeeded = [s for s in ingress if 'status=200' in s['detail']]
+      assert len(failed) == 1 and len(succeeded) == 1
+      assert failed[0]['service'] == f'replica-{replica_a.port}'
+      assert succeeded[0]['service'] == f'replica-{replica_b.port}'
+      # ...and the succeeded backend's batcher decomposed the serve.
+      assert by_name['serving/ftrace_b/request'][0]['request_id'] == \
+          'fleet-trace-1'
+      assert 'serving/ftrace_b/queued' in by_name
+      assert 'serving/ftrace_b/dispatch' in by_name
+      # Causally ordered after offset correction: children never start
+      # before their parents (tolerance = scraped error bounds).
+      tolerance = max(p['error_bound'] for p in assembled['processes'])
+      assert assemble_trace.causal_violations(
+          assembled, tolerance_secs=tolerance) == []
+      # The balancer hop precedes each backend's ingress.
+      by_id = {s['span_id']: s for s in spans}
+      for span in ingress:
+        parent = by_id[span['parent_id']]
+        assert parent['name'] == 'balancer/attempt'
+        assert span['start'] >= parent['start'] - tolerance
+
+      # Renderings: text names every service; Chrome JSON loads.
+      text = assemble_trace.render_text(assembled)
+      assert 'balancer/proxy' in text and 'server/request' in text
+      chrome = assemble_trace.chrome_trace(assembled)
+      names = {e['name'] for e in chrome['traceEvents'] if e['ph'] == 'X'}
+      assert 'balancer/proxy' in names
+      path = tmp_path / 'trace.json'
+      path.write_text(json.dumps(chrome))
+      assert json.loads(path.read_text())['metadata']['trace_id'] == \
+          ctx.trace_id
+  finally:
+    release.set()
+    replica_a.close()
+    replica_b.close()
+
+
+def test_trace_assembly_corrects_asymmetric_clock_skew():
+  """Fake 3-process fleet with injected asymmetric skew: probe-based
+  offsets leave residual error (≤ the probe bound); the causal
+  refinement pass absorbs it, keeping child spans inside their parents
+  and the balancer hop before each backend ingress."""
+  trace_id = 'ab' * 16
+  base = 1_700_000_000.0
+
+  def span(span_id, parent_id, name, start, end, skew):
+    return {'trace_id': trace_id, 'span_id': span_id,
+            'parent_id': parent_id, 'name': name, 'kind': 'test',
+            'start': base + start + skew, 'end': base + end + skew,
+            'request_id': 'rq', 'detail': ''}
+
+  processes = [
+      {'endpoint': 'bal', 'service': 'balancer', 'offset': 0.0,
+       'error_bound': 0.001, 'spans': [
+           span('p', 'root', 'balancer/proxy', 0.000, 0.060, 0.0),
+           span('a1', 'p', 'balancer/attempt', 0.001, 0.012, 0.0),
+           span('a2', 'p', 'balancer/attempt', 0.013, 0.058, 0.0),
+       ]},
+      # Replica A: clock +5 s; the probe estimate overshoots by 4 ms
+      # (asymmetric path), which UNCORRECTED puts its ingress 2 ms
+      # before the balancer attempt that caused it.
+      {'endpoint': 'a', 'service': 'replica-a', 'offset': 5.004,
+       'error_bound': 0.006, 'spans': [
+           span('iA', 'a1', 'server/request', 0.003, 0.010, 5.0),
+       ]},
+      # Replica B: clock −3 s; estimate undershoots by 3 ms.
+      {'endpoint': 'b', 'service': 'replica-b', 'offset': -2.997,
+       'error_bound': 0.004, 'spans': [
+           span('iB', 'a2', 'server/request', 0.015, 0.055, -3.0),
+           span('rB', 'iB', 'serving/request', 0.018, 0.054, -3.0),
+       ]},
+  ]
+  assembled = assemble_trace.assemble(processes, trace_id)
+  assert assemble_trace.causal_violations(
+      assembled, tolerance_secs=1e-9) == []
+  by_id = {s['span_id']: s for s in assembled['spans']}
+  # Balancer hop before backend ingress, per backend.
+  assert by_id['iA']['start'] >= by_id['a1']['start'] - 1e-9
+  assert by_id['iB']['start'] >= by_id['a2']['start'] - 1e-9
+  # The batcher span stays inside its ingress parent (same process —
+  # refinement shifts a process rigidly, preserving local order).
+  assert by_id['rB']['start'] >= by_id['iB']['start']
+  assert by_id['rB']['end'] <= by_id['iB']['end']
+  # Refinement never spends more than each probe's own error bound.
+  for proc, original in zip(assembled['processes'], processes):
+    residual = abs(proc['offset_applied'] - (0.0 - original['offset']))
+    assert residual <= original['error_bound'] + 1e-12
+
+
+def test_loadgen_trace_sample_mints_traceparent():
+  replica = server_lib.ServingServer(
+      _loaded_predictor(), max_batch=4, batch_deadline_ms=1.0,
+      metrics_prefix='serving/lg_trace', register_report=False).start()
+  try:
+    submit = loadgen.http_submit_fn('127.0.0.1', replica.port,
+                                    trace_sample=1.0)
+    for i in range(3):
+      submit(_features(0.01 * (i + 1)))
+    spans = tracing.spans()
+    request_spans = [s for s in spans
+                     if s['name'] == 'serving/lg_trace/request']
+    assert len(request_spans) == 3
+    assert len({s['trace_id'] for s in request_spans}) == 3  # fresh per req
+  finally:
+    replica.close()
+
+
+# ------------------------------------------------------------------ SLO leg
+
+
+class TestSLOEngine:
+
+  def test_availability_burn_rate_and_alert_transitions(self):
+    # Samples spaced 40 ms apart; the 30 ms fast window then spans only
+    # the LAST sample pair while the 200 ms slow window spans the ring.
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=999.0)
+    good = metrics_lib.counter('slounit/class/a/ok')
+    bad = metrics_lib.counter('slounit/class/a/bad')
+    objective = slo_lib.Objective.availability(
+        'unit_availability', good=['slounit/class/a/ok'],
+        bad=['slounit/class/a/bad'], objective=0.9)
+    engine = slo_lib.SLOEngine(
+        [objective], windows=[slo_lib.BurnWindow(0.03, 0.2, 2.0)],
+        recorder=recorder, register_report=False)
+    good.inc(100)
+    recorder.sample()
+    time.sleep(0.04)
+    good.inc(100)
+    recorder.sample()
+    status = engine.evaluate()[0]
+    assert not status['alerting']
+    assert status['windows'][0]['burn_fast'] == 0.0
+    # Fast window (last pair): 50/100 bad = burn 5.0x the 10% budget;
+    # slow window (whole ring): 50/300 bad = burn 2.5x. Both >= 2: alert.
+    time.sleep(0.04)
+    good.inc(50)
+    bad.inc(50)
+    recorder.sample()
+    status = engine.evaluate()[0]
+    assert status['alerting']
+    assert status['windows'][0]['burn_fast'] == pytest.approx(5.0)
+    assert status['windows'][0]['burn_slow'] == pytest.approx(2.5)
+    events = flight.events(kinds=['slo'])
+    assert any('unit_availability/burn_alert' in e['name'] for e in events)
+    # Recovery clears (edge events both ways, no re-alert spam).
+    time.sleep(0.04)
+    good.inc(500)
+    recorder.sample()
+    status = engine.evaluate()[0]
+    assert not status['alerting']
+    assert any('unit_availability/burn_clear' in e['name']
+               for e in flight.events(kinds=['slo']))
+
+  def test_latency_threshold_objective_uses_windowed_buckets(self):
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=999.0)
+    hist = metrics_lib.histogram('slounit/latency_ms')
+    objective = slo_lib.Objective.latency(
+        'unit_latency', histogram='slounit/latency_ms',
+        threshold_ms=64.0, objective=0.9)
+    engine = slo_lib.SLOEngine(
+        [objective], windows=[slo_lib.BurnWindow(0.03, 0.2, 2.0)],
+        recorder=recorder, register_report=False)
+    for _ in range(20):
+      hist.observe(10.0)  # well under threshold
+    recorder.sample()
+    time.sleep(0.04)
+    for _ in range(10):
+      hist.observe(10.0)
+    recorder.sample()
+    assert not engine.evaluate()[0]['alerting']
+    # Regression: half the fast window's observations over threshold =
+    # burn 5x the 10% budget (slow window dilutes to 10/30 = 3.3x).
+    time.sleep(0.04)
+    for _ in range(10):
+      hist.observe(10.0)
+    for _ in range(10):
+      hist.observe(500.0)
+    recorder.sample()
+    status = engine.evaluate()[0]
+    assert status['alerting']
+    assert status['windows'][0]['burn_fast'] == pytest.approx(5.0)
+
+  def test_slo_overload_drill(self, tmp_path):
+    """Injected overload burns the best-effort availability budget →
+    fast-window alert as a flight event and in /statz, and exactly ONE
+    rate-limited live bundle."""
+    release = threading.Event()
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=999.0)
+    prefix = 'serving/slodrill'
+    router = router_lib.ModelRouter(
+        {'m': _GatedPredictor(release)}, max_batch=1,
+        batch_deadline_ms=1.0, max_queue=8, shed_queue_fraction=0.25,
+        metrics_prefix=prefix, register_report=False)
+    engine = slo_lib.SLOEngine(
+        slo_lib.serving_objectives(prefix=prefix,
+                                   best_effort_objective=0.9),
+        windows=[slo_lib.BurnWindow(1.0, 4.0, 5.0)],
+        recorder=recorder, postmortem_dir=str(tmp_path),
+        register_report=False)
+    slo_lib.set_global_engine(engine)
+    server = server_lib.ServingServer(router=router).start()
+    blocked = []
+    try:
+      # Healthy best-effort baseline.
+      release.set()
+      for _ in range(10):
+        router.submit(_features(0.1),
+                      priority='best_effort').result(30.0)
+      recorder.sample()
+      assert not any(s['alerting'] for s in engine.evaluate())
+
+      # Overload: gate the dispatcher, back the queue up past shed_at,
+      # then offer best-effort traffic — all of it sheds.
+      release.clear()
+      blocked = [router.submit(_features(0.0)) for _ in range(4)]
+      deadline = time.monotonic() + 10.0
+      while (router.batcher('m').queue_depth < router.shed_at and
+             time.monotonic() < deadline):
+        time.sleep(0.01)
+      sheds = 0
+      for _ in range(30):
+        with pytest.raises(batching_lib.SheddedError):
+          router.submit(_features(0.2), priority='best_effort')
+        sheds += 1
+      assert sheds == 30
+      time.sleep(0.005)
+      recorder.sample()
+      statuses = engine.evaluate()
+      best_effort = next(s for s in statuses
+                         if s['name'] == 'best_effort_availability')
+      assert best_effort['alerting'], statuses
+      # Flight event (kind 'slo') fired on the transition.
+      events = flight.events(kinds=['slo'])
+      assert any('best_effort_availability/burn_alert' in e['name']
+                 for e in events)
+      # Visible in /statz through the serving HTTP surface.
+      with urllib.request.urlopen(server.url + '/statz',
+                                  timeout=30) as response:
+        statz = json.loads(response.read())
+      assert 'best_effort_availability' in statz['slo']['alerting']
+      # Exactly one live bundle, despite repeated alerting evaluations.
+      engine.evaluate()
+      engine.evaluate()
+      bundles = list((tmp_path / 'postmortem').glob('*.json'))
+      assert len(bundles) == 1, bundles
+      bundle = json.loads(bundles[0].read_text())
+      assert bundle['live'] is True
+      assert bundle['reason'] == 'slo_burn_best_effort_availability'
+      assert bundle['extra']['slo']['alerting'] is True
+    finally:
+      release.set()
+      for future in blocked:
+        try:
+          future.result(30.0)
+        except batching_lib.ServingError:
+          pass
+      server.close()
+
+
+# -------------------------------------------------------------- anomaly leg
+
+
+class TestAnomalyWatch:
+
+  def test_detector_flags_regression_not_steady_noise(self):
+    detector = anomaly_lib.RobustDetector(k=6.0, min_history=5)
+    for i in range(30):
+      assert detector.observe(10.0 + 0.2 * (i % 3)) is None
+    record = detector.observe(200.0)
+    assert record is not None
+    assert record['value'] == 200.0
+    assert record['deviation'] > record['threshold']
+    # A sustained regression keeps flagging (quarantined from the
+    # baseline) until the rebaseline threshold accepts the new regime.
+    flagged = sum(1 for _ in range(4) if detector.observe(210.0))
+    assert flagged == 4
+
+  def test_windowed_histogram_stats(self):
+    prev = {'count': 10, 'sum': 100.0,
+            'buckets': {'4': 10}}           # ten obs in (4, 8]
+    cur = {'count': 14, 'sum': 1300.0,
+           'buckets': {'4': 10, '9': 4}}    # +4 obs in (256, 512]
+    p99 = anomaly_lib.series_value(
+        ('m', 'p99'), (0.0, {'m': prev}), (2.0, {'m': cur}))
+    assert p99 == 512.0
+    mean = anomaly_lib.series_value(
+        ('m', 'mean'), (0.0, {'m': prev}), (2.0, {'m': cur}))
+    assert mean == pytest.approx(300.0)
+    rate = anomaly_lib.series_value(
+        ('m', 'rate'), (0.0, {'m': prev}), (2.0, {'m': cur}))
+    assert rate == pytest.approx(2.0)
+
+  def test_anomaly_drill_latency_regression(self, tmp_path):
+    """Injected latency regression on the time-series ring: flagged
+    within 2 detector windows, zero false positives on the steady
+    segment, escalation writes one live bundle."""
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=999.0)
+    hist = metrics_lib.histogram('fleetobs/latency_ms')
+    watch = anomaly_lib.AnomalyWatch(
+        specs=['fleetobs/latency_ms:p99'], recorder=recorder,
+        postmortem_dir=str(tmp_path), min_history=6,
+        register_report=False)
+    recorder.sample()
+    steady_flags = []
+    for _ in range(10):
+      for value in (7.0, 9.0, 12.0):
+        hist.observe(value)
+      time.sleep(0.005)
+      recorder.sample()
+      steady_flags.extend(watch.poll())
+    assert steady_flags == []  # zero false positives, steady segment
+
+    regression_flags = []
+    for _ in range(2):  # flagged within 2 detector windows
+      for value in (290.0, 300.0, 310.0):
+        hist.observe(value)
+      time.sleep(0.005)
+      recorder.sample()
+      regression_flags.extend(watch.poll())
+    assert regression_flags, 'regression not flagged within 2 windows'
+    record = regression_flags[0]
+    assert record['series'] == 'fleetobs/latency_ms:p99'
+    assert record['value'] == 512.0  # bucketed windowed p99
+    events = flight.events(kinds=['anomaly'])
+    assert any('fleetobs/latency_ms' in e['name'] for e in events)
+    bundles = list((tmp_path / 'postmortem').glob('*.json'))
+    assert len(bundles) == 1, bundles
+    bundle = json.loads(bundles[0].read_text())
+    assert bundle['live'] is True and 'anomaly' in bundle['extra']
+    report = watch.report()
+    assert report['series']['fleetobs/latency_ms:p99']['anomalies'] >= 1
+
+
+# ---------------------------------------------------------------- satellites
+
+
+def test_prom_exposition_carries_openmetrics_exemplars():
+  hist = metrics_lib.histogram('fleetobs/exemplar_ms')
+  hist.observe(3.0, exemplar='req-exemplar-1')
+  text = metricsz.prom_exposition()
+  match = re.search(
+      r'fleetobs_exemplar_ms_bucket\{le="4\.0"\} 1 '
+      r'# \{trace_id="req-exemplar-1"\} 3\.0 \d+\.\d{3}', text)
+  assert match, text[:2000]
+  # JSON snapshot keeps the historical {edge: label} exemplar shape.
+  snap = hist.snapshot()
+  assert snap['exemplars'] == {'4.0': 'req-exemplar-1'}
+
+
+def test_balancer_statz_merges_fleet_slow_requests():
+  replica_a = server_lib.ServingServer(
+      _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+      metrics_prefix='serving/slow_a', register_report=False).start()
+  replica_b = server_lib.ServingServer(
+      _loaded_predictor(), max_batch=8, batch_deadline_ms=1.0,
+      metrics_prefix='serving/slow_b', register_report=False).start()
+  try:
+    with balancer_lib.Balancer(
+        [('127.0.0.1', replica_a.port), ('127.0.0.1', replica_b.port)],
+        register_report=False) as balancer:
+      report = loadgen.run_load(
+          loadgen.http_submit_fn('127.0.0.1', balancer.port),
+          lambda i: _features(0.01 * (i + 1)),
+          num_clients=6, requests_per_client=5)
+      assert report.errors == 0
+      statz = balancer.report()
+      fleet = statz['fleet_slow_requests']
+      assert fleet, statz
+      assert all('backend' in e and 'request_id' in e for e in fleet)
+      latencies = [e['latency_ms'] for e in fleet]
+      assert latencies == sorted(latencies, reverse=True)
+      # With a large k the merge covers BOTH replicas' logs.
+      everyone = balancer.fleet_slow_requests(k=100)
+      assert {e['backend'] for e in everyone} == {
+          f'127.0.0.1:{replica_a.port}', f'127.0.0.1:{replica_b.port}'}
+      # /statz over HTTP carries the same section.
+      with urllib.request.urlopen(balancer.url + '/statz',
+                                  timeout=30) as response:
+        doc = json.loads(response.read())
+      assert doc['fleet_slow_requests']
+  finally:
+    replica_a.close()
+    replica_b.close()
+
+
+def test_live_bundle_renders_with_postmortem_tool(tmp_path, capsys):
+  flight.event('slo', 'slo/demo/burn_alert', 'burn_fast=9.9')
+  path = postmortem_lib.dump(str(tmp_path), 'slo_burn_demo', live=True,
+                             extra={'slo': {'alerting': True}})
+  assert path is not None
+  from tools import postmortem as tool
+
+  assert tool.main([path]) == 0
+  out = capsys.readouterr().out
+  assert 'live forensics bundle' in out
+  assert 'moment of capture' in out
+  assert tool.main([path, '--json']) == 0
+  summary = json.loads(capsys.readouterr().out)
+  assert summary['live'] is True and summary['reason'] == 'slo_burn_demo'

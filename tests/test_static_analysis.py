@@ -819,6 +819,89 @@ def bad(state, batch):
     assert [f.check for f in findings] == ['use-after-donate']
 
 
+# ========================================================= metric cardinality
+
+
+CARDINALITY_BAD = '''
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+
+def handle(request_id, model):
+  metrics_lib.counter(f'requests/{request_id}').inc()      # BAD: param
+  metrics_lib.histogram('latency_' + model).observe(1.0)   # BAD: concat
+  for source in discover_sources():
+    metrics_lib.counter(f'errors/{source}').inc()          # BAD: loop
+
+
+def cache_key(entry):
+  metrics_lib.gauge(f'cache/{entry.key}/bytes').set(0.0)   # BAD: attr
+'''
+
+CARDINALITY_GOOD = '''
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+INTERACTIVE = 'interactive'
+BEST_EFFORT = 'best_effort'
+PRIORITIES = (INTERACTIVE, BEST_EFFORT)
+
+
+class Plane:
+  def __init__(self, metrics_prefix, name):
+    self._metrics_prefix = metrics_prefix
+    s = metrics_lib.scope(self._metrics_prefix + '/quant')
+    self._m_requests = s.counter('requests')
+    for priority in PRIORITIES:
+      s.scope(f'class/{priority}').counter('ok')
+    self._m_burn = metrics_lib.gauge('slo/' + name + '/burn')
+
+  def publish(self):
+    metrics_lib.histogram(f'{self._metrics_prefix}/latency_ms')
+
+
+def publish_windows(process_count):
+  out = {'breakdown/wall_ms': 1.0, 'breakdown/host_wait_ms': 2.0}
+  for key, value in out.items():
+    metrics_lib.gauge(f'trainer/{key}').set(value)
+  for host in range(process_count):
+    metrics_lib.gauge(f'heartbeat/host{host}/age_sec').set(0.0)
+
+
+def budget_charge(budget_name, src):
+  # Allowlisted capped scope: ErrorBudget bounds src to 32 sources.
+  metrics_lib.counter(
+      f'resilience/data_errors/{budget_name}/{src}').inc()
+'''
+
+
+class TestMetricCardinality:
+
+  def test_fires_on_runtime_variable_names(self):
+    findings = _unwaived(_analyze(CARDINALITY_BAD), 'metric-cardinality')
+    assert len(findings) == 4, findings
+    assert all(f.check == 'dynamic-metric-name' for f in findings)
+    symbols = {f.symbol for f in findings}
+    assert symbols == {'handle', 'cache_key'}
+    messages = ' '.join(f.message for f in findings)
+    assert 'request_id' in messages and 'cardinality' in messages
+
+  def test_quiet_on_scope_plumbing_and_bounded_domains(self):
+    # self-attrs, *prefix*/*name* plumbing, loops over module-constant
+    # tuples / range() / constant-keyed dict displays, and the
+    # allowlisted capped resilience scope: all clean.
+    assert _unwaived(_analyze(CARDINALITY_GOOD),
+                     'metric-cardinality') == []
+
+  def test_bare_variable_names_are_not_construction_sites(self):
+    source = '''
+from tensor2robot_tpu.observability import metrics as metrics_lib
+
+
+def counter(name):
+  return metrics_lib.counter(name)   # pass-through helper: not flagged
+'''
+    assert _unwaived(_analyze(source), 'metric-cardinality') == []
+
+
 # ================================================================ gate
 
 

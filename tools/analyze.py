@@ -63,6 +63,7 @@ def _checkers_for(rules):
   from tensor2robot_tpu.analysis import donated_reuse
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
+  from tensor2robot_tpu.analysis import metric_cardinality
   from tensor2robot_tpu.analysis import recompile_hazards
 
   table = {
@@ -72,6 +73,7 @@ def _checkers_for(rules):
       'dead-code': dead_code.check,
       'blocking-under-lock': blocking_under_lock.check,
       'donated-reuse': donated_reuse.check,
+      'metric-cardinality': metric_cardinality.check,
   }
   if not rules:
     return None  # all

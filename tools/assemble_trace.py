@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Assemble one cross-process trace from a fleet's ``/tracez`` indexes.
+
+    python tools/assemble_trace.py --trace <trace_id> \
+        127.0.0.1:9000 127.0.0.1:8001 127.0.0.1:8002
+    python tools/assemble_trace.py --request <request_id> <endpoints...>
+    python tools/assemble_trace.py --trace <id> --chrome trace.json ...
+    python tools/assemble_trace.py --trace <id> --json ...
+
+Each positional argument is one fleet process's HTTP surface (balancer,
+serving replica, or a trainer's ``/metricsz`` server — they all serve
+``GET /tracez``). For every endpoint the tool:
+
+1. **estimates the process's clock offset** from probe round-trips:
+   ``GET /tracez?probe=1`` returns the server's wall clock; against the
+   probe's local send/receive timestamps, ``offset ≈ server_now −
+   (t_send + t_recv)/2`` with error ≤ RTT/2 (the classic NTP bound).
+   The minimum-RTT probe of several wins — its bound is tightest.
+2. **fetches the spans** for the requested trace (or resolves a request
+   id to its trace id first).
+
+Spans are de-duplicated by span id (replicas sharing a process share a
+span index), shifted onto the first endpoint's clock, and **causally
+refined**: a cross-process parent/child pair that still violates
+happens-before after the probe correction (child starting before the
+hop that caused it) pulls its process's offset by the residual — but
+never past the probe's own error bound, so the refinement can only
+spend uncertainty the measurement actually has. The result is one
+merged timeline — balancer proxy span, a failed backend's attempt +
+ingress spans, the succeeded backend's ingress/batcher spans — rendered
+as an indented text tree and/or Chrome-trace JSON (Perfetto-loadable).
+
+Pure stdlib; importable (``from tools import assemble_trace``) so tests
+drive :func:`assemble` on fake fleets with injected skew.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import time
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_REFINE_PASSES = 3
+
+
+# ------------------------------------------------------------------ scraping
+
+
+def _fetch_json(host: str, port: int, path: str,
+                timeout: float = 5.0) -> Dict[str, Any]:
+  conn = http.client.HTTPConnection(host, port, timeout=timeout)
+  try:
+    conn.request('GET', path)
+    response = conn.getresponse()
+    payload = response.read()
+    if response.status != 200:
+      raise RuntimeError(f'{host}:{port}{path} -> HTTP {response.status}')
+    return json.loads(payload)
+  finally:
+    conn.close()
+
+
+def probe_offset(host: str, port: int, probes: int = 5,
+                 timeout: float = 5.0) -> Dict[str, Any]:
+  """Clock offset of ``host:port`` vs the local clock, via ``?probe=1``.
+
+  Returns ``offset`` (add to a local timestamp to get the server's
+  clock; subtract from a server timestamp to map it here), the
+  ``error_bound`` (min-RTT/2), and the server's service/pid labels.
+  """
+  best: Optional[Tuple[float, float, Dict[str, Any]]] = None
+  for _ in range(max(1, probes)):
+    t_send = time.time()
+    doc = _fetch_json(host, port, '/tracez?probe=1', timeout)
+    t_recv = time.time()
+    rtt = max(t_recv - t_send, 0.0)
+    offset = float(doc['now']) - (t_send + t_recv) / 2.0
+    if best is None or rtt < best[0]:
+      best = (rtt, offset, doc)
+  rtt, offset, doc = best
+  return {
+      'offset': offset,
+      'error_bound': rtt / 2.0,
+      'rtt': rtt,
+      'service': doc.get('service', f'{host}:{port}'),
+      'pid': doc.get('pid'),
+  }
+
+
+def fetch_process(host: str, port: int,
+                  trace_id: Optional[str] = None,
+                  request_id: Optional[str] = None,
+                  probes: int = 5,
+                  timeout: float = 5.0) -> Dict[str, Any]:
+  """One endpoint's offset estimate + matching spans."""
+  probe = probe_offset(host, port, probes=probes, timeout=timeout)
+  query = {}
+  if trace_id:
+    query['trace_id'] = trace_id
+  if request_id:
+    query['request_id'] = request_id
+  path = '/tracez'
+  if query:
+    path += '?' + urllib.parse.urlencode(query)
+  doc = _fetch_json(host, port, path, timeout)
+  return {
+      'endpoint': f'{host}:{port}',
+      'service': doc.get('service', f'{host}:{port}'),
+      'pid': doc.get('pid'),
+      'offset': probe['offset'],
+      'error_bound': probe['error_bound'],
+      'spans': doc.get('spans', []),
+  }
+
+
+def resolve_trace_id(processes: Sequence[Dict[str, Any]],
+                     request_id: str) -> Optional[str]:
+  """The (newest) trace id carrying ``request_id`` across the fleet."""
+  best: Optional[Tuple[float, str]] = None
+  for proc in processes:
+    for span in proc['spans']:
+      if span.get('request_id') != request_id or not span.get('trace_id'):
+        continue
+      key = (float(span.get('end', 0.0)), span['trace_id'])
+      if best is None or key > best:
+        best = key
+  return best[1] if best else None
+
+
+# ------------------------------------------------------------------ assembly
+
+
+def assemble(processes: Sequence[Dict[str, Any]],
+             trace_id: str) -> Dict[str, Any]:
+  """Merge the fleet's spans for ``trace_id`` onto one corrected clock.
+
+  ``processes`` entries carry ``endpoint / service / offset /
+  error_bound / spans`` (the :func:`fetch_process` shape; tests build
+  them by hand with injected skew). All spans land on the FIRST
+  process's clock: its offset is the reference, every other process's
+  spans are shifted by the offset difference, then causally refined
+  within each process's error bound.
+  """
+  if not processes:
+    raise ValueError('assemble() needs at least one process')
+  reference_offset = float(processes[0]['offset'])
+  spans: Dict[str, Dict[str, Any]] = {}
+  shifts: Dict[str, float] = {}
+  bounds: Dict[str, float] = {}
+  for proc in processes:
+    endpoint = proc['endpoint']
+    base_shift = reference_offset - float(proc['offset'])
+    for raw in proc['spans']:
+      if raw.get('trace_id') != trace_id:
+        continue
+      span_id = raw.get('span_id')
+      if not span_id or span_id in spans:
+        continue  # replicas sharing a process share a span index
+      span = dict(raw)
+      span['endpoint'] = endpoint
+      spans[span_id] = span
+    if endpoint not in shifts:
+      shifts[endpoint] = base_shift
+      bounds[endpoint] = float(proc.get('error_bound', 0.0))
+
+  def corrected(span: Dict[str, Any], field: str) -> float:
+    return float(span[field]) + shifts[span['endpoint']]
+
+  # Causal refinement: a child that still starts before its cross-
+  # process parent after probe correction exposes residual offset
+  # error; pull the child's process forward by the residual, clamped to
+  # its probe error bound (never invent precision the probe lacks).
+  edges = [(spans[s['parent_id']], s) for s in spans.values()
+           if s.get('parent_id') in spans
+           and spans[s['parent_id']]['endpoint'] != s['endpoint']]
+  spent: Dict[str, float] = {e: 0.0 for e in shifts}
+  for _ in range(_REFINE_PASSES):
+    adjusted = False
+    for parent, child in edges:
+      endpoint = child['endpoint']
+      violation = corrected(parent, 'start') - corrected(child, 'start')
+      if violation <= 0:
+        continue
+      headroom = bounds[endpoint] - spent[endpoint]
+      shift = min(violation, max(headroom, 0.0))
+      if shift <= 0:
+        continue
+      shifts[endpoint] += shift
+      spent[endpoint] += shift
+      adjusted = True
+    if not adjusted:
+      break
+
+  merged = []
+  for span in spans.values():
+    out = dict(span)
+    out['start'] = corrected(span, 'start')
+    out['end'] = corrected(span, 'end')
+    out['duration_ms'] = round(1e3 * (out['end'] - out['start']), 3)
+    merged.append(out)
+  merged.sort(key=lambda s: (s['start'], s['end']))
+  origin = merged[0]['start'] if merged else 0.0
+  return {
+      'kind': 'assembled_trace',
+      'trace_id': trace_id,
+      'origin': origin,
+      'processes': [{
+          'endpoint': p['endpoint'],
+          'service': p['service'],
+          'offset_applied': round(shifts[p['endpoint']], 6),
+          'error_bound': bounds[p['endpoint']],
+      } for p in processes],
+      'spans': merged,
+  }
+
+
+def causal_violations(assembled: Dict[str, Any],
+                      tolerance_secs: float = 0.0
+                      ) -> List[Tuple[str, str, float]]:
+  """(parent span id, child span id, seconds) where a child still
+  starts before its parent by more than ``tolerance_secs`` — empty for
+  a causally ordered timeline."""
+  by_id = {s['span_id']: s for s in assembled['spans']}
+  violations = []
+  for span in assembled['spans']:
+    parent = by_id.get(span.get('parent_id'))
+    if parent is None:
+      continue
+    gap = parent['start'] - span['start']
+    if gap > tolerance_secs:
+      violations.append((parent['span_id'], span['span_id'], gap))
+  return violations
+
+
+# ----------------------------------------------------------------- rendering
+
+
+def render_text(assembled: Dict[str, Any]) -> str:
+  spans = assembled['spans']
+  by_id = {s['span_id']: s for s in spans}
+  children: Dict[str, List[dict]] = {}
+  roots = []
+  for span in spans:
+    parent_id = span.get('parent_id')
+    if parent_id in by_id:
+      children.setdefault(parent_id, []).append(span)
+    else:
+      roots.append(span)
+  origin = assembled.get('origin', 0.0)
+  lines = [f'trace {assembled["trace_id"]}  '
+           f'({len(spans)} spans across '
+           f'{len({s.get("service", "?") for s in spans})} service(s))']
+  for proc in assembled.get('processes', []):
+    lines.append(f'  process {proc["service"]} @ {proc["endpoint"]}  '
+                 f'offset {proc["offset_applied"] * 1e3:+.3f} ms '
+                 f'(± {proc["error_bound"] * 1e3:.3f} ms)')
+  lines.append('')
+  lines.append(f'  {"start":>10}  {"dur":>9}  span')
+
+  def emit(span, depth):
+    start_ms = 1e3 * (span['start'] - origin)
+    detail = span.get('detail', '')
+    rid = span.get('request_id', '')
+    lines.append(
+        f'  {start_ms:>+9.3f}ms {span["duration_ms"]:>8.3f}ms '
+        + '  ' * depth
+        + f'{span["name"]} [{span.get("service", "?")}]'
+        + (f' id={rid}' if rid else '')
+        + (f'  {detail}' if detail else ''))
+    for child in sorted(children.get(span['span_id'], []),
+                        key=lambda s: s['start']):
+      emit(child, depth + 1)
+
+  for root in sorted(roots, key=lambda s: s['start']):
+    emit(root, 0)
+  return '\n'.join(lines)
+
+
+def chrome_trace(assembled: Dict[str, Any]) -> Dict[str, Any]:
+  """The merged timeline as Chrome-trace JSON (one 'process' row per
+  fleet process, Perfetto/chrome://tracing-loadable)."""
+  services = []
+  events = []
+  for span in assembled['spans']:
+    service = span.get('service', span.get('endpoint', '?'))
+    if service not in services:
+      services.append(service)
+    events.append({
+        'name': span['name'],
+        'cat': span.get('kind', 'span'),
+        'ph': 'X',
+        'ts': span['start'] * 1e6,
+        'dur': max(span['end'] - span['start'], 0.0) * 1e6,
+        'pid': services.index(service),
+        'tid': 0,
+        'args': {
+            'trace_id': assembled['trace_id'],
+            'span_id': span['span_id'],
+            'parent_id': span.get('parent_id', ''),
+            'request_id': span.get('request_id', ''),
+            'detail': span.get('detail', ''),
+        },
+    })
+  metadata = [{
+      'ph': 'M', 'name': 'process_name', 'pid': index, 'tid': 0,
+      'args': {'name': service},
+  } for index, service in enumerate(services)]
+  return {'traceEvents': metadata + events, 'displayTimeUnit': 'ms',
+          'metadata': {'producer': 'tools/assemble_trace.py',
+                       'trace_id': assembled['trace_id']}}
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+def _parse_endpoint(spec: str) -> Tuple[str, int]:
+  host, _, port = spec.rpartition(':')
+  if not host or not port.isdigit():
+    raise argparse.ArgumentTypeError(f'{spec!r} is not host:port')
+  return host, int(port)
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__.split('\n')[0],
+      formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser.add_argument('endpoints', nargs='+', type=_parse_endpoint,
+                      metavar='HOST:PORT',
+                      help='Fleet /tracez surfaces (balancer, replicas, '
+                           'trainer metricsz).')
+  parser.add_argument('--trace', default=None, help='Trace id to assemble.')
+  parser.add_argument('--request', default=None,
+                      help='Request id: its trace id is resolved across '
+                           'the fleet first.')
+  parser.add_argument('--probes', type=int, default=5,
+                      help='Clock-offset probes per endpoint (min-RTT '
+                           'sample wins).')
+  parser.add_argument('--chrome', default=None, metavar='PATH',
+                      help='Also write the merged Chrome-trace JSON here.')
+  parser.add_argument('--json', action='store_true', dest='as_json',
+                      help='Machine-readable assembled document.')
+  args = parser.parse_args(argv)
+  if bool(args.trace) == bool(args.request):
+    parser.error('pass exactly one of --trace or --request')
+
+  try:
+    processes = [fetch_process(host, port, trace_id=args.trace,
+                               request_id=args.request,
+                               probes=args.probes)
+                 for host, port in args.endpoints]
+  except (OSError, RuntimeError, ValueError) as e:
+    print(f'error: {e}', file=sys.stderr)
+    return 1
+
+  trace_id = args.trace or resolve_trace_id(processes, args.request)
+  if not trace_id:
+    print(f'error: no trace found for request {args.request!r} on '
+          f'{len(processes)} endpoint(s)', file=sys.stderr)
+    return 1
+  if args.request and not args.trace:
+    # The per-request fetch may have missed sibling spans (other hops
+    # record the trace id but not necessarily the request id on every
+    # span) — refetch by trace id for the complete picture.
+    try:
+      processes = [fetch_process(host, port, trace_id=trace_id,
+                                 probes=args.probes)
+                   for host, port in args.endpoints]
+    except (OSError, RuntimeError, ValueError) as e:
+      print(f'error: {e}', file=sys.stderr)
+      return 1
+
+  assembled = assemble(processes, trace_id)
+  if not assembled['spans']:
+    print(f'error: no spans for trace {trace_id!r}', file=sys.stderr)
+    return 1
+  if args.chrome:
+    with open(args.chrome, 'w') as f:
+      json.dump(chrome_trace(assembled), f, indent=2)
+    print(f'wrote {args.chrome}', file=sys.stderr)
+  if args.as_json:
+    print(json.dumps(assembled, indent=2, sort_keys=True))
+  else:
+    print(render_text(assembled))
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

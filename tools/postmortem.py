@@ -67,7 +67,8 @@ def _parse_detail(detail: str) -> Dict[str, str]:
 
 def timeline(bundle: Dict[str, Any],
              max_events: Optional[int] = None) -> List[Dict[str, Any]]:
-  """Events with an ``offset_sec`` relative to the moment of death."""
+  """Events with an ``offset_sec`` relative to the moment of death (or,
+  for a live bundle, the moment of capture)."""
   t_death = float(bundle.get('time', 0.0))
   events = bundle.get('events', [])
   if max_events is not None and len(events) > max_events:
@@ -133,6 +134,7 @@ def summarize(bundle: Dict[str, Any], max_events: Optional[int] = None,
   return {
       'kind': 'postmortem_summary',
       'reason': bundle.get('reason'),
+      'live': bool(bundle.get('live')),
       'exit_code': bundle.get('exit_code'),
       'time': bundle.get('time'),
       'pid': bundle.get('pid'),
@@ -152,10 +154,13 @@ def render(bundle: Dict[str, Any], path: str,
   t = bundle.get('time')
   when = (time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime(t))
           if t else '?')
-  lines.append(f'postmortem: {path}')
+  live = bool(bundle.get('live'))
+  lines.append(('live forensics bundle: ' if live else 'postmortem: ')
+               + path)
   lines.append(f'  reason:    {bundle.get("reason")}'
                + (f'  (exit {bundle["exit_code"]})'
-                  if bundle.get('exit_code') is not None else ''))
+                  if bundle.get('exit_code') is not None else '')
+               + ('  [process kept running]' if live else ''))
   lines.append(f'  when:      {when}   pid {bundle.get("pid")}')
   error = bundle.get('error')
   if error:
@@ -199,9 +204,10 @@ def render(bundle: Dict[str, Any], path: str,
 
   events = timeline(bundle, max_events=max_events)
   lines.append('')
+  anchor = 'moment of capture' if live else 'moment of death'
   lines.append(f'timeline (last {len(events)} of '
                f'{len(bundle.get("events", []))} events; '
-               't-0 = moment of death):')
+               f't-0 = {anchor}):')
   for e in events:
     lines.append(f'  {e["offset_sec"]:>+9.3f}s  [{e["kind"]:>10s}] '
                  f'{e["name"]}  {e["detail"]}')
