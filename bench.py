@@ -323,10 +323,19 @@ def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
     trainer._callbacks = [timer]  # pylint: disable=protected-access
     start = trainer.step
 
+    # The TUNED path, explicitly: engine autotuned (engine_workers=None
+    # above) AND device prefetch resolved by the same core heuristic —
+    # BENCH_r05 had the grasp2vec line racing the serial path, which is
+    # not the configuration anyone ships (ISSUE 13 satellite).
+    from tensor2robot_tpu.data import engine as engine_lib
+
+    prefetch = engine_lib.autotune_prefetch()
+
     def run(n):
       trainer._config = TrainerConfig(  # pylint: disable=protected-access
           model_dir='', max_train_steps=trainer.step + n,
-          eval_interval_steps=0, log_interval_steps=0)
+          eval_interval_steps=0, log_interval_steps=0,
+          prefetch_batches=prefetch)
       trainer.train(gen.create_iterator(ModeKeys.TRAIN), None)
       jax.block_until_ready(trainer.state.params)
 
@@ -341,8 +350,6 @@ def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
     # The input engine's autotune outcome (workers / ring depth) rides
     # beside the throughput it produced, so a BENCH round's record-fed
     # number arrives with its pipeline shape attached.
-    from tensor2robot_tpu.data import engine as engine_lib
-
     decision = engine_lib.last_decision()
     print(json.dumps({
         'metric': 'qtopt_record_train_steps_per_sec',
@@ -355,6 +362,7 @@ def bench_record_fed_train(trainer, device_ms: float, batch_size: int,
         if floor_sps else None,
         'steps': trainer.step - start,
         'batch_size': batch_size,
+        'prefetch': prefetch,
         'engine_autotune': decision.as_dict() if decision else None,
     }))
   finally:
@@ -878,6 +886,101 @@ def bench_native_reader():
     shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_resume_depth(depths=(1000, 10000, 100000), batch_size: int = 100,
+                       shuffle_buffer: int = 1000):
+  """Resume-depth curve: restore wall time at 1k/10k/100k records.
+
+  The PR-13 goodput claim — deep-position stream resume is a SEEK, not
+  a replay — measured, not asserted: for each depth the checkpointable
+  native stream delivers to the position, saves, and a FRESH pipeline
+  restores twice — once via the shard-index seek path (flat in depth:
+  closed-form position math + ≤ shuffle_buffer indexed reads) and once
+  with the legacy O(position) replay forced (`allow_seek=False`) as the
+  A/B. Pure host path (no device), so the curve is honest on CPU boxes
+  too; extends the PR-6 `restart_to_first_step_seconds` story with the
+  data half of restart goodput.
+  """
+  import os
+  import shutil
+  import tempfile
+
+  import numpy as np
+
+  from tensor2robot_tpu.data import example_codec
+  from tensor2robot_tpu.data import records as records_lib
+  from tensor2robot_tpu.data.input_generators import (
+      NativeRecordInputGenerator)
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.observability import metrics as metrics_lib
+  from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+  spec = SpecStruct({'x': TensorSpec((1,), np.float32, name='x')})
+  total = max(depths) + shuffle_buffer + 2 * batch_size
+  shards = 4
+  per_shard = (total + shards - 1) // shards
+  tmp = tempfile.mkdtemp(prefix='t2r_resume_bench_')
+  try:
+    k = 0
+    paths = []
+    for s in range(shards):
+      path = os.path.join(tmp, f'data-{s:05d}.tfrecord')
+      serialized = []
+      for _ in range(per_shard):
+        serialized.append(example_codec.encode_example(
+            spec, {'x': np.array([k], np.float32)}))
+        k += 1
+      records_lib.write_examples(path, serialized)
+      paths.append(path)
+    pattern = ','.join(paths)
+
+    def make_iterator():
+      gen = NativeRecordInputGenerator(
+          pattern, batch_size=batch_size,
+          shuffle_buffer_size=shuffle_buffer, seed=0, engine_workers=0)
+      gen.set_specification(spec, None)
+      return gen.create_checkpointable_iterator(ModeKeys.TRAIN)
+
+    for depth in depths:
+      it = make_iterator()
+      for _ in range(depth // batch_size):
+        next(it)
+      prefix = os.path.join(tmp, f'state_{depth}', 'state')
+      it.save(prefix)
+      it.close()
+
+      def timed_restore(allow_seek, prefix=prefix):
+        best = float('inf')
+        for _ in range(3):  # best-of-3: restore cost, not scheduler noise
+          fresh = make_iterator()
+          t0 = time.perf_counter()
+          fresh.restore(prefix, allow_seek=allow_seek)
+          next(fresh)  # position is only proven once a batch surfaces
+          best = min(best, time.perf_counter() - t0)
+          fresh.close()
+        return best
+
+      seek_s = timed_restore(True)
+      seek_mode = int(metrics_lib.gauge('data/resume_seek_mode').value)
+      replayed = int(
+          metrics_lib.gauge('data/resume_replayed_records').value)
+      replay_s = timed_restore(False)
+      print(json.dumps({
+          'metric': 'resume_seconds_at_depth',
+          'depth_records': depth,
+          'value': round(seek_s, 4),
+          'unit': 's',
+          'replay_seconds': round(replay_s, 4),
+          'speedup_vs_replay': round(replay_s / seek_s, 2) if seek_s else
+          None,
+          'seek_mode': seek_mode,
+          'resume_replayed_records': replayed,
+          'batch_size': batch_size,
+          'shuffle_buffer_size': shuffle_buffer,
+      }))
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
   import jax
 
@@ -938,6 +1041,15 @@ def main():
     }))
   except Exception as e:  # pylint: disable=broad-except
     print(json.dumps({'metric': 'restart_to_first_step_seconds',
+                      'error': repr(e)[:200]}))
+
+  # The data half of restart goodput: the seek-vs-replay resume-depth
+  # curve (flatness is the claim). Host-only — measured on every round,
+  # CPU or TPU.
+  try:
+    bench_resume_depth()
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'resume_seconds_at_depth',
                       'error': repr(e)[:200]}))
 
   state = trainer.state

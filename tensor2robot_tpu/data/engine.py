@@ -235,7 +235,8 @@ class ParallelBatchEngine:
                reuse_buffers: bool = False,
                reautotune: bool = False,
                cpus: Optional[int] = None,
-               lease_timeout: float = 5.0):
+               lease_timeout: float = 5.0,
+               start_delivered: int = 0):
     if batch_size <= 0:
       raise ValueError(f'batch_size must be positive, got {batch_size}')
     self._records = iter(records)
@@ -246,7 +247,11 @@ class ParallelBatchEngine:
     # [1, ring_depth-1]), so mode checks need no lock.
     self._serial = max(0, int(num_workers)) == 0
     self._num_workers = max(0, int(num_workers))  # GUARDED_BY(self._workers_lock)
-    self.delivered = 0
+    # ``start_delivered``: a resumed pipeline's record iterator begins
+    # mid-stream (seek or replay restore), so ``delivered`` — the
+    # engine's checkpointable stream position — continues from the
+    # restored batch count instead of restarting at 0.
+    self.delivered = int(start_delivered)
     self._workers_lock = threading.Lock()
     self._closed = False  # GUARDED_BY(self._workers_lock)
     self._metrics = metrics_lib.scope('data/engine')

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import abc
 import json
+import logging
 import os
 from typing import Callable, Dict, Iterator, Optional, Tuple, Union
 
@@ -381,18 +382,11 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                                if engine_reautotune is None
                                else bool(engine_reautotune))
 
-  def _records(self, mode: str):
-    """Yields raw serialized examples forever (train) or one epoch.
-
-    With ``error_budget`` set, a RECORD-level ``ErrorBudget`` is shared
-    across reader reopens: a corrupt record ends the current interleave
-    pass (framing cannot resync) and the train loop's reopen continues
-    on the surviving bytes, bounded by the budget; reader OPENS are
-    additionally retried with jittered backoff (transient filesystem
-    errors should not kill a multi-day run).
-    """
-    from tensor2robot_tpu.data import native_io, records
-    from tensor2robot_tpu.utils import retry as retry_lib
+  def _resolved_filenames(self):
+    """This process's shard list IN STREAM ORDER plus the shard flavor:
+    ``(filenames, element_shard)`` — the one resolution both the live
+    stream and the seek-resume position math must agree on."""
+    from tensor2robot_tpu.data import records
 
     data_format, filenames = records.get_data_format_and_filenames(
         self._file_patterns)
@@ -402,6 +396,34 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     import jax
 
     element_shard = not sharded and jax.process_count() > 1
+    if element_shard:
+      filenames = sorted(filenames)
+    return filenames, element_shard
+
+  def _records(self, mode: str, resume=None):
+    """Yields raw serialized examples forever (train) or one epoch.
+
+    With ``error_budget`` set, a RECORD-level ``ErrorBudget`` is shared
+    across reader reopens: a corrupt record ends the current interleave
+    pass (framing cannot resync) and the train loop's reopen continues
+    on the surviving bytes, bounded by the budget; reader OPENS are
+    additionally retried with jittered backoff (transient filesystem
+    errors should not kill a multi-day run).
+
+    ``resume`` (a ``seek_resume.ResumePlan``) starts the stream
+    mid-epoch: the PARTIAL epoch runs through per-slot readers seeked
+    via the shard index (byte-identical order to the native interleave,
+    no prefetch threads — it lasts at most one epoch), after which full
+    epochs go back through the native prefetching interleave reader.
+    """
+    from tensor2robot_tpu.data import native_io, records, seek_resume
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    filenames, element_shard = self._resolved_filenames()
+    import jax
+
+    process_count = jax.process_count()
+    process_index = jax.process_index()
     training = modes.is_training(mode)
     read_budget = None
     if self._error_budget is not None:
@@ -409,10 +431,25 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
           self._error_budget, name=f'{type(self).__name__} record stream')
     open_policy = retry_lib.RetryPolicy(max_attempts=max(1,
                                                          self._open_retries))
+    if resume is not None:
+      if not training and resume.epoch > 0:
+        return  # single-pass stream already exhausted at the position
+      indexes = resume.indexes or {}
+
+      def open_reader(path, ordinal):
+        return records.open_at(path, ordinal, index=indexes.get(path))
+
+      for within, record in seek_resume.iter_epoch_from(
+          resume.layout, resume.files, resume.within_epoch, open_reader):
+        if element_shard and within % process_count != process_index:
+          continue
+        yield record
+      if not training:
+        return
     while True:
       reader = retry_lib.retry_call(
           native_io.NativeInterleaveReader,
-          sorted(filenames) if element_shard else filenames,
+          filenames,
           cycle_length=self._cycle_length,
           queue_capacity=self._queue_capacity,
           error_budget=read_budget,
@@ -420,7 +457,7 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
           describe='native interleave open')
       with reader:
         for i, record in enumerate(reader):
-          if element_shard and i % jax.process_count() != jax.process_index():
+          if element_shard and i % process_count != process_index:
             continue
           yield record
       if not training:
@@ -429,13 +466,17 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
   def _create_iterator(self, mode, batch_size):
     return self._build_batches(mode, batch_size)
 
-  def _build_batches(self, mode, batch_size, skip_batches: int = 0):
+  def _build_batches(self, mode, batch_size, skip_batches: int = 0,
+                     resume=None, start_delivered: Optional[int] = None):
     """The ONE batch pipeline both iterator flavors build from:
     interleaved read → seeded shuffle → engine (ticket-parallel
     parse/decode, order-preserving). ``skip_batches`` fast-forwards the
     deterministic stream by consuming (without parsing) the records the
-    first N batches would have used — the checkpointable iterator's
-    restore path."""
+    first N batches would have used — the O(position) replay restore.
+    ``resume`` (a ``seek_resume.ResumePlan``) is the O(1) restore: the
+    shuffle buffer arrives pre-filled by indexed reads, the rng already
+    advanced, and the raw stream starts at a seeked mid-epoch position —
+    the delivered stream is byte-identical to the replay path."""
     import itertools
 
     from tensor2robot_tpu.data import engine as engine_lib
@@ -450,14 +491,24 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
           'multi-image features, or no C++ toolchain); use '
           'DefaultRecordInputGenerator.')
     training = modes.is_training(mode)
-    rng = np.random.RandomState(self._seed)
+    shuffling = training and self._shuffle_buffer_size > 1
+    if start_delivered is None:
+      start_delivered = skip_batches
 
     def stream():
-      if not training or self._shuffle_buffer_size <= 1:
-        yield from self._records(mode)
+      if not shuffling:
+        yield from self._records(mode, resume=resume)
         return
-      buf = []
-      for record in self._records(mode):
+      if resume is None:
+        rng = np.random.RandomState(self._seed)
+        buf = []
+      else:
+        # The buffer and rng resume EXACTLY where the saved position
+        # left them, so the refill loop below continues the same
+        # deterministic emission sequence.
+        rng = resume.rng
+        buf = list(resume.buffer)
+      for record in self._records(mode, resume=resume):
         if len(buf) < self._shuffle_buffer_size:
           buf.append(record)
           continue
@@ -480,7 +531,8 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
         num_workers=decision.num_workers,
         ring_depth=decision.ring_depth,
         reuse_buffers=self._reuse_batch_buffers,
-        reautotune=self._engine_reautotune)
+        reautotune=self._engine_reautotune,
+        start_delivered=start_delivered)
 
   def create_checkpointable_iterator(
       self, mode: str, batch_size: Optional[int] = None
@@ -488,13 +540,18 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     """Engine-fed iterator whose STREAM POSITION checkpoints.
 
     The native stream is a deterministic function of (files, seed,
-    batch size), so its position is the delivered-batch count; restore
-    rebuilds the pipeline and fast-forwards the raw record stream to
-    that count (read-only replay — the skipped batches are never parsed
-    or decoded). Requires a ``seed`` when shuffling, or the replay would
-    diverge. Same prefetch caveat as the tf.data flavor
-    (``train/input_state.py``): run ``prefetch_batches=0`` when bit-
-    exact resume matters.
+    batch size), so its position is the delivered-batch count. Restore
+    is CONSTANT-TIME at any depth when shard-index sidecars are valid
+    (``data/shard_index.py``: per-record byte offsets, built
+    opportunistically here on first use): the shuffle buffer and rng
+    are reconstructed by closed-form position math plus ≤ buffer_size
+    indexed reads, and each reader seeks straight to its record
+    boundary. A missing/stale index degrades LOUDLY
+    (``data/resume_fallbacks`` counter + warning) to the legacy
+    O(position) replay — identical bytes either way, never a wrong
+    stream. Requires a ``seed`` when shuffling. Same prefetch caveat as
+    the tf.data flavor (``train/input_state.py``): run
+    ``prefetch_batches=0`` when bit-exact resume matters.
     """
     if self._feature_spec is None:
       raise ValueError(
@@ -508,14 +565,47 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
     return _CheckpointableEngineIterator(
         self, mode, batch_size or self._batch_size)
 
+  def _maybe_build_indexes(self) -> Dict[str, object]:
+    """Opportunistic sidecar build for this stream's shards.
+
+    Returns ``{path: ShardIndex}`` for every shard that could be
+    indexed (loaded if a valid sidecar exists, else one header-only
+    framing walk + best-effort atomic write). Shards that cannot be
+    indexed (remote schemes, scan errors) are simply absent — save
+    then records the stream as replay-only and restore stays on the
+    legacy path. ``T2R_SHARD_INDEX_DISABLE=1`` opts out entirely.
+    """
+    from tensor2robot_tpu.data import shard_index
+
+    if os.environ.get('T2R_SHARD_INDEX_DISABLE'):
+      return {}
+    indexes: Dict[str, object] = {}
+    filenames, _ = self._resolved_filenames()
+    for path in filenames:
+      if '://' in path:
+        continue  # remote shards: offline `tools/index_shards.py` only
+      try:
+        indexes[path] = shard_index.ensure_index(path)
+      except (OSError, shard_index.IndexError_) as e:
+        logging.warning('Cannot index shard %r (%s); deep-position '
+                        'resume will replay.', path, e)
+    return indexes
+
+
+class _SeekUnavailable(Exception):
+  """Why an O(1) seek restore degraded to the O(position) replay."""
+
 
 class _CheckpointableEngineIterator:
   """Resumable position tracking over the native engine pipeline.
 
   Same save/restore surface as ``pipeline.CheckpointableNumpyIterator``
   (``train/input_state.py`` drives both): ``save`` writes a tiny JSON
-  position next to the model checkpoint; ``restore`` rebuilds the engine
-  with a deterministic fast-forward. The lock makes position capture
+  position next to the model checkpoint; ``restore`` rebuilds the
+  engine at the saved position — an O(1) index-seek when every shard's
+  sidecar validates (v2 states carry the stream fingerprint: files,
+  per-shard record counts, seed/shuffle/cycle config), else the legacy
+  O(position) read-only replay, loudly. The lock makes position capture
   atomic against a prefetch worker's concurrent ``next()``.
   """
 
@@ -528,6 +618,9 @@ class _CheckpointableEngineIterator:
     self._batch_size = batch_size
     self._delivered = 0  # GUARDED_BY(self._lock)
     self._lock = threading.Lock()
+    # Opportunistic: the first resumable stream over a corpus leaves
+    # index sidecars behind, so every later restore is a seek.
+    self._indexes = generator._maybe_build_indexes()  # pylint: disable=protected-access
     self._engine = generator._build_batches(mode, batch_size)  # pylint: disable=protected-access  # GUARDED_BY(self._lock)
 
   def __iter__(self):
@@ -548,19 +641,136 @@ class _CheckpointableEngineIterator:
     # restore(), which runs before the consuming threads start.
     self._engine.release()
 
+  def _stream_fingerprint(self) -> dict:
+    """The v2 'stream' block: everything restore needs to decide seek
+    vs replay. Per-shard counts come from the sidecars and each sidecar
+    is re-validated (O(1) stat + sampled CRC) at SAVE time, so a shard
+    rewritten mid-run can never masquerade as seekable."""
+    from tensor2robot_tpu.data import shard_index
+
+    gen = self._generator
+    filenames, element_shard = gen._resolved_filenames()  # pylint: disable=protected-access
+    import jax
+
+    counts = []
+    seekable = True
+    reason = None
+    for path in filenames:
+      index = self._indexes.get(path)
+      if index is None:
+        seekable, reason = False, f'no index for {path}'
+        break
+      try:
+        shard_index.validate_index(index, path)
+      except shard_index.StaleIndexError as e:
+        seekable, reason = False, str(e)
+        break
+      counts.append(index.record_count)
+    return {
+        'version': 2,
+        'seekable': seekable,
+        'reason': reason,
+        'files': filenames,
+        'record_counts': counts if seekable else None,
+        'seed': gen._seed,  # pylint: disable=protected-access
+        'shuffle_buffer_size': gen._shuffle_buffer_size,  # pylint: disable=protected-access
+        'cycle_length': gen._cycle_length,  # pylint: disable=protected-access
+        'element_shard': element_shard,
+        'process_count': jax.process_count(),
+        'process_index': jax.process_index(),
+    }
+
   def save(self, path_prefix: str) -> str:
     path = path_prefix + '.json'
     dirname = os.path.dirname(path)
     if dirname:
       os.makedirs(dirname, exist_ok=True)
+    stream = self._stream_fingerprint()
     with self._lock:
       state = {'batches_delivered': self._delivered,
-               'batch_size': self._batch_size, 'mode': self._mode}
+               'batch_size': self._batch_size, 'mode': self._mode,
+               'stream': stream}
     with open(path, 'w') as f:
       json.dump(state, f)
     return path
 
-  def restore(self, path_prefix: str) -> None:
+  def _seek_plan(self, state):
+    """Builds the O(1) resume plan, or raises with a fallback reason."""
+    from tensor2robot_tpu.data import records, seek_resume, shard_index
+
+    gen = self._generator
+    stream = state.get('stream') or {}
+    if not stream.get('seekable'):
+      raise _SeekUnavailable(
+          stream.get('reason') or 'state has no seekable stream block '
+          '(saved by an older version?)')
+    filenames, element_shard = gen._resolved_filenames()  # pylint: disable=protected-access
+    import jax
+
+    config = {
+        'files': filenames,
+        'seed': gen._seed,  # pylint: disable=protected-access
+        'shuffle_buffer_size': gen._shuffle_buffer_size,  # pylint: disable=protected-access
+        'cycle_length': gen._cycle_length,  # pylint: disable=protected-access
+        'element_shard': element_shard,
+        'process_count': jax.process_count(),
+        'process_index': jax.process_index(),
+    }
+    for key, value in config.items():
+      if stream.get(key) != value:
+        raise _SeekUnavailable(
+            f'stream config changed since save: {key} was '
+            f'{stream.get(key)!r}, now {value!r}')
+    indexes = {}
+    for path, saved_count in zip(filenames, stream['record_counts']):
+      try:
+        index = shard_index.load_index(path)
+      except FileNotFoundError as e:
+        raise _SeekUnavailable(f'missing shard index: {path}') from e
+      except shard_index.StaleIndexError as e:
+        raise _SeekUnavailable(f'stale shard index: {e}') from e
+      except (OSError, shard_index.IndexError_) as e:
+        raise _SeekUnavailable(f'unreadable shard index: {e}') from e
+      if index.record_count != saved_count:
+        raise _SeekUnavailable(
+            f'{path}: {index.record_count} records now vs {saved_count} '
+            f'at save time')
+      indexes[path] = index
+    emitted = int(state['batches_delivered']) * self._batch_size
+    shuffled = (modes.is_training(self._mode) and
+                gen._shuffle_buffer_size > 1)  # pylint: disable=protected-access
+    stride = (config['process_count'], config['process_index']) \
+        if element_shard else (1, 0)
+    plan = seek_resume.plan_resume(
+        files=filenames,
+        counts=stream['record_counts'],
+        cycle_length=gen._cycle_length,  # pylint: disable=protected-access
+        seed=gen._seed,  # pylint: disable=protected-access
+        shuffle_buffer_size=gen._shuffle_buffer_size,  # pylint: disable=protected-access
+        records_emitted=emitted,
+        shuffled=shuffled,
+        fetch=lambda path, ords: records.read_records_at(
+            path, ords, index=indexes[path]),
+        process_count=stride[0],
+        process_index=stride[1])
+    plan.indexes = indexes
+    return plan
+
+  def restore(self, path_prefix: str, allow_seek: bool = True) -> None:
+    """Rebuilds the pipeline at the saved position.
+
+    Seek path (O(1) at any depth) when the state is v2-seekable and
+    every sidecar validates; otherwise the legacy O(position) replay —
+    LOUDLY (``data/resume_fallbacks`` + warning), byte-identical either
+    way. ``allow_seek=False`` forces the replay path (bench A/B).
+    Publishes ``data/resume_ms``, ``data/resume_seek_mode`` and
+    ``data/resume_replayed_records``.
+    """
+    import time
+
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+
+    t0 = time.perf_counter()
     with open(path_prefix + '.json') as f:
       state = json.load(f)
     if state.get('batch_size') != self._batch_size:
@@ -568,11 +778,38 @@ class _CheckpointableEngineIterator:
           f'Input state was saved with batch_size='
           f'{state.get("batch_size")}, but this iterator uses '
           f'{self._batch_size}; the stream positions are incompatible.')
+    plan = None
+    if allow_seek:
+      try:
+        plan = self._seek_plan(state)
+      except _SeekUnavailable as e:
+        metrics_lib.counter('data/resume_fallbacks').inc()
+        logging.warning(
+            'Deep-position seek resume unavailable (%s); falling back '
+            'to the O(position) replay of %d batches.', e,
+            int(state['batches_delivered']))
+    delivered = int(state['batches_delivered'])
     with self._lock:
       self._engine.close()
-      self._delivered = int(state['batches_delivered'])
-      self._engine = self._generator._build_batches(  # pylint: disable=protected-access
-          self._mode, self._batch_size, skip_batches=self._delivered)
+      self._delivered = delivered
+      if plan is not None:
+        self._engine = self._generator._build_batches(  # pylint: disable=protected-access
+            self._mode, self._batch_size, resume=plan,
+            start_delivered=delivered)
+        replayed = 0
+      else:
+        self._engine = self._generator._build_batches(  # pylint: disable=protected-access
+            self._mode, self._batch_size, skip_batches=delivered)
+        replayed = delivered * self._batch_size
+    metrics_lib.gauge('data/resume_ms').set(
+        (time.perf_counter() - t0) * 1e3)
+    metrics_lib.gauge('data/resume_seek_mode').set(
+        1 if plan is not None else 0)
+    metrics_lib.gauge('data/resume_replayed_records').set(replayed)
+    logging.info(
+        'Input stream restored at batch %d via %s (%.1f ms).', delivered,
+        'index seek' if plan is not None else 'replay',
+        (time.perf_counter() - t0) * 1e3)
 
   def close(self) -> None:
     # ANALYSIS_OK(lock-discipline): same no-lock contract as release();

@@ -93,13 +93,40 @@ class NativeRecordReader:
   """
 
   def __init__(self, path: str, verify_crc: bool = True,
-               error_budget: Optional['retry_lib.ErrorBudget'] = None):
+               error_budget: Optional['retry_lib.ErrorBudget'] = None,
+               start_offset: int = 0):
     self._lib = _lib()
     self._path = path
     self._error_budget = error_budget
     self._h = self._lib.t2r_reader_open(path.encode(), int(verify_crc))
     if not self._h:
       raise IOError(f'cannot open {path!r}')
+    if start_offset:
+      self.seek(start_offset)
+
+  def seek(self, offset: int) -> None:
+    """Repositions to an absolute byte offset — a record boundary from a
+    shard-index sidecar (``data/shard_index.py``); a mid-record offset
+    surfaces as a framing/CRC error on the next read, never silence."""
+    if self._lib.t2r_reader_seek(self._h, int(offset)):
+      raise IOError(
+          f'seek to offset {offset} failed in {self._path!r}: '
+          f'{self._lib.t2r_reader_error(self._h).decode()}')
+
+  def read_next(self) -> Optional[bytes]:
+    """One record (or None at EOF) — the indexed-read primitive
+    ``records.read_records_at`` drives between seeks."""
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    n = self._lib.t2r_reader_next(self._h, ctypes.byref(buf))
+    if n == -1:
+      return None
+    if n == -2:
+      err = self._lib.t2r_reader_error(self._h).decode()
+      _charge_read_error(err)
+      raise IOError(f'record read failed in {self._path!r}: {err}')
+    metrics_lib.counter('data/records_read').inc()
+    metrics_lib.counter('data/bytes_read').inc(n)
+    return ctypes.string_at(buf, n)
 
   def __iter__(self) -> Iterator[bytes]:
     buf = ctypes.POINTER(ctypes.c_uint8)()
@@ -224,6 +251,19 @@ def read_records(path: str) -> List[bytes]:
   """Reads every record of one file (convenience for tools/tests)."""
   with NativeRecordReader(path) as r:
     return list(r)
+
+
+def iter_records_from(path: str, offset: int = 0,
+                      verify_crc: bool = True) -> Iterator[bytes]:
+  """Sequential records from an absolute byte offset (a record boundary
+  from a shard index) — the seeked-reader primitive behind
+  ``records.open_at``. The reader closes when the generator finishes."""
+  reader = NativeRecordReader(path, verify_crc=verify_crc,
+                              start_offset=offset)
+  try:
+    yield from reader
+  finally:
+    reader.close()
 
 
 # ------------------------------------------------------- example parsing

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import glob as glob_lib
 import os
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from typing import (Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple, Union)
 
 
 def _tf():
@@ -97,6 +98,66 @@ def verify_tfrecord_file(path: str) -> bool:
     return True
   except tf.errors.OpError:
     return False
+
+
+def open_at(path: str, record_ordinal: int,
+            index: Optional['shard_index.ShardIndex'] = None,
+            verify_crc: bool = True) -> Iterator[bytes]:
+  """Sequential records of ``path`` starting at ``record_ordinal``.
+
+  The O(1) deep-position entry point: the shard index sidecar
+  (``data/shard_index.py``) maps the ordinal to a byte offset and the
+  reader seeks there — no records before the position are read. Prefers
+  the native reader; falls back to the pure-Python framing walker.
+  ``index`` (optional) skips re-loading the sidecar; without it the
+  sidecar is loaded AND validated against the shard (raises
+  ``shard_index.StaleIndexError`` on mismatch — callers fall back to the
+  O(position) replay path, never a wrong stream).
+  """
+  from tensor2robot_tpu.data import native_io, shard_index
+
+  if index is None:
+    index = shard_index.load_index(path)
+  if record_ordinal == index.record_count:
+    return iter(())
+  offset = index.offset_of(record_ordinal)
+  if '://' not in path and native_io.available():
+    return native_io.iter_records_from(path, offset, verify_crc)
+  return shard_index.iter_records_from(path, offset, verify_crc)
+
+
+def read_records_at(path: str, ordinals: Sequence[int],
+                    index: Optional['shard_index.ShardIndex'] = None
+                    ) -> Dict[int, bytes]:
+  """Indexed point reads: ``{ordinal: payload}`` via one open + seeks.
+
+  The shuffle-buffer refill primitive for constant-time resume
+  (``data/seek_resume.plan_resume``): ≤ buffer_size records fetched by
+  offset, independent of their depth in the shard.
+  """
+  from tensor2robot_tpu.data import native_io, shard_index
+
+  if index is None:
+    index = shard_index.load_index(path)
+  out: Dict[int, bytes] = {}
+  if '://' not in path and native_io.available():
+    with native_io.NativeRecordReader(path) as reader:
+      for ordinal in sorted(set(ordinals)):
+        reader.seek(index.offset_of(ordinal))
+        record = reader.read_next()
+        if record is None:
+          raise IOError(
+              f'{path}: unexpected EOF at indexed record {ordinal}')
+        out[ordinal] = record
+    return out
+  for ordinal in sorted(set(ordinals)):
+    record = next(
+        shard_index.iter_records_from(path, index.offset_of(ordinal)),
+        None)
+    if record is None:
+      raise IOError(f'{path}: unexpected EOF at indexed record {ordinal}')
+    out[ordinal] = record
+  return out
 
 
 class RecordWriter:

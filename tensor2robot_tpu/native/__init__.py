@@ -79,6 +79,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
   lib.t2r_reader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p)]
   lib.t2r_reader_error.restype = ctypes.c_char_p
   lib.t2r_reader_error.argtypes = [ctypes.c_void_p]
+  lib.t2r_reader_seek.restype = ctypes.c_int
+  lib.t2r_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
   lib.t2r_reader_close.restype = None
   lib.t2r_reader_close.argtypes = [ctypes.c_void_p]
 

@@ -302,6 +302,19 @@ const char* t2r_reader_error(void* handle) {
   return static_cast<Reader*>(handle)->error.c_str();
 }
 
+// Repositions the reader to an absolute byte offset — a RECORD BOUNDARY
+// from a shard index sidecar (data/shard_index.py); seeking mid-record
+// surfaces as a framing/CRC error on the next read, never silence.
+// Returns 0 on success, -1 on seek failure.
+int t2r_reader_seek(void* handle, uint64_t offset) {
+  auto* r = static_cast<Reader*>(handle);
+  if (fseeko(r->f, static_cast<off_t>(offset), SEEK_SET) != 0) {
+    r->error = "seek failed";
+    return -1;
+  }
+  return 0;
+}
+
 void t2r_reader_close(void* handle) {
   auto* r = static_cast<Reader*>(handle);
   fclose(r->f);

@@ -93,9 +93,23 @@ class InputStateCallback(TrainerCallback):
           'restarts from the beginning (examples before the checkpoint '
           'may repeat).', self._name, step, root)
       return
+    import time
+
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+
+    t0 = time.perf_counter()
     self._iterator.restore(os.path.join(path, 'state'))
-    logging.info('Restored %r input stream state at step %d.', self._name,
-                 step)
+    # The goodput-facing number for ROADMAP direction 5: how long the
+    # DATA side of a restart took, and whether it was an O(1) index
+    # seek (data/resume_seek_mode=1) or an O(position) replay — read
+    # next to trainer/restart_to_first_step_seconds.
+    resume_ms = (time.perf_counter() - t0) * 1e3
+    logging.info(
+        'Restored %r input stream state at step %d in %.1f ms '
+        '(seek_mode=%s, replayed_records=%s).', self._name, step,
+        resume_ms,
+        int(metrics_lib.gauge('data/resume_seek_mode').value),
+        int(metrics_lib.gauge('data/resume_replayed_records').value))
 
   def after_checkpoint(self, trainer, step: int) -> None:
     root = self._root(trainer)

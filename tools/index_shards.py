@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Build/verify shard-index sidecars offline (stdlib-only).
+
+The operator half of O(1) deep-position stream resume
+(``data/shard_index.py``): pre-building ``<shard>.idx`` sidecars for a
+corpus means the FIRST resumable run never pays the opportunistic
+header walk, and ``--verify`` is the pre-resume health check — it walks
+every shard's full TFRecord framing (payload CRCs included) and exits
+non-zero NAMING any shard whose index is stale (size/CRC footer
+mismatch), truncated, or whose framing is broken.
+
+    python tools/index_shards.py '<data_dir>/train-*.tfrecord'
+    python tools/index_shards.py --verify '<data_dir>/*.tfrecord'
+    python tools/index_shards.py --rebuild '<data_dir>/*.tfrecord'
+
+Runs anywhere (no jax/numpy/TF import — same dependency discipline as
+``tools/inspect_checkpoint.py``); only the stdlib-only
+``data/shard_index.py`` module is imported from the package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+  sys.path.insert(0, REPO)
+
+from tensor2robot_tpu.data import shard_index  # noqa: E402
+
+
+def resolve_shards(patterns: List[str]) -> List[str]:
+  shards: List[str] = []
+  for pattern in patterns:
+    matches = sorted(glob.glob(pattern))
+    shards.extend(m for m in matches
+                  if not m.endswith(shard_index.INDEX_SUFFIX))
+  return shards
+
+
+def build(shards: List[str], rebuild: bool) -> int:
+  failures = 0
+  for shard in shards:
+    try:
+      if rebuild:
+        index = shard_index.build_index(shard)
+        shard_index.write_index(shard, index)
+        status = 'rebuilt'
+      else:
+        index = shard_index.ensure_index(shard)
+        status = 'ok'
+      print(f'{shard}: {status} ({index.record_count} records, '
+            f'{index.shard_size} bytes)')
+    except (OSError, shard_index.IndexError_) as e:
+      failures += 1
+      print(f'{shard}: FAILED ({e})', file=sys.stderr)
+  return failures
+
+
+def verify(shards: List[str]) -> int:
+  """Full offline verification; returns the number of bad shards."""
+  failures = 0
+  for shard in shards:
+    problems = []
+    index = None
+    try:
+      index = shard_index.load_index(shard, validate=True)
+    except FileNotFoundError:
+      problems.append('index sidecar missing')
+    except shard_index.StaleIndexError as e:
+      problems.append(f'index STALE: {e}')
+    except (OSError, shard_index.IndexError_) as e:
+      problems.append(f'index unreadable: {e}')
+    # Full framing + payload-CRC walk — the thing the O(1) staleness
+    # footer deliberately does not do online.
+    try:
+      count = 0
+      offsets = []
+      pos = 0
+      for record in shard_index.iter_records_from(shard, 0,
+                                                  verify_crc=True):
+        offsets.append(pos)
+        pos += 12 + len(record) + 4
+        count += 1
+      if index is not None:
+        if count != index.record_count:
+          problems.append(
+              f'index records {index.record_count} != shard {count}')
+        elif offsets != index.offsets:
+          problems.append('index offsets do not match shard framing')
+    except (OSError, shard_index.IndexError_) as e:
+      problems.append(f'shard TRUNCATED/CORRUPT: {e}')
+    if problems:
+      failures += 1
+      print(f'{shard}: ' + '; '.join(problems), file=sys.stderr)
+    else:
+      print(f'{shard}: verified ({count} records)')
+  return failures
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  parser.add_argument('patterns', nargs='+',
+                      help='shard glob(s), e.g. "data/train-*.tfrecord"')
+  parser.add_argument('--verify', action='store_true',
+                      help='full framing+CRC verification; exit non-zero '
+                           'naming any stale/truncated shard')
+  parser.add_argument('--rebuild', action='store_true',
+                      help='rebuild sidecars even when they validate')
+  args = parser.parse_args(argv)
+
+  shards = resolve_shards(args.patterns)
+  if not shards:
+    print(f'no shards match {args.patterns}', file=sys.stderr)
+    return 2
+  if args.verify:
+    failures = verify(shards)
+  else:
+    failures = build(shards, rebuild=args.rebuild)
+  if failures:
+    print(f'{failures}/{len(shards)} shard(s) FAILED', file=sys.stderr)
+    return 1
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 COMMIT_FILENAME = 'commit.json'
 HOST_ACK_PREFIX = 'host_ack_'
+INPUT_STATE_DIRNAME = 'input_state'
 
 
 def _read_json(path: str) -> Optional[Dict[str, Any]]:
@@ -143,9 +144,69 @@ def inspect_step(directory: str, step: int, step_dir: str,
       'incarnation': incarnation,
       'acks': acks,
       'shard_layout': _shard_layout(step_dir),
+      'input_states': _input_states(directory, step),
   }
-  del directory
   return info
+
+
+def _input_states(directory: str, step: int) -> List[Dict[str, Any]]:
+  """Iterator-state blobs saved adjacent to checkpoint ``step``.
+
+  Layout (``train/input_state.py``): ``<model_dir>/input_state/<name>/
+  process_<i>/step_<n>/state*``. The native engine writes ``state.json``
+  (rendered fully: seek-vs-replay position mode, per-shard ordinals,
+  shuffle seed); the tf.data flavor writes an opaque checkpoint blob
+  (reported as present). A resume that would silently fall back to the
+  O(position) replay is thus diagnosable from the checkpoint dir alone.
+  """
+  model_dir = os.path.dirname(directory)
+  root = os.path.join(model_dir, INPUT_STATE_DIRNAME)
+  out: List[Dict[str, Any]] = []
+  try:
+    names = sorted(os.listdir(root))
+  except OSError:
+    return out
+  for name in names:
+    name_dir = os.path.join(root, name)
+    try:
+      processes = sorted(os.listdir(name_dir))
+    except OSError:
+      continue
+    for proc in processes:
+      step_dir = os.path.join(name_dir, proc, f'step_{step}')
+      if not os.path.isdir(step_dir):
+        continue
+      entry: Dict[str, Any] = {'name': name, 'process': proc}
+      state = _read_json(os.path.join(step_dir, 'state.json'))
+      if state is not None:
+        stream = state.get('stream') or {}
+        seekable = bool(stream.get('seekable'))
+        entry.update({
+            'kind': 'native-engine-position',
+            'batches_delivered': state.get('batches_delivered'),
+            'batch_size': state.get('batch_size'),
+            'mode': state.get('mode'),
+            'resume': 'seek' if seekable else 'replay',
+            'records_position': (
+                None if state.get('batches_delivered') is None else
+                int(state['batches_delivered']) *
+                int(state.get('batch_size') or 0)),
+            'seed': stream.get('seed'),
+            'shuffle_buffer_size': stream.get('shuffle_buffer_size'),
+            'cycle_length': stream.get('cycle_length'),
+            'shards': len(stream.get('files') or []),
+            'record_counts': stream.get('record_counts'),
+            'not_seekable_reason': stream.get('reason'),
+        })
+      else:
+        try:
+          files = sorted(os.listdir(step_dir))
+        except OSError:
+          files = []
+        entry.update({'kind': 'tf-iterator-blob', 'files': files,
+                      'resume': 'full-state'})
+      out.append(entry)
+  return out
 
 
 def inspect_directory(directory: str) -> Dict[str, Any]:
@@ -201,6 +262,29 @@ def _print_human(report: Dict[str, Any]) -> None:
       print(f"  acks: {sorted(a.get('process_index') for a in fresh)}"
             + (f" (+{len(stale)} stale from a previous attempt)"
                if stale else ''))
+    for state in info.get('input_states', []):
+      if state.get('kind') == 'native-engine-position':
+        counts = state.get('record_counts')
+        shards = state.get('shards')
+        detail = (f"seed={state.get('seed')} "
+                  f"shuffle={state.get('shuffle_buffer_size')} "
+                  f"{shards} shard(s)"
+                  + (f" ({sum(counts):,} records indexed)" if counts
+                     else ''))
+        position = state.get('records_position')
+        print(f"  input stream {state['name']}/{state['process']}: "
+              f"{state['resume'].upper()} resume at batch "
+              f"{state['batches_delivered']} "
+              f"(record {position if position is None else format(position, ',')}, "
+              f"batch_size {state['batch_size']}); {detail}")
+        if state['resume'] == 'replay':
+          print(f"    NOT seekable: "
+                f"{state.get('not_seekable_reason') or 'no stream block'}"
+                f" — restore replays O(position)")
+      else:
+        print(f"  input stream {state['name']}/{state['process']}: "
+              f"tf.data iterator blob (full pipeline state, "
+              f"{len(state.get('files', []))} file(s))")
   print(f"\nlatest restorable step: {report['latest_restorable_step']}")
   if report['torn_steps']:
     print(f"torn (invisible) steps: {report['torn_steps']}")
