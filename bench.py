@@ -981,6 +981,119 @@ def bench_resume_depth(depths=(1000, 10000, 100000), batch_size: int = 100,
     shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_collect_loop(train_steps: int = 100):
+  """Live-ingest goodput: episodes/s ingested WHILE training.
+
+  Runs the real closed loop (``bin/run_collect_train``): 2 actor
+  subprocesses (pinned to CPU — the robot-host story) collect pose-env
+  episodes against the live export root while this process trains on
+  the follow-mode stream at the device floor. The headline is the
+  follow stream's ingest rate over the training wall — the episodes/s
+  the loop sustains without the trainer stalling (pose episodes are
+  single-step: one record each).
+  """
+  import shutil
+  import tempfile
+
+  from tensor2robot_tpu.bin.run_collect_train import (LoopConfig,
+                                                      run_collect_train)
+
+  tmp = tempfile.mkdtemp(prefix='t2r_bench_loop_')
+  try:
+    config = LoopConfig(
+        model_dir=tmp, num_actors=2, max_train_steps=train_steps,
+        batch_size=16, save_interval_steps=max(1, train_steps // 2),
+        episodes_per_shard=4, window_records=4096,
+        starve_timeout_secs=300.0, seed=0,
+        actor_env={'JAX_PLATFORMS': 'cpu'})
+    result = run_collect_train(config)
+    episodes_per_sec = (result.records_ingested /
+                        max(result.train_seconds, 1e-9))
+    print(json.dumps({
+        'metric': 'collect_episodes_per_sec',
+        'value': round(episodes_per_sec, 2),
+        'unit': 'episodes/s',
+        'train_steps': result.final_step,
+        'train_seconds': round(result.train_seconds, 2),
+        'episodes_ingested': result.records_ingested,
+        'num_actors': config.num_actors,
+        'actor_exit_codes': result.actor_exit_codes,
+    }))
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_loop_restart():
+  """Whole-loop restart number: SIGTERM receipt → resumed training.
+
+  A REAL subprocess drill of the closed loop: start ``bin/
+  run_collect_train``, SIGTERM it once the first checkpoint lands
+  (trainer checkpoints, actors exit 42, driver exits 42), restart the
+  same command, and read the ``trainer/sigterm_to_resumed_step_seconds``
+  measurement the restarted trainer persists to ``loop_restart.json`` —
+  the wall an operator's preemption budget pays END TO END: dispatch
+  drain + forced checkpoint + fleet fan-out + process startup + restore
+  + first post-restore dispatch. Emitted each round next to the
+  restart_to_first_step goodput line.
+  """
+  import os
+  import shutil
+  import signal
+  import subprocess
+  import sys
+  import tempfile
+
+  tmp = tempfile.mkdtemp(prefix='t2r_bench_loop_restart_')
+  cmd = [sys.executable, '-m', 'tensor2robot_tpu.bin.run_collect_train',
+         '--model-dir', tmp, '--num-actors', '1',
+         '--max-train-steps', '100000', '--batch-size', '8',
+         '--save-interval-steps', '30', '--episodes-per-shard', '2',
+         '--actor-episode-interval-secs', '0.05',
+         '--starve-timeout-secs', '300']
+  try:
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    ckpt_dir = os.path.join(tmp, 'checkpoints')
+    deadline = time.time() + 300
+    while time.time() < deadline:
+      if (os.path.isdir(ckpt_dir) and
+          any(e.startswith('ckpt_') for e in os.listdir(ckpt_dir))):
+        break
+      if proc.poll() is not None:
+        raise RuntimeError(f'loop driver died rc={proc.returncode}')
+      time.sleep(0.5)
+    else:
+      proc.kill()
+      raise RuntimeError('no checkpoint within 300s')
+    t_sigterm = time.time()
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    drain_seconds = time.time() - t_sigterm
+
+    proc2 = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    measured_path = os.path.join(tmp, 'loop_restart.json')
+    deadline = time.time() + 300
+    while time.time() < deadline and not os.path.exists(measured_path):
+      if proc2.poll() is not None:
+        raise RuntimeError(f'restarted driver died rc={proc2.returncode}')
+      time.sleep(0.5)
+    proc2.send_signal(signal.SIGTERM)
+    proc2.wait(timeout=120)
+    with open(measured_path) as f:
+      measured = json.load(f)
+    print(json.dumps({
+        'metric': 'loop_restart_seconds',
+        'value': round(measured['sigterm_to_resumed_step_seconds'], 3),
+        'unit': 's',
+        'sigterm_drain_seconds': round(drain_seconds, 3),
+        'preempt_exit_code': rc,
+        'resumed_step': measured.get('resumed_step'),
+    }))
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
   import jax
 
@@ -1050,6 +1163,21 @@ def main():
     bench_resume_depth()
   except Exception as e:  # pylint: disable=broad-except
     print(json.dumps({'metric': 'resume_seconds_at_depth',
+                      'error': repr(e)[:200]}))
+
+  # The WHOLE-loop restart number (ROADMAP direction 5 remaining) +
+  # live-ingest goodput for the closed actor–learner loop (direction 1):
+  # SIGTERM → resumed training across a real subprocess restart, and
+  # episodes/s ingested while training at the device floor.
+  try:
+    bench_loop_restart()
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'loop_restart_seconds',
+                      'error': repr(e)[:200]}))
+  try:
+    bench_collect_loop()
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'collect_episodes_per_sec',
                       'error': repr(e)[:200]}))
 
   state = trainer.state

@@ -1,0 +1,26 @@
+"""Distributed episode collection: actors, shard commit, supervision.
+
+The collect half of the reference's collect→train→export→collect cycle
+(``continuous_collect_eval``, dql_grasping ``run_env``): N actor
+processes drive sim envs with the latest *committed* export, write
+episodes as atomically-committed tfrecord shards, and an
+:class:`~tensor2robot_tpu.collect.actor.ActorSupervisor` keeps the fleet
+alive under crashes. The train half is the input engine's follow mode
+(``data/follow.py``); ``bin/run_collect_train.py`` wires both into one
+supervised loop.
+"""
+
+from tensor2robot_tpu.collect.actor import (
+    ActorConfig,
+    ActorSupervisor,
+    EpisodeShardWriter,
+    run_actor,
+)
+from tensor2robot_tpu.collect.episodes import (
+    EpisodeStamp,
+    encode_feature_map,
+    pose_episode_to_transitions,
+    read_stamp,
+    scan_example,
+    stamp_transition,
+)

@@ -1,0 +1,656 @@
+"""Actor processes: env rollouts → atomically committed episode shards.
+
+One actor = one OS process driving a sim env with the latest *committed*
+export (``ExportedModelPredictor``: torn exports invisible, failed hot
+reloads fall back last-good) and writing stamped episode records as
+rolling tfrecord shards under a commit protocol that makes a killed
+actor harmless:
+
+1. records append to ``.tmp-<shard>`` (never matched by readers),
+2. the file is flushed + fsynced, then atomically renamed to its final
+   ``ep-a<actor>-p<pid>-<n>.tfrecord`` name,
+3. an ``.idx`` seek sidecar is built opportunistically,
+4. the per-shard commit marker ``<shard>.commit`` is published LAST
+   (tmp + fsync + rename), carrying the shard's episode manifest
+   (request/trace ids, policy versions, rollout span timings).
+
+Follow-mode readers (``data/follow.py``) ingest only marker-carrying
+shards, so a SIGKILL anywhere in an actor's life can at worst strand an
+invisible ``.tmp`` file or an unmarked shard — never a torn record in
+the trainer's stream.
+
+:class:`ActorSupervisor` keeps N such processes alive: crashes restart
+under a jittered-backoff :class:`~tensor2robot_tpu.utils.retry.
+RetryPolicy` with a per-actor crash budget; a budget-exhausted actor is
+declared DEAD loudly (``collect/actors_dead`` gauge + flight event)
+instead of respawning forever. Orderly exits — 0 (episode quota) and 42
+(graceful preemption) — are never restarted.
+
+Fault hooks (armed by ``utils/faults.py`` injectors inside the actor
+process): ``_before_commit_hook`` fires between the shard's final write
+and its rename (``KillActorMidEpisode`` SIGKILLs here),
+``_suppress_marker_hook`` drops a shard's commit marker
+(``TornShardInjector``), ``_hold_export_hook`` pins the reload poller to
+a stale generation (``StaleExportInjector``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tensor2robot_tpu.collect import episodes as episodes_lib
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.utils import retry as retry_lib
+
+SHARD_PREFIX = 'ep-'
+COMMIT_SUFFIX = '.commit'
+# Orderly actor exits: completion of an episode quota / graceful
+# preemption (train/resilience.PREEMPTED_EXIT_CODE). Anything else is a
+# crash the supervisor charges against the actor's budget.
+ORDERLY_EXIT_CODES = (0, 42)
+
+# Fault-injection hooks (utils/faults.py arms these IN the actor
+# process; None in production). See module docstring.
+_before_commit_hook: Optional[Callable[[int], None]] = None
+_suppress_marker_hook: Optional[Callable[[int], bool]] = None
+_hold_export_hook: Optional[Callable[[int], bool]] = None
+
+
+def commit_marker_path(shard_path: str) -> str:
+  return shard_path + COMMIT_SUFFIX
+
+
+def _fsync_path(path: str) -> None:
+  fd = os.open(path, os.O_RDONLY)
+  try:
+    os.fsync(fd)
+  finally:
+    os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+  try:
+    fd = os.open(path, os.O_RDONLY)
+  except OSError:
+    return
+  try:
+    os.fsync(fd)
+  except OSError:
+    pass  # some filesystems refuse directory fsync; rename is still atomic
+  finally:
+    os.close(fd)
+
+
+class EpisodeShardWriter:
+  """Rolling episode shards under the atomic commit protocol.
+
+  ``add_episode`` appends one episode's (already stamped) records to the
+  current ``.tmp`` shard and rolls/commits every ``episodes_per_shard``
+  episodes. Shard names embed the actor id AND pid, so a restarted
+  incarnation never collides with its predecessor's files. ``close()``
+  commits a partial final shard if it holds at least one full episode —
+  episodes are the atomicity unit; a shard never carries half of one.
+  """
+
+  def __init__(self, out_dir: str, actor_id: int,
+               episodes_per_shard: int = 8):
+    if episodes_per_shard < 1:
+      raise ValueError(f'episodes_per_shard must be >= 1, got '
+                       f'{episodes_per_shard}')
+    os.makedirs(out_dir, exist_ok=True)
+    self._out_dir = out_dir
+    self._actor_id = int(actor_id)
+    self._episodes_per_shard = int(episodes_per_shard)
+    self._shard_ordinal = 0
+    self._writer = None
+    self._tmp_path: Optional[str] = None
+    self._episode_manifest: List[dict] = []
+    self._record_count = 0
+    self.committed_paths: List[str] = []
+
+  def _shard_name(self) -> str:
+    return (f'{SHARD_PREFIX}a{self._actor_id}-p{os.getpid()}-'
+            f'{self._shard_ordinal:05d}.tfrecord')
+
+  def _open(self) -> None:
+    from tensor2robot_tpu.data import records as records_lib
+
+    name = self._shard_name()
+    self._tmp_path = os.path.join(self._out_dir, f'.tmp-{name}')
+    self._writer = records_lib.RecordWriter(self._tmp_path)
+    self._episode_manifest = []
+    self._record_count = 0
+
+  def add_episode(self, records: Sequence[bytes], meta: dict) -> None:
+    """Appends one episode (all-or-nothing within the shard)."""
+    if self._writer is None:
+      self._open()
+    for record in records:
+      self._writer.write(record)
+    self._record_count += len(records)
+    self._episode_manifest.append(dict(meta, records=len(records)))
+    if len(self._episode_manifest) >= self._episodes_per_shard:
+      self._commit()
+
+  def _commit(self) -> None:
+    """Publish the current shard: fsync → rename → index → marker."""
+    if self._writer is None:
+      return
+    ordinal = self._shard_ordinal
+    final_path = os.path.join(self._out_dir, self._shard_name())
+    self._writer.flush()
+    self._writer.close()
+    self._writer = None
+    _fsync_path(self._tmp_path)
+    if _before_commit_hook is not None:
+      # KillActorMidEpisode fires here: the shard bytes exist only under
+      # the .tmp name, so a SIGKILL at this exact point strands an
+      # invisible file — the torn-write anatomy the drill asserts.
+      _before_commit_hook(ordinal)
+    os.replace(self._tmp_path, final_path)
+    _fsync_dir(self._out_dir)
+    self._tmp_path = None
+    self._shard_ordinal += 1
+    # Opportunistic seek sidecar (data/shard_index.py): committed shards
+    # are immutable, so the index can never go stale; failure only costs
+    # deep-position seeks, never correctness.
+    try:
+      from tensor2robot_tpu.data import shard_index
+
+      shard_index.ensure_index(final_path)
+    except Exception as e:  # pylint: disable=broad-except
+      logging.warning('Cannot index episode shard %r: %r', final_path, e)
+    if _suppress_marker_hook is not None and _suppress_marker_hook(ordinal):
+      # TornShardInjector: the shard stays marker-less forever — follow
+      # readers must never surface its records.
+      flight.event('collect', 'collect/marker_suppressed',
+                   f'actor={self._actor_id} shard={ordinal} (injected)')
+      return
+    marker = {
+        'actor_id': self._actor_id,
+        'pid': os.getpid(),
+        'shard': ordinal,
+        'records': self._record_count,
+        'time': time.time(),
+        'episodes': self._episode_manifest,
+    }
+    marker_path = commit_marker_path(final_path)
+    tmp_marker = marker_path + f'.tmp{os.getpid()}'
+    with open(tmp_marker, 'w') as f:
+      json.dump(marker, f)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp_marker, marker_path)
+    _fsync_dir(self._out_dir)
+    self.committed_paths.append(final_path)
+    metrics_lib.counter('collect/shards_committed').inc()
+    flight.event(
+        'collect', 'collect/shard_committed',
+        f'actor={self._actor_id} shard={ordinal} '
+        f'records={self._record_count} '
+        f'episodes={len(self._episode_manifest)}')
+
+  def close(self) -> None:
+    """Commits a non-empty partial shard; abandons an empty tmp file."""
+    if self._writer is None:
+      return
+    if self._episode_manifest:
+      self._commit()
+      return
+    self._writer.close()
+    self._writer = None
+    if self._tmp_path and os.path.exists(self._tmp_path):
+      os.remove(self._tmp_path)
+    self._tmp_path = None
+
+
+# ------------------------------------------------------------- actor process
+
+
+@dataclasses.dataclass
+class ActorConfig:
+  """One actor process's wiring (JSON-serializable across the spawn)."""
+
+  actor_id: int
+  export_root: str
+  out_dir: str
+  episodes_per_shard: int = 8
+  max_episodes: Optional[int] = None  # None = run until SIGTERM
+  reload_interval_secs: float = 1.0
+  restore_timeout_secs: float = 60.0
+  seed: int = 0
+  # Dotted-path env factory + kwargs; the default is the pose toy env.
+  env_class: str = 'tensor2robot_tpu.research.pose_env.pose_env.PoseToyEnv'
+  env_kwargs: Optional[dict] = None
+  # Dotted-path episode→records fn (the TF-free pose encoder by default).
+  transitions_fn: str = ('tensor2robot_tpu.collect.episodes.'
+                         'pose_episode_to_transitions')
+  # Gaussian exploration noise added to the policy action (clipped to
+  # [-1, 1]). Wide by default: the reference bootstraps its loop from a
+  # UNIFORM random collect, and narrow noise around an untrained
+  # policy's near-zero output concentrates the reward-weighted loss on
+  # near-origin targets (measured: σ=0.3 data plateaus at reward −0.39
+  # where σ=0.8 reaches −0.24 in the same 300 steps).
+  explore_stddev: float = 0.8
+  # Pacing between episodes: a sim env rolls out orders of magnitude
+  # faster than a robot; the throttle keeps drill fleets from burying
+  # the trainer in thousands of tiny shards (0 = flat out).
+  episode_interval_secs: float = 0.0
+  # utils/faults.py injector specs applied INSIDE the actor process,
+  # e.g. ['kill_before_commit:1', 'torn_shard:2', 'hold_export:4'].
+  faults: Optional[List[str]] = None
+
+  def to_json(self) -> str:
+    return json.dumps(dataclasses.asdict(self))
+
+  @classmethod
+  def from_json(cls, text: str) -> 'ActorConfig':
+    return cls(**json.loads(text))
+
+
+def _import_dotted(path: str):
+  import importlib
+
+  module_name, _, attr = path.rpartition('.')
+  return getattr(importlib.import_module(module_name), attr)
+
+
+def run_actor(config: ActorConfig) -> int:
+  """One actor's life; returns the process exit code (0 / 42).
+
+  reload-poll → rollout episode → stamp → shard write, until the episode
+  quota or a graceful-shutdown request. SIGTERM mid-episode ABANDONS the
+  in-flight episode (nothing of it is written — episodes are atomic),
+  commits the current shard's completed episodes, and exits 42.
+  """
+  import numpy as np
+
+  from tensor2robot_tpu.observability import tracing
+  from tensor2robot_tpu.train import resilience
+
+  if config.faults:
+    from tensor2robot_tpu.utils import faults as faults_lib
+
+    for spec in config.faults:
+      faults_lib.apply_actor_fault(spec, config)
+
+  tracing.set_service(f'actor{config.actor_id}')
+  shutdown = resilience.install_graceful_shutdown()
+  rng = np.random.RandomState(config.seed)
+  env = _import_dotted(config.env_class)(**(config.env_kwargs or {}))
+  transitions_fn = _import_dotted(config.transitions_fn)
+
+  from tensor2robot_tpu.export import exporters as exporters_lib
+  from tensor2robot_tpu.policies import RegressionPolicy
+  from tensor2robot_tpu.predictors import ExportedModelPredictor
+
+  predictor = ExportedModelPredictor(
+      config.export_root, timeout=config.restore_timeout_secs)
+  if not predictor.restore():
+    raise RuntimeError(
+        f'actor {config.actor_id}: no committed export appeared under '
+        f'{config.export_root!r} within {config.restore_timeout_secs}s')
+  model = exporters_lib.load_model_from_export_dir(predictor.model_path)
+  policy = RegressionPolicy(t2r_model=model, predictor=predictor)
+
+  writer = EpisodeShardWriter(config.out_dir, config.actor_id,
+                              config.episodes_per_shard)
+  episodes_counter = metrics_lib.counter('collect/episodes')
+  reward_hist = metrics_lib.histogram('collect/episode_reward')
+  version_gauge = metrics_lib.gauge('collect/policy_version')
+  last_reload = time.monotonic()
+  episode_index = 0
+  preempted = False
+  logging.info('actor %d: serving export step %d from %r', config.actor_id,
+               predictor.global_step, predictor.model_path)
+  while config.max_episodes is None or episode_index < config.max_episodes:
+    if shutdown.requested:
+      preempted = True
+      break
+    now = time.monotonic()
+    if now - last_reload >= config.reload_interval_secs:
+      last_reload = now
+      if _hold_export_hook is not None and _hold_export_hook(episode_index):
+        metrics_lib.counter('collect/export_reloads_held').inc()
+      else:
+        before = predictor.global_step
+        predictor.restore()  # last-good fallback + torn-skip built in
+        if predictor.global_step != before:
+          model = exporters_lib.load_model_from_export_dir(
+              predictor.model_path)
+          policy = RegressionPolicy(t2r_model=model, predictor=predictor)
+          metrics_lib.counter('collect/policy_reloads').inc()
+          flight.event(
+              'collect', 'collect/policy_reloaded',
+              f'actor={config.actor_id} version={predictor.global_step}')
+    version = int(predictor.global_step)
+    version_gauge.set(version)
+    trace_id, span_id = tracing.mint_trace_id(), tracing.mint_span_id()
+    request_id = f'ep-a{config.actor_id}-p{os.getpid()}-{episode_index}'
+    t_start = time.time()
+    episode_data, abandoned = _rollout(
+        env, policy, rng, config.explore_stddev, shutdown)
+    new_task = getattr(env, 'set_new_pose', None)
+    if new_task is not None:
+      new_task()  # pose env: episodes are single-step; vary the target
+    if abandoned:
+      # Finish-or-abandon contract: a shutdown observed mid-episode
+      # abandons the incomplete rollout — no partial episode is written.
+      flight.event('collect', 'collect/episode_abandoned',
+                   f'actor={config.actor_id} episode={episode_index}')
+      preempted = True
+      break
+    t_end = time.time()
+    stamp = episodes_lib.EpisodeStamp(
+        actor_id=config.actor_id, policy_version=version,
+        episode_index=episode_index, request_id=request_id,
+        trace_id=trace_id, span_id=span_id, time=t_start)
+    records = [episodes_lib.stamp_transition(r, stamp)
+               for r in transitions_fn(episode_data)]
+    reward = float(sum(step[2] for step in episode_data))
+    writer.add_episode(records, {
+        'request_id': request_id,
+        'trace_id': trace_id,
+        'span_id': span_id,
+        'policy_version': version,
+        'start': t_start,
+        'end': t_end,
+        'reward': reward,
+        'service': f'actor{config.actor_id}',
+    })
+    episodes_counter.inc()
+    reward_hist.observe(reward)
+    episode_index += 1
+    if config.episode_interval_secs > 0:
+      # Interruptible pacing: a SIGTERM during the sleep still exits
+      # within one episode interval.
+      shutdown_event = getattr(shutdown, '_event', None)
+      if shutdown_event is not None:
+        shutdown_event.wait(config.episode_interval_secs)
+      else:
+        time.sleep(config.episode_interval_secs)
+  writer.close()
+  predictor.close()
+  env.close()
+  if preempted:
+    logging.warning(
+        'actor %d: graceful shutdown after %d episode(s); exiting 42.',
+        config.actor_id, episode_index)
+    return resilience.PREEMPTED_EXIT_CODE
+  logging.info('actor %d: completed %d episode(s).', config.actor_id,
+               episode_index)
+  return 0
+
+
+def _rollout(env, policy, rng, explore_stddev: float, shutdown):
+  """One episode; returns ``(episode_data, abandoned)``."""
+  import numpy as np
+
+  episode_data = []
+  policy.reset()
+  obs = env.reset()
+  if isinstance(obs, tuple) and len(obs) == 2:
+    obs = obs[0]  # gymnasium returns (obs, info)
+  done = False
+  while not done:
+    if shutdown.requested:
+      return episode_data, True
+    action = np.asarray(policy.SelectAction(obs, None, None), np.float32)
+    if explore_stddev:
+      action = np.clip(
+          action + rng.normal(0.0, explore_stddev, action.shape).astype(
+              np.float32), -1.0, 1.0)
+    result = env.step(action)
+    if len(result) == 5:  # gymnasium
+      new_obs, reward, terminated, truncated, debug = result
+      done = bool(terminated or truncated)
+    else:
+      new_obs, reward, done, debug = result
+    episode_data.append((obs, action, reward, new_obs, done, debug))
+    obs = new_obs
+  return episode_data, False
+
+
+# --------------------------------------------------------------- supervision
+
+
+class _ActorSlot:
+  """One supervised actor's lifecycle state (all GUARDED_BY the
+  supervisor lock)."""
+
+  def __init__(self, name: str, argv: List[str]):
+    self.name = name
+    self.argv = argv
+    self.proc: Optional[subprocess.Popen] = None
+    self.crashes = 0
+    self.restarts = 0
+    self.dead = False
+    self.exit_code: Optional[int] = None  # last observed exit
+    self.respawn_at: Optional[float] = None  # monotonic deadline
+
+  @property
+  def running(self) -> bool:
+    return self.proc is not None and self.proc.poll() is None
+
+
+class ActorSupervisor:
+  """Restarts crashed actors under a backoff policy and a crash budget.
+
+  ``commands`` maps a display name to the argv that (re)spawns the
+  actor; :meth:`for_configs` builds them for :class:`ActorConfig`
+  fleets. :meth:`poll` advances the state machine one tick (the monitor
+  thread calls it on a cadence; tests may drive it manually):
+
+  * orderly exit (0 / 42) → slot retires, never respawned;
+  * crash → ``collect/actor_crashes``, flight event, and — within the
+    per-actor ``crash_budget`` — a respawn scheduled after the
+    RetryPolicy's jittered backoff (``collect/actor_restarts``);
+  * budget exhausted → the actor is DEAD: ``collect/actors_dead`` rises,
+    a loud flight event + log records the verdict, and the slot never
+    respawns — a crash-looping actor degrades the fleet loudly instead
+    of spinning forever.
+  """
+
+  def __init__(self,
+               commands: Dict[str, List[str]],
+               crash_budget: int = 3,
+               backoff: Optional[retry_lib.RetryPolicy] = None,
+               env: Optional[Dict[str, str]] = None):
+    self._lock = threading.Lock()
+    self._slots = {name: _ActorSlot(name, list(argv))
+                   for name, argv in commands.items()}  # GUARDED_BY(self._lock)
+    self._crash_budget = int(crash_budget)
+    self._backoff = backoff or retry_lib.RetryPolicy(
+        max_attempts=crash_budget + 1, base_delay=0.25, max_delay=10.0)
+    self._env = dict(env) if env is not None else None
+    self._monitor: Optional[threading.Thread] = None
+    self._stop_monitor = threading.Event()
+    self._dead_gauge = metrics_lib.gauge('collect/actors_dead')
+    self._alive_gauge = metrics_lib.gauge('collect/actors_alive')
+
+  @classmethod
+  def for_configs(cls, configs: Sequence[ActorConfig],
+                  **kwargs) -> 'ActorSupervisor':
+    commands = {
+        f'actor{c.actor_id}': [
+            sys.executable, '-m', 'tensor2robot_tpu.collect.actor_main',
+            '--config-json', c.to_json(),
+        ]
+        for c in configs
+    }
+    return cls(commands, **kwargs)
+
+  def start(self) -> None:
+    with self._lock:
+      for slot in self._slots.values():
+        if slot.proc is None and not slot.dead:
+          self._spawn(slot)
+    self._publish()
+
+  def _spawn(self, slot: _ActorSlot) -> None:
+    """GUARDED_BY(self._lock) — callers hold the supervisor lock."""
+    slot.proc = subprocess.Popen(slot.argv, env=self._env)
+    slot.respawn_at = None
+    flight.event('collect', 'collect/actor_spawned',
+                 f'name={slot.name} pid={slot.proc.pid} '
+                 f'restarts={slot.restarts}')
+
+  def poll(self) -> None:
+    """One supervision tick: reap exits, schedule/execute respawns."""
+    now = time.monotonic()
+    with self._lock:
+      for slot in self._slots.values():
+        if slot.dead:
+          continue
+        if slot.proc is not None:
+          rc = slot.proc.poll()
+          if rc is None:
+            continue
+          slot.proc = None
+          slot.exit_code = rc
+          if rc in ORDERLY_EXIT_CODES:
+            flight.event('collect', 'collect/actor_exit',
+                         f'name={slot.name} code={rc} orderly=1')
+            continue
+          slot.crashes += 1
+          metrics_lib.counter('collect/actor_crashes').inc()
+          flight.event(
+              'collect', 'collect/actor_crashed',
+              f'name={slot.name} code={rc} crashes={slot.crashes}/'
+              f'{self._crash_budget}')
+          logging.warning('Actor %s crashed (exit %s), crash %d/%d.',
+                          slot.name, rc, slot.crashes, self._crash_budget)
+          if slot.crashes > self._crash_budget:
+            slot.dead = True
+            flight.event(
+                'collect', 'collect/actor_dead',
+                f'name={slot.name} crashes={slot.crashes} verdict=DEAD')
+            logging.error(
+                'Actor %s is DEAD: %d crash(es) exceeded the budget of %d; '
+                'not respawning. The fleet continues degraded.',
+                slot.name, slot.crashes, self._crash_budget)
+            continue
+          delay = self._backoff.delay(slot.crashes - 1)
+          slot.respawn_at = now + delay
+          logging.warning('Actor %s respawns in %.2fs.', slot.name, delay)
+        elif slot.respawn_at is not None and now >= slot.respawn_at:
+          slot.restarts += 1
+          metrics_lib.counter('collect/actor_restarts').inc()
+          self._spawn(slot)
+    self._publish()
+
+  def _publish(self) -> None:
+    with self._lock:
+      dead = sum(1 for s in self._slots.values() if s.dead)
+      alive = sum(1 for s in self._slots.values() if s.running)
+    self._dead_gauge.set(dead)
+    self._alive_gauge.set(alive)
+
+  def start_monitor(self, interval_secs: float = 0.25) -> None:
+    """Runs :meth:`poll` on a daemon thread until :meth:`stop`."""
+    if self._monitor is not None:
+      return
+
+    def loop():
+      while not self._stop_monitor.wait(interval_secs):
+        self.poll()
+
+    self._stop_monitor.clear()
+    self._monitor = threading.Thread(
+        target=loop, name='actor-supervisor', daemon=True)
+    self._monitor.start()
+
+  def request_stop(self, sig: int = signal.SIGTERM) -> None:
+    """Fans the shutdown signal out to every live actor."""
+    with self._lock:
+      for slot in self._slots.values():
+        slot.respawn_at = None  # a stopping fleet schedules no respawns
+        if slot.running:
+          try:
+            slot.proc.send_signal(sig)
+          except OSError:
+            pass
+    flight.event('collect', 'collect/stop_requested', f'signal={sig}')
+
+  def wait(self, timeout_secs: float = 30.0,
+           kill_after_timeout: bool = True) -> Dict[str, Optional[int]]:
+    """Waits for every actor to exit; SIGKILLs stragglers past the
+    deadline. Returns ``{name: exit_code}`` (None = still running)."""
+    deadline = time.monotonic() + timeout_secs
+    with self._lock:
+      slots = list(self._slots.values())
+    for slot in slots:
+      with self._lock:
+        proc = slot.proc
+      if proc is None:
+        continue
+      remaining = max(0.0, deadline - time.monotonic())
+      try:
+        rc = proc.wait(timeout=remaining)
+      except subprocess.TimeoutExpired:
+        if not kill_after_timeout:
+          continue
+        logging.error('Actor %s ignored shutdown for %.1fs; SIGKILL.',
+                      slot.name, timeout_secs)
+        proc.kill()
+        rc = proc.wait()
+      with self._lock:
+        slot.exit_code = rc
+        slot.proc = None
+    self.stop_monitor()
+    self._publish()
+    return self.exit_codes()
+
+  def stop_monitor(self) -> None:
+    if self._monitor is not None:
+      self._stop_monitor.set()
+      self._monitor.join(timeout=5.0)
+      self._monitor = None
+
+  def exit_codes(self) -> Dict[str, Optional[int]]:
+    with self._lock:
+      return {name: slot.exit_code for name, slot in self._slots.items()}
+
+  def stats(self) -> Dict[str, dict]:
+    with self._lock:
+      return {
+          name: {
+              'running': slot.running, 'crashes': slot.crashes,
+              'restarts': slot.restarts, 'dead': slot.dead,
+              'exit_code': slot.exit_code,
+          } for name, slot in self._slots.items()
+      }
+
+  def any_alive(self) -> bool:
+    with self._lock:
+      return any(s.running for s in self._slots.values())
+
+  def any_dead(self) -> bool:
+    with self._lock:
+      return any(s.dead for s in self._slots.values())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  """Actor subprocess entry (spawned via ``collect/actor_main.py``)."""
+  import argparse
+
+  parser = argparse.ArgumentParser(description='episode-collecting actor')
+  parser.add_argument('--config-json', required=True,
+                      help='ActorConfig as a JSON object.')
+  args = parser.parse_args(argv)
+  logging.basicConfig(level=logging.INFO)
+  return run_actor(ActorConfig.from_json(args.config_json))
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,16 @@
+"""Actor subprocess entry point.
+
+A separate module from ``collect/actor.py`` so ``python -m`` execution
+never re-runs a module the package ``__init__`` already imported (the
+runpy double-import warning); the supervisor spawns
+``python -m tensor2robot_tpu.collect.actor_main --config-json ...``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tensor2robot_tpu.collect import actor
+
+if __name__ == '__main__':
+  sys.exit(actor.main())

@@ -1,0 +1,272 @@
+"""Episode record codec: TF-free tf.Example encode/scan + provenance stamps.
+
+Actors run on robot-class hosts (the serving-host contract: numpy + PIL
++ the native record writer, no TensorFlow wheel), yet the episodes they
+write must parse through BOTH training parse paths — the native C++ wire
+parser and tf.data — so this module hand-encodes the ``tf.train.Example``
+wire format with the stdlib only:
+
+* :func:`encode_feature_map` — ``{key: bytes | floats | ints}`` → one
+  serialized Example (packed float/int64 lists, exactly what the TF
+  serializer emits).
+* :func:`scan_example` — the inverse walk, for inspection tooling
+  (``tools/inspect_episodes.py``) on TF-free hosts.
+* :func:`stamp_transition` — appends the collecting actor's provenance
+  STAMP (actor id, policy version, trace/request ids) to an
+  already-serialized transition by protobuf message-merge semantics:
+  concatenating two serialized Examples merges their feature maps, so
+  stamping never re-encodes the (image-heavy) transition payload.
+  Training parsers ignore the stamp keys (spec-driven parse); forensics
+  tooling reads them back with :func:`read_stamp`, and the ids join the
+  record to the actor's flight events and trace spans
+  (``tools/assemble_trace.py --request``).
+
+The stamp keys live under ``collect/`` — reserved: models must not spec
+features under that prefix.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+FeatureValue = Union[bytes, Sequence[float], Sequence[int]]
+
+# Stamp feature keys (the ``collect/`` prefix is reserved for provenance).
+STAMP_ACTOR_ID = 'collect/actor_id'
+STAMP_POLICY_VERSION = 'collect/policy_version'
+STAMP_EPISODE_INDEX = 'collect/episode_index'
+STAMP_REQUEST_ID = 'collect/request_id'
+STAMP_TRACE_ID = 'collect/trace_id'
+STAMP_SPAN_ID = 'collect/span_id'
+STAMP_TIME = 'collect/time'
+
+
+def _varint(value: int) -> bytes:
+  out = bytearray()
+  while True:
+    bits = value & 0x7F
+    value >>= 7
+    if value:
+      out.append(bits | 0x80)
+    else:
+      out.append(bits)
+      return bytes(out)
+
+
+def _len_field(field_number: int, payload: bytes) -> bytes:
+  return _varint((field_number << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _encode_feature(value: FeatureValue) -> bytes:
+  """One ``Feature`` message: BytesList(1) / FloatList(2) / Int64List(3)."""
+  if isinstance(value, bytes):
+    return _len_field(1, _len_field(1, value))
+  values = list(value)
+  if all(isinstance(v, (int, bool)) and not isinstance(v, float)
+         for v in values):
+    packed = b''.join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in values)
+    return _len_field(3, _len_field(1, packed))
+  packed = struct.pack(f'<{len(values)}f', *[float(v) for v in values])
+  return _len_field(2, _len_field(1, packed))
+
+
+def encode_feature_map(features: Dict[str, FeatureValue]) -> bytes:
+  """Serializes ``{key: value}`` as one ``tf.train.Example``.
+
+  ``bytes`` values become a single-element BytesList; int sequences a
+  packed Int64List; everything else a packed FloatList — the exact
+  wire bytes ``tf.train.Example`` would serialize (pinned against TF in
+  the tests), so both training parse paths accept them.
+  """
+  entries = []
+  for key in sorted(features):
+    entry = (_len_field(1, key.encode()) +
+             _len_field(2, _encode_feature(features[key])))
+    entries.append(_len_field(1, entry))
+  return _len_field(1, b''.join(entries))
+
+
+# ----------------------------------------------------------------- scanning
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+  result = shift = 0
+  while True:
+    byte = data[pos]
+    pos += 1
+    result |= (byte & 0x7F) << shift
+    if not byte & 0x80:
+      return result, pos
+    shift += 7
+
+
+def _fields(data: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
+  """Yields ``(field_number, wire_type, value)`` over one message."""
+  pos = 0
+  while pos < len(data):
+    tag, pos = _read_varint(data, pos)
+    field, wire = tag >> 3, tag & 7
+    if wire == 0:
+      value, pos = _read_varint(data, pos)
+    elif wire == 2:
+      length, pos = _read_varint(data, pos)
+      value = data[pos:pos + length]
+      pos += length
+    elif wire == 5:
+      value = data[pos:pos + 4]
+      pos += 4
+    elif wire == 1:
+      value = data[pos:pos + 8]
+      pos += 8
+    else:
+      raise ValueError(f'unsupported wire type {wire} at offset {pos}')
+    yield field, wire, value
+
+
+def _decode_feature(data: bytes) -> Tuple[str, list]:
+  kind, values = 'empty', []
+  for field, wire, payload in _fields(data):
+    if field == 1 and wire == 2:  # BytesList
+      kind = 'bytes'
+      values.extend(v for f, w, v in _fields(payload) if f == 1 and w == 2)
+    elif field == 2 and wire == 2:  # FloatList
+      kind = 'float'
+      for f, w, v in _fields(payload):
+        if f != 1:
+          continue
+        if w == 2:  # packed
+          values.extend(struct.unpack(f'<{len(v) // 4}f', v))
+        elif w == 5:
+          values.append(struct.unpack('<f', v)[0])
+    elif field == 3 and wire == 2:  # Int64List
+      kind = 'int64'
+      for f, w, v in _fields(payload):
+        if f != 1:
+          continue
+        if w == 2:  # packed varints
+          pos = 0
+          while pos < len(v):
+            value, pos = _read_varint(v, pos)
+            values.append(value - (1 << 64) if value >= (1 << 63) else value)
+        elif w == 0:
+          values.append(v - (1 << 64) if v >= (1 << 63) else v)
+  return kind, values
+
+
+def scan_example(serialized: bytes) -> Dict[str, Tuple[str, list]]:
+  """Parses one serialized Example: ``{key: (kind, values)}``.
+
+  Map-merge semantics match protobuf: a key appearing in several
+  concatenated fragments (a stamped transition) keeps the LAST
+  occurrence, exactly what a proto parser would materialize.
+  """
+  out: Dict[str, Tuple[str, list]] = {}
+  for field, wire, features in _fields(serialized):
+    if field != 1 or wire != 2:
+      continue
+    for f, w, entry in _fields(features):
+      if f != 1 or w != 2:
+        continue
+      key: Optional[str] = None
+      feature = b''
+      for ef, ew, ev in _fields(entry):
+        if ef == 1 and ew == 2:
+          key = ev.decode('utf-8', 'replace')
+        elif ef == 2 and ew == 2:
+          feature = ev
+      if key is not None:
+        out[key] = _decode_feature(feature)
+  return out
+
+
+# ------------------------------------------------------------------ stamping
+
+
+class EpisodeStamp(NamedTuple):
+  """Provenance of one episode: who collected it, with which policy.
+
+  ``request_id`` is the episode's fleet-unique id (the
+  ``assemble_trace --request`` join key); ``trace_id``/``span_id`` are
+  the actor's rollout trace coordinates (``observability/tracing.py``
+  formats), so a bad gradient traced to a record resolves to the exact
+  actor rollout — and through the export generation (``policy_version``
+  is the export's global step) to the trainer state that produced it.
+  """
+
+  actor_id: int
+  policy_version: int
+  episode_index: int
+  request_id: str
+  trace_id: str
+  span_id: str
+  time: float
+
+  def features(self) -> Dict[str, FeatureValue]:
+    return {
+        STAMP_ACTOR_ID: [self.actor_id],
+        STAMP_POLICY_VERSION: [self.policy_version],
+        STAMP_EPISODE_INDEX: [self.episode_index],
+        STAMP_REQUEST_ID: self.request_id.encode(),
+        STAMP_TRACE_ID: self.trace_id.encode(),
+        STAMP_SPAN_ID: self.span_id.encode(),
+        # int64 epoch milliseconds: a FloatList is float32 on the wire,
+        # whose ~2^7-second granularity at epoch scale is useless.
+        STAMP_TIME: [int(self.time * 1000)],
+    }
+
+
+def stamp_transition(serialized: bytes, stamp: EpisodeStamp) -> bytes:
+  """Appends the stamp to a serialized transition (proto merge)."""
+  return serialized + encode_feature_map(stamp.features())
+
+
+def read_stamp(serialized: bytes) -> Optional[dict]:
+  """The stamp of a record, or None for unstamped records."""
+  scanned = scan_example(serialized)
+  if STAMP_REQUEST_ID not in scanned:
+    return None
+
+  def _one(key, default=None):
+    kind_values = scanned.get(key)
+    if not kind_values or not kind_values[1]:
+      return default
+    value = kind_values[1][0]
+    return value.decode('utf-8', 'replace') if isinstance(value, bytes) \
+        else value
+
+  return {
+      'actor_id': int(_one(STAMP_ACTOR_ID, -1)),
+      'policy_version': int(_one(STAMP_POLICY_VERSION, -1)),
+      'episode_index': int(_one(STAMP_EPISODE_INDEX, -1)),
+      'request_id': _one(STAMP_REQUEST_ID, ''),
+      'trace_id': _one(STAMP_TRACE_ID, ''),
+      'span_id': _one(STAMP_SPAN_ID, ''),
+      'time': float(_one(STAMP_TIME, 0)) / 1000.0,
+  }
+
+
+# ------------------------------------------------- pose-env transitions (TF-free)
+
+
+def pose_episode_to_transitions(episode_data: Sequence[Tuple]) -> List[bytes]:
+  """TF-free twin of ``pose_env.episode_to_transitions_pose_toy``.
+
+  Identical record schema (``state/image`` JPEG bytes, ``pose`` [2],
+  ``reward`` [1], ``target_pose`` [2]) built with the stdlib encoder, so
+  actor hosts never import TensorFlow.
+  """
+  import numpy as np
+
+  from tensor2robot_tpu.utils import image as image_lib
+
+  transitions = []
+  for (obs_t, action, reward, _, _, debug) in episode_data:
+    transitions.append(encode_feature_map({
+        'state/image': image_lib.numpy_to_image_string(obs_t),
+        'pose': [float(v) for v in np.asarray(action).flatten()],
+        'reward': [float(reward)],
+        'target_pose': [float(v)
+                        for v in np.asarray(debug['target_pose']).flatten()],
+    }))
+  return transitions

@@ -1,0 +1,392 @@
+"""Follow mode: tail a growing shard directory into a sampling window.
+
+The train half of the collect→train→export→collect loop: actors
+(``collect/actor.py``) keep committing episode shards into a directory;
+a :class:`FollowStream` tails it, ingests ONLY commit-marked shards, and
+serves records out of a bounded replay-buffer-style sampling window, so
+the trainer's input engine consumes a live, changing corpus with the
+same ``Iterator[bytes]`` contract a static interleave has.
+
+Robustness contracts, drilled by ``tests/test_collect_loop.py``:
+
+* **Torn shards are invisible.** A shard file without its
+  ``<shard>.commit`` marker (a killed actor, a suppressed marker) is
+  never opened — only counted (``data/follow/torn_pending``). Commit
+  markers are published atomically AFTER the shard bytes are durable,
+  so a marker implies a complete shard.
+* **Corrupt/stale shards skip loudly.** A committed shard that fails
+  its CRC-verified read charges the stream's
+  :class:`~tensor2robot_tpu.utils.retry.ErrorBudget` (per-source
+  accounting, ``resilience/data_errors/...``) and is skipped; the
+  budget's exhaustion raises, never silently shrinking the corpus.
+* **Bounded-wait backpressure, both directions.** When the trainer
+  outruns collection the sampler BLOCKS on a condition (no busy-spin)
+  until the window holds ``min_window_records``, bounded by
+  ``starve_timeout_secs`` — exhaustion raises a loud
+  :class:`FollowStarvedError`, never a silent hang. When collection
+  outruns the trainer the bounded window evicts oldest records
+  (``data/follow/evicted_records``) — memory is fixed, staleness
+  shrinks.
+* **Off-policy staleness is measurable.** Every record carries the
+  policy version (export global step) that collected it (the
+  ``collect/`` stamp manifest riding the commit marker);
+  ``data/follow/staleness_steps`` gauges sampled-record age against the
+  newest version seen, next to ``data/follow/{shards_seen,
+  window_records}``.
+
+Each commit marker also carries its episodes' rollout-span manifest
+(trace/span ids + timings); ingest records the actor's rollout span and
+a child ``data/follow/ingest`` span into this process's span index, so
+``tools/assemble_trace.py --request <episode>`` resolves a training
+record back through the trainer to the actor and export generation that
+produced it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as glob_lib
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import List, Optional, Set, Tuple
+
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.utils import retry as retry_lib
+
+COMMIT_SUFFIX = '.commit'
+
+
+class FollowStarvedError(RuntimeError):
+  """The sampling window stayed under its minimum past the bounded wait.
+
+  Collection has stalled (actors dead? filesystem wedged?) — raising is
+  the honest move; a trainer silently spinning on an empty window would
+  look like a hang.
+  """
+
+
+@dataclasses.dataclass
+class FollowConfig:
+  """Follow-mode knobs (see module docstring for the semantics)."""
+
+  directory: str
+  pattern: str = '*.tfrecord'
+  poll_interval_secs: float = 0.25
+  # Bounded sampling window (records). Collection past it evicts oldest.
+  window_records: int = 4096
+  # Sampling blocks until the window holds this many records (None =
+  # one training batch, resolved by the input generator).
+  min_window_records: Optional[int] = None
+  # Bounded wait for the window minimum; exceeded → FollowStarvedError.
+  starve_timeout_secs: float = 120.0
+  # Tolerated unreadable committed shards (ErrorBudget; the raise path).
+  error_budget: int = 10
+  seed: Optional[int] = None
+  # Ingest the commit markers' episode manifests into the span index
+  # (the assemble_trace --request join).
+  record_trace_spans: bool = True
+  # Drill accounting: keep sha1 digests of every sampled record on the
+  # stream (bounded by window uniqueness) so tests can assert the
+  # trainer stream is byte-clean against the committed shard set.
+  trace_samples: bool = False
+
+
+class FollowStream:
+  """``Iterator[bytes]`` over a live shard directory (see module doc).
+
+  One background follower thread ingests committed shards into the
+  window; any number of consumer threads sample (the input engine uses
+  exactly one issuer). ``close()`` stops the follower and makes
+  ``next()`` raise ``StopIteration`` — the engine then drains normally.
+  """
+
+  def __init__(self, config: FollowConfig, batch_size: int = 1):
+    import numpy as np
+
+    if config.window_records < 1:
+      raise ValueError(
+          f'window_records must be >= 1, got {config.window_records}')
+    self._config = config
+    self._min_records = (config.min_window_records
+                         if config.min_window_records is not None
+                         else max(1, int(batch_size)))
+    if self._min_records > config.window_records:
+      raise ValueError(
+          f'min_window_records={self._min_records} exceeds the window '
+          f'capacity {config.window_records}: sampling could never start')
+    self._rng = np.random.RandomState(config.seed)
+    self._lock = threading.Lock()
+    self._cond = threading.Condition(self._lock)
+    # The sampling window: (record_bytes, policy_version) pairs, evicted
+    # FIFO past window_records.
+    self._window: List[Tuple[bytes, int]] = []  # GUARDED_BY(self._lock)
+    self._ingested_shards: Set[str] = set()  # GUARDED_BY(self._lock)
+    self._latest_version = -1  # GUARDED_BY(self._lock)
+    self._closed = False  # GUARDED_BY(self._lock)
+    self._shards_seen = 0  # GUARDED_BY(self._lock)
+    self.sampled_hashes: Set[bytes] = set()  # GUARDED_BY(self._lock)
+    self._budget = retry_lib.ErrorBudget(
+        config.error_budget, name='follow stream')
+    self._budget_error: Optional[BaseException] = None  # GUARDED_BY(self._lock)
+    # Registry series (static names: the cardinality gate).
+    self._g_shards = metrics_lib.gauge('data/follow/shards_seen')
+    self._g_window = metrics_lib.gauge('data/follow/window_records')
+    self._g_staleness = metrics_lib.gauge('data/follow/staleness_steps')
+    # High-water mark (monotonic per stream): the drill-assertable proof
+    # that off-policy data was actually served at some point, which the
+    # instantaneous gauge can't retain.
+    self._g_max_staleness = metrics_lib.gauge(
+        'data/follow/max_staleness_steps')
+    self._max_staleness = 0  # GUARDED_BY(self._lock)
+    self._g_torn = metrics_lib.gauge('data/follow/torn_pending')
+    self._c_records = metrics_lib.counter('data/follow/records_ingested')
+    self._c_evicted = metrics_lib.counter('data/follow/evicted_records')
+    self._c_samples = metrics_lib.counter('data/follow/samples')
+    self._c_waits = metrics_lib.counter('data/follow/sample_waits')
+    self._h_wait_ms = metrics_lib.histogram('data/follow/sample_wait_ms')
+    self._c_skipped = metrics_lib.counter('data/follow/skipped_shards')
+    self._follower = threading.Thread(
+        target=self._follow_loop, name='follow-ingest', daemon=True)
+    self._follower.start()
+
+  # ------------------------------------------------------------- ingestion
+
+  def _committed_shards(self) -> Tuple[List[str], int]:
+    """Shards whose commit marker exists, plus the torn-pending count.
+
+    A marker names a COMPLETE shard (the writer publishes it last), so
+    marker presence is the only visibility authority. Deterministic
+    order: markers sorted by (mtime, name) — commit order, name-tied.
+    """
+    directory = self._config.directory
+    shards = glob_lib.glob(os.path.join(directory, self._config.pattern))
+    committed, torn = [], 0
+    for shard in shards:
+      if os.path.exists(shard + COMMIT_SUFFIX):
+        committed.append(shard)
+      else:
+        torn += 1
+
+    def order(path):
+      try:
+        mtime = os.path.getmtime(path + COMMIT_SUFFIX)
+      except OSError:
+        mtime = 0.0
+      return (mtime, path)
+
+    return sorted(committed, key=order), torn
+
+  def _follow_loop(self) -> None:
+    while True:
+      with self._lock:
+        if self._closed:
+          return
+        seen = set(self._ingested_shards)
+      try:
+        committed, torn = self._committed_shards()
+        self._g_torn.set(torn)
+        for shard in committed:
+          if shard in seen:
+            continue
+          self._ingest_shard(shard)
+          with self._lock:
+            if self._closed:
+              return
+      except retry_lib.DataErrorBudgetExceededError as e:
+        # Surface on the consumer thread: the sampler re-raises it so
+        # the trainer dies loudly instead of starving quietly.
+        with self._cond:
+          self._budget_error = e
+          self._cond.notify_all()
+        return
+      except Exception as e:  # pylint: disable=broad-except
+        # Directory scans must survive transient filesystem errors; the
+        # budget machinery above is the bounded-failure authority.
+        logging.warning('Follow scan of %r failed (%r); retrying.',
+                        self._config.directory, e)
+      with self._cond:
+        if self._closed:
+          return
+        self._cond.wait(timeout=self._config.poll_interval_secs)
+
+  def _read_shard(self, shard: str) -> List[bytes]:
+    """All records of a committed shard, CRC-verified."""
+    from tensor2robot_tpu.data import native_io, shard_index
+
+    if native_io.available() and '://' not in shard:
+      with native_io.NativeRecordReader(shard) as reader:
+        return list(reader)
+    return list(shard_index.iter_records_from(shard, 0))
+
+  def _episode_versions(self, shard: str,
+                        record_count: int) -> Tuple[List[int], dict]:
+    """Per-record policy versions from the commit-marker manifest."""
+    marker: dict = {}
+    try:
+      with open(shard + COMMIT_SUFFIX) as f:
+        marker = json.load(f)
+    except (OSError, ValueError):
+      pass
+    versions: List[int] = []
+    for episode in marker.get('episodes', []):
+      versions.extend([int(episode.get('policy_version', -1))] *
+                      int(episode.get('records', 0)))
+    if len(versions) < record_count:
+      versions.extend([-1] * (record_count - len(versions)))
+    return versions[:record_count], marker
+
+  def _record_ingest_spans(self, marker: dict, t0: float, t1: float) -> None:
+    """Actor rollout spans (riding the marker) + this process's ingest
+    child spans → the span index, one batched call per shard."""
+    from tensor2robot_tpu.observability import tracing
+
+    span_dicts = []
+    for episode in marker.get('episodes', []):
+      trace_id = episode.get('trace_id')
+      span_id = episode.get('span_id')
+      if not trace_id or not span_id:
+        continue
+      request_id = episode.get('request_id', '')
+      span_dicts.append({
+          'trace_id': trace_id, 'span_id': span_id, 'parent_id': '',
+          'name': 'collect/rollout', 'kind': 'collect',
+          'start': float(episode.get('start', t0)),
+          'end': float(episode.get('end', t0)),
+          'request_id': request_id,
+          'detail': (f"actor={marker.get('actor_id')} "
+                     f"version={episode.get('policy_version')} "
+                     f"reward={episode.get('reward')}"),
+          'service': episode.get('service',
+                                 f"actor{marker.get('actor_id')}"),
+      })
+      span_dicts.append({
+          'trace_id': trace_id, 'span_id': tracing.mint_span_id(),
+          'parent_id': span_id, 'name': 'data/follow/ingest',
+          'kind': 'collect', 'start': t0, 'end': t1,
+          'request_id': request_id,
+          'detail': f"version={episode.get('policy_version')}",
+      })
+    if span_dicts:
+      tracing.record_spans(span_dicts)
+
+  def _ingest_shard(self, shard: str) -> None:
+    t0 = time.time()
+    try:
+      records = self._read_shard(shard)
+    except (IOError, OSError, ValueError) as e:
+      # A COMMITTED shard that cannot be read: stale replication, bitrot,
+      # or an injected tear. Budget-charged per source, skipped loudly.
+      self._c_skipped.inc()
+      flight.event('collect', 'data/follow/shard_skipped',
+                   f'shard={os.path.basename(shard)} error='
+                   f'{type(e).__name__}')
+      with self._lock:
+        self._ingested_shards.add(shard)  # never retried: skip is final
+      self._budget.record(e, source=shard)
+      return
+    versions, marker = self._episode_versions(shard, len(records))
+    t1 = time.time()
+    evicted = 0
+    with self._cond:
+      self._ingested_shards.add(shard)
+      self._shards_seen += 1
+      for record, version in zip(records, versions):
+        self._window.append((record, version))
+        if version > self._latest_version:
+          self._latest_version = version
+      overflow = len(self._window) - self._config.window_records
+      if overflow > 0:
+        del self._window[:overflow]
+        evicted = overflow
+      window_size = len(self._window)
+      shards_seen = self._shards_seen
+      self._cond.notify_all()
+    self._c_records.inc(len(records))
+    if evicted:
+      self._c_evicted.inc(evicted)
+    self._g_shards.set(shards_seen)
+    self._g_window.set(window_size)
+    flight.event(
+        'collect', 'data/follow/shard_ingested',
+        f'shard={os.path.basename(shard)} records={len(records)} '
+        f'window={window_size} evicted={evicted}')
+    if self._config.record_trace_spans and marker:
+      self._record_ingest_spans(marker, t0, t1)
+
+  # -------------------------------------------------------------- sampling
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> bytes:
+    deadline = time.monotonic() + self._config.starve_timeout_secs
+    waited = False
+    t_wait0 = time.monotonic()
+    with self._cond:
+      while True:
+        if self._budget_error is not None:
+          raise self._budget_error
+        if self._closed:
+          raise StopIteration
+        if len(self._window) >= self._min_records:
+          break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+          raise FollowStarvedError(
+              f'follow stream starved: window holds {len(self._window)} '
+              f'record(s) < minimum {self._min_records} after '
+              f'{self._config.starve_timeout_secs:.1f}s '
+              f'({self._shards_seen} shard(s) ingested from '
+              f'{self._config.directory!r}); collection has stalled')
+        if not waited:
+          waited = True
+          self._c_waits.inc()
+        self._cond.wait(timeout=remaining)
+      index = int(self._rng.randint(len(self._window)))
+      record, version = self._window[index]
+      staleness = (self._latest_version - version
+                   if version >= 0 and self._latest_version >= 0 else 0)
+      staleness = max(0, staleness)
+      if staleness > self._max_staleness:
+        self._max_staleness = staleness
+      max_staleness = self._max_staleness
+      if self._config.trace_samples:
+        self.sampled_hashes.add(hashlib.sha1(record).digest())
+    if waited:
+      self._h_wait_ms.observe((time.monotonic() - t_wait0) * 1e3)
+    self._c_samples.inc()
+    self._g_staleness.set(staleness)
+    self._g_max_staleness.set(max_staleness)
+    return record
+
+  # ------------------------------------------------------------- lifecycle
+
+  @property
+  def latest_version(self) -> int:
+    with self._lock:
+      return self._latest_version
+
+  @property
+  def window_size(self) -> int:
+    with self._lock:
+      return len(self._window)
+
+  @property
+  def shards_seen(self) -> int:
+    with self._lock:
+      return self._shards_seen
+
+  def ingested_shards(self) -> Set[str]:
+    with self._lock:
+      return set(self._ingested_shards)
+
+  def close(self) -> None:
+    with self._cond:
+      self._closed = True
+      self._cond.notify_all()
+    self._follower.join(timeout=5.0)

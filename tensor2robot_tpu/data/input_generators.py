@@ -340,6 +340,19 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
   :meth:`create_checkpointable_iterator` supports mid-epoch resume:
   restore replays the record stream to the saved batch count (read-only
   fast-forward, no parse/decode) and continues bit-exactly.
+
+  **Follow mode** (``follow=`` a ``data/follow.FollowConfig`` or a
+  directory path) replaces the static interleave with a live tail of a
+  GROWING shard directory (``data/follow.py``): only commit-marked
+  shards are ingested, records are sampled from a bounded
+  replay-buffer-style window (the window IS the shuffle; the static
+  shuffle buffer is bypassed), and off-policy staleness is gauged under
+  ``data/follow/*``. Torn/unreadable shards skip loudly through the
+  follow stream's own error budget (``FollowConfig.error_budget``), so
+  the generator-level ``error_budget`` must stay None in follow mode.
+  The stream has no checkpointable position — a restarted trainer
+  re-enters the live window — so
+  :meth:`create_checkpointable_iterator` refuses.
   """
 
   def __init__(self,
@@ -355,10 +368,24 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
                engine_workers: Optional[int] = None,
                engine_ring_depth: Optional[int] = None,
                reuse_batch_buffers: bool = False,
-               engine_reautotune: Optional[bool] = None):
+               engine_reautotune: Optional[bool] = None,
+               follow=None):
     super().__init__(batch_size, error_budget=error_budget)
     if not file_patterns:
       raise ValueError('Provide file_patterns.')
+    if follow is not None:
+      from tensor2robot_tpu.data import follow as follow_lib
+
+      if isinstance(follow, str):
+        follow = follow_lib.FollowConfig(directory=follow)
+      if error_budget is not None:
+        raise ValueError(
+            'follow mode owns its error budget (FollowConfig.error_budget); '
+            'pass error_budget=None on the generator.')
+    self._follow = follow
+    # The live follow stream behind the most recent iterator (follow
+    # mode only): exposes close() and the drill accounting surface.
+    self.follow_stream = None
     self._file_patterns = file_patterns
     self._shuffle_buffer_size = shuffle_buffer_size
     self._cycle_length = cycle_length
@@ -490,6 +517,23 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
           'Specs not natively parseable (sequence/multi-dataset/'
           'multi-image features, or no C++ toolchain); use '
           'DefaultRecordInputGenerator.')
+    if self._follow is not None:
+      if skip_batches or resume is not None:
+        raise ValueError(
+            'follow-mode streams have no checkpointable position; '
+            'a restarted trainer re-enters the live window.')
+      from tensor2robot_tpu.data import follow as follow_lib
+
+      self.follow_stream = follow_lib.FollowStream(
+          self._follow, batch_size=batch_size)
+      decision = engine_lib.autotune(self._engine_workers,
+                                     self._engine_ring_depth)
+      return engine_lib.ParallelBatchEngine(
+          iter(self.follow_stream), parse_fn, batch_size,
+          num_workers=decision.num_workers,
+          ring_depth=decision.ring_depth,
+          reuse_buffers=self._reuse_batch_buffers,
+          reautotune=self._engine_reautotune)
     training = modes.is_training(mode)
     shuffling = training and self._shuffle_buffer_size > 1
     if start_delivered is None:
@@ -557,6 +601,11 @@ class NativeRecordInputGenerator(AbstractInputGenerator):
       raise ValueError(
           'Input generator has no specs; call set_specification(_from_model) '
           'first.')
+    if self._follow is not None:
+      raise ValueError(
+          'follow-mode streams are not positional (a live window has no '
+          'replayable position); use create_iterator — a restarted '
+          'trainer re-enters the window.')
     if (modes.is_training(mode) and self._shuffle_buffer_size > 1 and
         self._seed is None):
       raise ValueError(

@@ -42,10 +42,16 @@ class AsyncExportCallback(TrainerCallback):
                export_dir: Optional[str] = None,
                export_name: str = 'latest_exporter_numpy',
                keep: int = 5,
-               asynchronous: bool = True):
+               asynchronous: bool = True,
+               serialize_serving: bool = True):
     self._export_dir = export_dir
     self._export_name = export_name
-    self._exporter = ModelExporter(keep=keep)
+    # serialize_serving=False skips the StableHLO artifact: versions are
+    # cheap orbax state dumps and predictors use the model-class
+    # fallback — the right trade for high-cadence collect-loop exports
+    # where the actor fleet shares the training code anyway.
+    self._exporter = ModelExporter(keep=keep,
+                                   serialize_serving=serialize_serving)
     self._asynchronous = asynchronous
     self._pending: Optional[threading.Thread] = None
 

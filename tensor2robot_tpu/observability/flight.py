@@ -32,7 +32,8 @@ Design constraints, in the observability tradition:
 Event shape: ``(time.time(), kind, name, detail)`` where ``kind`` is a
 coarse subsystem tag (``'span' | 'dispatch' | 'checkpoint' | 'swap' |
 'nonfinite' | 'budget' | 'shutdown' | 'liveness' | 'request' |
-'router' | 'balancer' | 'slo' | 'anomaly' | 'error'``), ``name`` a
+'router' | 'balancer' | 'slo' | 'anomaly' | 'collect' | 'error'``),
+``name`` a
 slash-scoped identifier like metric names, and ``detail`` a short
 ``k=v``-style string (machine-greppable: the postmortem renderer parses
 ``dur_ms=`` / ``id=`` tokens out of it). ``'router'`` carries the
@@ -44,6 +45,10 @@ carries burn-rate alert/clear transitions (``observability/slo.py``),
 anomaly.py``) — both also escalate to rate-limited LIVE postmortem
 bundles. Traced requests' ``'request'`` events carry a ``trace=`` token
 joining the ring to the cross-process ``/tracez`` span index.
+``'collect'`` carries the actor–learner loop's lifecycle: actor
+spawn/crash/restart/DEAD verdicts (``collect/actor.py`` supervision),
+shard commits and suppressed markers, and follow-mode shard
+ingest/skip decisions (``data/follow.py``).
 """
 
 from __future__ import annotations

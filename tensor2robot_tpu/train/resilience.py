@@ -38,6 +38,7 @@ from __future__ import annotations
 import logging
 import signal
 import threading
+import time
 from typing import Optional, Tuple
 
 from tensor2robot_tpu.observability import flight
@@ -87,14 +88,25 @@ class GracefulShutdown:
     # Which signal tripped the flag (None for programmatic requests);
     # read by the trainer's boundary poll for the flight-ring record.
     self._signal_observed: Optional[int] = None
+    # Wall-clock receipt of the request/signal: the start mark of the
+    # whole-loop restart number (trainer/sigterm_to_resumed_step_seconds
+    # — SIGTERM receipt → first post-restore completed dispatch, so the
+    # measurement charges signal→checkpoint drain to the restart too).
+    self._signal_time: Optional[float] = None
 
   @property
   def requested(self) -> bool:
     return self._event.is_set()
 
+  @property
+  def signal_time(self) -> Optional[float]:
+    """Epoch seconds the shutdown was requested (None before)."""
+    return self._signal_time
+
   def request(self) -> None:
     """Programmatic preemption (tests, cluster agents without signals)."""
     if not self._event.is_set():
+      self._signal_time = time.time()
       flight.event('shutdown', 'resilience/shutdown_requested',
                    'source=programmatic')
     self._event.set()
@@ -107,6 +119,8 @@ class GracefulShutdown:
     # No flight.event here: a signal handler must not take the ring lock
     # (the interrupted main thread may hold it). The signal is recorded
     # when the trainer OBSERVES the flag at the next dispatch boundary.
+    # time.time() is async-signal-safe (one syscall, no locks).
+    self._signal_time = time.time()
     self._signal_observed = signum
     self._event.set()
     self.uninstall()

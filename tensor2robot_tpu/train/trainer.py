@@ -97,6 +97,77 @@ def _record_restart_to_first_step() -> None:
       '(compilation cache: %s).', elapsed, cache_lib.enabled_dir() or 'off')
 
 
+# Whole-loop restart accounting (ROADMAP direction 5): the preemption
+# branch persists the SIGTERM receipt time beside the checkpoints, and
+# the restarted process's first completed dispatch turns it into the
+# `trainer/sigterm_to_resumed_step_seconds` gauge — signal receipt →
+# in-flight dispatch drain → forced checkpoint → scheduler restart →
+# python/jax startup → restore → first post-restore dispatch, the number
+# an operator's preemption budget actually pays. Measured across a REAL
+# subprocess restart by tests/test_collect_loop.py; `loop_restart.json`
+# persists the measurement for bench.py's `loop_restart_seconds` line.
+PREEMPT_STATE_FILENAME = 'preempt_state.json'
+LOOP_RESTART_FILENAME = 'loop_restart.json'
+
+
+def _write_preempt_state(model_dir: str, shutdown, step: int) -> None:
+  """Persists the SIGTERM receipt mark (atomic, never raises)."""
+  if not model_dir:
+    return
+  import json
+
+  sigterm_time = getattr(shutdown, 'signal_time', None) if shutdown else None
+  path = os.path.join(model_dir, PREEMPT_STATE_FILENAME)
+  try:
+    tmp = f'{path}.tmp{os.getpid()}'
+    with open(tmp, 'w') as f:
+      json.dump({'sigterm_time': float(sigterm_time or time.time()),
+                 'step': int(step), 'pid': os.getpid()}, f)
+    os.replace(tmp, path)
+  except OSError as e:
+    logging.warning('Cannot persist preempt state under %r: %r',
+                    model_dir, e)
+
+
+def _record_sigterm_to_resumed(model_dir: str, step: int) -> None:
+  """First-post-restore-dispatch mark: closes the restart measurement.
+
+  A no-op unless a preemption left its receipt mark; the mark is
+  CONSUMED (one measurement per preemption) and the result persisted to
+  ``loop_restart.json`` for bench/test readers.
+  """
+  if not model_dir:
+    return
+  import json
+
+  path = os.path.join(model_dir, PREEMPT_STATE_FILENAME)
+  try:
+    with open(path) as f:
+      state = json.load(f)
+    sigterm_time = float(state['sigterm_time'])
+  except (OSError, ValueError, KeyError, TypeError):
+    return
+  elapsed = time.time() - sigterm_time
+  metrics_lib.gauge('trainer/sigterm_to_resumed_step_seconds').set(elapsed)
+  flight.event('shutdown', 'trainer/sigterm_to_resumed',
+               f'seconds={elapsed:.3f} step={step}')
+  logging.info(
+      'Whole-loop restart: %.2fs from SIGTERM receipt (pre-restart step '
+      '%s) to the first post-restore completed dispatch (step %d).',
+      elapsed, state.get('step'), step)
+  try:
+    os.remove(path)
+    out = os.path.join(model_dir, LOOP_RESTART_FILENAME)
+    tmp = f'{out}.tmp{os.getpid()}'
+    with open(tmp, 'w') as f:
+      json.dump({'sigterm_to_resumed_step_seconds': elapsed,
+                 'resumed_step': int(step),
+                 'preempted_step': state.get('step')}, f)
+    os.replace(tmp, out)
+  except OSError as e:
+    logging.warning('Cannot persist loop-restart measurement: %r', e)
+
+
 def _place_releasing(place: Callable[[Batch], 'PlacedBatch'],
                      release: Callable[[], None],
                      batch: Batch) -> 'PlacedBatch':
@@ -1438,6 +1509,11 @@ class Trainer:
           self.save_checkpoint(force=True, sync=True)
           if self._manager is not None:
             self._manager.wait_until_finished()
+          if getattr(self, 'is_primary_process', True):
+            # Start mark of the whole-loop restart number: the restarted
+            # process's first post-restore dispatch consumes it into
+            # trainer/sigterm_to_resumed_step_seconds.
+            _write_preempt_state(config.model_dir, shutdown, step)
           for cb in self._callbacks:
             cb.end(self)
           raise resilience.PreemptedError(self.step)
@@ -1467,6 +1543,7 @@ class Trainer:
           # excluded from the breakdown as compile anyway).
           jax.block_until_ready(scalars)
           _record_restart_to_first_step()
+          _record_sigterm_to_resumed(config.model_dir, step)
         before = step
         self._dispatch_start_step = before
         batch_leaves = jax.tree_util.tree_leaves(features)

@@ -36,6 +36,24 @@ drills over the real 2-process ``jax.distributed`` harness):
   the survivors as a bounded liveness exit, never a committed marker
   over a half-written payload.
 
+Actor-loop injectors (the ``tests/test_collect_loop.py`` drills over
+the collect→train→export→collect cycle; each arms a hook inside
+``collect/actor.py`` and is applied IN the actor process via
+:func:`apply_actor_fault`, so ``ActorConfig.faults`` specs cross the
+spawn boundary as strings):
+
+* :class:`KillActorMidEpisode` — SIGKILL between the shard's final
+  write and its commit rename: the shard bytes exist only under the
+  invisible ``.tmp`` name, the exact torn-write anatomy follow-mode
+  readers must never surface.
+* :class:`TornShardInjector` — commits a shard's bytes but suppresses
+  its commit marker: a permanently marker-less shard that must stay
+  invisible to the trainer stream.
+* :class:`StaleExportInjector` — pins the actor's reload poller to an
+  old export generation while newer ones commit, so off-policy
+  staleness (``data/follow/staleness_steps``) has something real to
+  measure and reloads provably catch up once released.
+
 All schedules are explicit step/index sets or seeded draws — a failing
 test replays bit-identically.
 """
@@ -287,6 +305,145 @@ def clear_kill_during_save() -> None:
   from tensor2robot_tpu.train import checkpoints as ckpt_lib
 
   ckpt_lib._during_save_hook = None  # pylint: disable=protected-access
+
+
+# -------------------------------------------------------- actor-loop faults
+
+
+class KillActorMidEpisode:
+  """SIGKILLs the actor between shard write and commit rename.
+
+  Installed on ``collect.actor._before_commit_hook``: the hook fires
+  after the shard's bytes are flushed+fsynced under the ``.tmp`` name
+  and strictly before the rename that makes them visible — a process
+  death here strands an invisible temp file, never a half-visible
+  shard. ``at_shard`` is the 0-based shard ordinal to die on.
+
+  Two flavors, because the spec re-arms in every respawned incarnation:
+
+  * ``once_sentinel=None`` — kill EVERY incarnation at/after the
+    ordinal: the crash-loop shape whose verdict must be DEAD once the
+    supervisor's budget is spent.
+  * ``once_sentinel=<path>`` — kill exactly ONCE across incarnations
+    (the sentinel file records that the kill already happened): the
+    acceptance drill's one-SIGKILL-survived-and-restarted shape.
+  """
+
+  def __init__(self, at_shard: int, signum: int = 9,
+               once_sentinel: Optional[str] = None):
+    self._at_shard = int(at_shard)
+    self._signum = int(signum)
+    self._once_sentinel = once_sentinel
+
+  def install(self) -> None:
+    from tensor2robot_tpu.collect import actor as actor_lib
+
+    at_shard, signum = self._at_shard, self._signum
+    sentinel = self._once_sentinel
+
+    def hook(shard_ordinal: int) -> None:
+      if shard_ordinal < at_shard:
+        return
+      if sentinel is not None:
+        try:
+          # O_EXCL claim: exactly one incarnation ever dies, even if
+          # the respawn races a slow filesystem.
+          fd = os.open(sentinel, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+          os.close(fd)
+        except FileExistsError:
+          return
+      os.kill(os.getpid(), signum)
+
+    actor_lib._before_commit_hook = hook  # pylint: disable=protected-access
+
+
+class TornShardInjector:
+  """Publishes shard ``at_shard``'s bytes but drops its commit marker.
+
+  Installed on ``collect.actor._suppress_marker_hook``: the shard file
+  lands under its final name (readable, CRC-clean) yet stays
+  permanently marker-less — the signature of an actor that died between
+  rename and marker publish. Follow-mode readers must treat it as torn
+  forever (``data/follow/torn_pending``), and the trainer stream must
+  contain none of its records.
+  """
+
+  def __init__(self, at_shard: int):
+    self._at_shard = int(at_shard)
+
+  def install(self) -> None:
+    from tensor2robot_tpu.collect import actor as actor_lib
+
+    at_shard = self._at_shard
+
+    def hook(shard_ordinal: int) -> bool:
+      return shard_ordinal == at_shard
+
+    actor_lib._suppress_marker_hook = hook  # pylint: disable=protected-access
+
+
+class StaleExportInjector:
+  """Serves an old export generation while newer ones commit.
+
+  Installed on ``collect.actor._hold_export_hook``: reload polls are
+  suppressed (``collect/export_reloads_held``) until the actor has
+  collected ``hold_episodes`` episodes, pinning its policy to the
+  generation loaded at startup while the trainer keeps exporting. The
+  staleness the loop must SURVIVE and MEASURE: stamped policy versions
+  lag the newest export, ``data/follow/staleness_steps`` rises, and
+  once released the next poll catches the actor up.
+  """
+
+  def __init__(self, hold_episodes: int):
+    self._hold_episodes = int(hold_episodes)
+
+  def install(self) -> None:
+    from tensor2robot_tpu.collect import actor as actor_lib
+
+    hold = self._hold_episodes
+
+    def hook(episode_index: int) -> bool:
+      return episode_index < hold
+
+    actor_lib._hold_export_hook = hook  # pylint: disable=protected-access
+
+
+def apply_actor_fault(spec: str, config=None) -> None:
+  """Arms one actor-fault hook from its ``name:arg`` string form.
+
+  The string form is how ``ActorConfig.faults`` crosses the process
+  spawn (configs are JSON): ``kill_before_commit:<shard>`` (every
+  incarnation — the crash-loop/DEAD drill),
+  ``kill_once_before_commit:<shard>`` (exactly once across
+  incarnations, via a sentinel in the actor's out_dir),
+  ``torn_shard:<shard>``, ``hold_export:<episodes>``. ``config`` is the
+  applying actor's ``ActorConfig`` (sentinel placement).
+  """
+  name, _, arg = spec.partition(':')
+  if name == 'kill_before_commit':
+    KillActorMidEpisode(int(arg)).install()
+  elif name == 'kill_once_before_commit':
+    if config is None:
+      raise ValueError('kill_once_before_commit needs the ActorConfig '
+                       '(sentinel placement)')
+    sentinel = os.path.join(
+        config.out_dir, f'.fault-killed-a{config.actor_id}')
+    KillActorMidEpisode(int(arg), once_sentinel=sentinel).install()
+  elif name == 'torn_shard':
+    TornShardInjector(int(arg)).install()
+  elif name == 'hold_export':
+    StaleExportInjector(int(arg)).install()
+  else:
+    raise ValueError(f'unknown actor fault spec {spec!r}')
+
+
+def clear_actor_faults() -> None:
+  """Disarms every actor-fault hook (test teardown)."""
+  from tensor2robot_tpu.collect import actor as actor_lib
+
+  actor_lib._before_commit_hook = None  # pylint: disable=protected-access
+  actor_lib._suppress_marker_hook = None  # pylint: disable=protected-access
+  actor_lib._hold_export_hook = None  # pylint: disable=protected-access
 
 
 def corrupt_checkpoint_host_ack(ckpt_dir: str, step: int, host: int) -> None:

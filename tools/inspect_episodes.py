@@ -1,0 +1,164 @@
+"""Inspect episode shards: commit verdicts, provenance stamps, rewards.
+
+The episode-side twin of ``tools/inspect_checkpoint.py`` for the
+collect→train loop's shard directories (``collect/actor.py`` writers,
+``data/follow.py`` readers). For every shard it reports:
+
+* the COMMIT VERDICT — ``committed`` (marker present) vs ``torn``
+  (marker-less: a killed actor or an injected tear; follow-mode
+  trainers never read these), and whether the records walk back
+  CRC-clean;
+* the per-episode provenance STAMPS riding the records
+  (``collect/episodes.py``): collecting actor, policy version (the
+  export generation's global step), episode request id and trace/span
+  ids — the ``tools/assemble_trace.py --request`` join keys that
+  resolve a training record back to the actor rollout and export
+  generation that produced it;
+* rewards and record counts per episode (stamp-grouped), plus the
+  commit-marker manifest when present.
+
+Pure stdlib + the in-repo pure-python record walker: runs on hosts with
+no TensorFlow and no native library.
+
+Usage:
+  python tools/inspect_episodes.py <shard.tfrecord | episodes-dir>...
+  python tools/inspect_episodes.py --records <shard>   # per-record rows
+  python tools/inspect_episodes.py --json <dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_lib
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+  sys.path.insert(0, _REPO_ROOT)
+
+from tensor2robot_tpu.collect import episodes as episodes_lib  # noqa: E402
+from tensor2robot_tpu.data import shard_index  # noqa: E402
+
+COMMIT_SUFFIX = '.commit'
+
+
+def _resolve_shards(paths):
+  shards = []
+  for path in paths:
+    if os.path.isdir(path):
+      shards.extend(sorted(glob_lib.glob(os.path.join(path, '*.tfrecord'))))
+    else:
+      shards.append(path)
+  return shards
+
+
+def _scalar(scanned, key):
+  kind_values = scanned.get(key)
+  if not kind_values or not kind_values[1]:
+    return None
+  value = kind_values[1][0]
+  return value.decode('utf-8', 'replace') if isinstance(value, bytes) \
+      else value
+
+
+def inspect_shard(shard_path: str) -> dict:
+  """One shard's verdict + stamp-grouped episode summary (JSON-ready)."""
+  marker_path = shard_path + COMMIT_SUFFIX
+  committed = os.path.exists(marker_path)
+  marker = None
+  if committed:
+    try:
+      with open(marker_path) as f:
+        marker = json.load(f)
+    except (OSError, ValueError):
+      marker = {'error': 'unreadable commit marker'}
+  episodes, records, read_error = {}, 0, None
+  try:
+    for record in shard_index.iter_records_from(shard_path, 0):
+      records += 1
+      stamp = episodes_lib.read_stamp(record)
+      scanned = episodes_lib.scan_example(record)
+      reward = _scalar(scanned, 'reward')
+      key = stamp['request_id'] if stamp else '<unstamped>'
+      entry = episodes.setdefault(key, {
+          'request_id': key,
+          'actor_id': stamp['actor_id'] if stamp else None,
+          'policy_version': stamp['policy_version'] if stamp else None,
+          'trace_id': stamp['trace_id'] if stamp else None,
+          'span_id': stamp['span_id'] if stamp else None,
+          'records': 0,
+          'reward': 0.0,
+      })
+      entry['records'] += 1
+      if reward is not None:
+        entry['reward'] += float(reward)
+  except (IOError, OSError, ValueError) as e:
+    read_error = f'{type(e).__name__}: {e}'
+  return {
+      'shard': shard_path,
+      'verdict': ('committed' if committed else 'torn')
+                 if read_error is None else
+                 ('committed-unreadable' if committed else 'torn-unreadable'),
+      'records': records,
+      'read_error': read_error,
+      'has_index': os.path.exists(shard_path + '.idx'),
+      'episodes': list(episodes.values()),
+      'marker': marker,
+  }
+
+
+def _render(info: dict, show_records: bool) -> None:
+  verdict = info['verdict'].upper()
+  print(f"{info['shard']}")
+  print(f"  verdict: {verdict}   records: {info['records']}   "
+        f"index: {'yes' if info['has_index'] else 'no'}")
+  if info['read_error']:
+    print(f"  READ ERROR: {info['read_error']}")
+  for episode in info['episodes']:
+    line = (f"  episode {episode['request_id']}  "
+            f"actor={episode['actor_id']}  "
+            f"policy_version={episode['policy_version']}  "
+            f"records={episode['records']}  "
+            f"reward={episode['reward']:.4f}")
+    print(line)
+    if show_records:
+      print(f"    trace={episode['trace_id']}  span={episode['span_id']}")
+  marker = info.get('marker')
+  if marker and 'episodes' in marker:
+    manifest = marker['episodes']
+    print(f"  marker: actor={marker.get('actor_id')} "
+          f"pid={marker.get('pid')} shard={marker.get('shard')} "
+          f"episodes={len(manifest)}")
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('paths', nargs='+',
+                      help='Shard files and/or episode directories.')
+  parser.add_argument('--json', action='store_true',
+                      help='Machine-readable output.')
+  parser.add_argument('--records', action='store_true',
+                      help='Per-episode trace/span id rows.')
+  args = parser.parse_args(argv)
+  shards = _resolve_shards(args.paths)
+  if not shards:
+    print('no episode shards found', file=sys.stderr)
+    return 1
+  infos = [inspect_shard(s) for s in shards]
+  if args.json:
+    json.dump({'shards': infos}, sys.stdout, indent=2)
+    print()
+  else:
+    committed = sum(1 for i in infos if i['verdict'] == 'committed')
+    torn = sum(1 for i in infos if i['verdict'].startswith('torn'))
+    for info in infos:
+      _render(info, args.records)
+    print(f'{len(infos)} shard(s): {committed} committed, {torn} torn, '
+          f'{sum(i["records"] for i in infos)} record(s).')
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
