@@ -220,6 +220,71 @@ def bench_accum_batch_curve():
   }))
 
 
+def bench_kernel_fp8_ab():
+  """Pallas pool/conv kernels + fp8 training A/B — JSON lines.
+
+  The PR-15 claims, driver-verified on chip: ``qtopt_kernel_step_ms``
+  runs the batch-32 qtopt step per kernel_policy arm (none / pool /
+  pool_conv — worth ~16% device step if the pool1+conv1 roofline rows
+  reach their HBM bounds) and ``qtopt_fp8_step_ms`` the
+  matmul_precision='fp8' arm (the 2×-bf16 MXU path; on CPU the qdq is
+  pure overhead, so these lines are TPU-only). Each arm runs in its OWN
+  subprocess (tools/measure_baselines.py — coexisting executables make
+  the tunneled backend re-stream per dispatch), so the device_ms deltas
+  are same-methodology comparable with the r5 roofline numbers.
+  """
+  import os
+  import subprocess
+  import sys
+
+  tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'measure_baselines.py')
+
+  def point(extra):
+    args = [sys.executable, tool, '--qtopt-batch', '32'] + extra
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          timeout=1800)
+    for out_line in proc.stdout.splitlines():
+      if out_line.startswith('{'):
+        return json.loads(out_line)
+    raise RuntimeError(f'{extra}: no JSON line; '
+                       f'stderr: {proc.stderr[-300:]}')
+
+  base_ms = None
+  for policy in ('none', 'pool', 'pool_conv'):
+    try:
+      p = point(['--kernel-policy', policy])
+      dev = p.get('device_ms')
+      if policy == 'none':
+        base_ms = dev
+      print(json.dumps({
+          'metric': 'qtopt_kernel_step_ms',
+          'kernel_policy': policy,
+          'device_ms_per_step': dev,
+          'steps_per_sec': p.get('steps_per_sec'),
+          'vs_none': (round(base_ms / dev, 3)
+                      if base_ms and dev else None),
+      }))
+    except Exception as e:  # pylint: disable=broad-except
+      print(json.dumps({'metric': 'qtopt_kernel_step_ms',
+                        'kernel_policy': policy,
+                        'error': repr(e)[:200]}))
+  try:
+    p = point(['--matmul-precision', 'fp8'])
+    dev = p.get('device_ms')
+    print(json.dumps({
+        'metric': 'qtopt_fp8_step_ms',
+        'matmul_precision': 'fp8',
+        'device_ms_per_step': dev,
+        'steps_per_sec': p.get('steps_per_sec'),
+        'vs_bf16': (round(base_ms / dev, 3) if base_ms and dev else None),
+        'note': 'parity band vs bf16 gated in tier-1 (-m kernels)',
+    }))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'qtopt_fp8_step_ms',
+                      'error': repr(e)[:200]}))
+
+
 def bench_h2d_transport(host_batch):
   """Transport context for the record-fed metrics.
 
@@ -1320,6 +1385,11 @@ def main():
       bench_accum_batch_curve()
     except Exception as e:
       print(json.dumps({'metric': 'qtopt_accum_curve_point',
+                        'error': repr(e)[:200]}))
+    try:
+      bench_kernel_fp8_ab()
+    except Exception as e:
+      print(json.dumps({'metric': 'qtopt_kernel_step_ms',
                         'error': repr(e)[:200]}))
     try:
       bench_h2d_transport(batches[0][0])

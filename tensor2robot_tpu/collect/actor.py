@@ -100,23 +100,55 @@ class EpisodeShardWriter:
   incarnation never collides with its predecessor's files. ``close()``
   commits a partial final shard if it holds at least one full episode —
   episodes are the atomicity unit; a shard never carries half of one.
+
+  **Retention GC** (``max_shards`` / ``max_bytes``): nothing else in the
+  collect loop ever deletes episode shards, which makes any long soak an
+  unbounded-disk run (ROADMAP direction 1a named this the blocker).
+  After every commit the writer prunes ITS OWN oldest committed shards
+  past the configured budget, under one hard safety rule: a shard is
+  only deletable when it is (a) commit-marked — torn/tmp files are the
+  crash-recovery evidence and stay for the forensics tooling — and
+  (b) strictly OLDER than the follow-mode sampling window: the newest
+  shards jointly covering ``retain_window_records`` records (the
+  trainer's ``FollowConfig.window_records``) are always retained, so a
+  follow-mode reader restarting or refilling its window can never find
+  its sampling range deleted out from under it. The commit marker is
+  removed FIRST (the shard becomes invisible to any new reader exactly
+  like a torn shard), then the ``.idx`` sidecar and the shard bytes.
+  Deletions count ``collect/shards_gced`` (+ a flight event with the
+  reclaimed bytes). Budgets are per writer — a fleet's disk budget is
+  ``max_bytes × actors``.
   """
 
   def __init__(self, out_dir: str, actor_id: int,
-               episodes_per_shard: int = 8):
+               episodes_per_shard: int = 8,
+               max_shards: Optional[int] = None,
+               max_bytes: Optional[int] = None,
+               retain_window_records: int = 4096):
     if episodes_per_shard < 1:
       raise ValueError(f'episodes_per_shard must be >= 1, got '
                        f'{episodes_per_shard}')
+    if max_shards is not None and max_shards < 1:
+      raise ValueError(f'max_shards must be >= 1, got {max_shards}')
+    if max_bytes is not None and max_bytes < 1:
+      raise ValueError(f'max_bytes must be >= 1, got {max_bytes}')
     os.makedirs(out_dir, exist_ok=True)
     self._out_dir = out_dir
     self._actor_id = int(actor_id)
     self._episodes_per_shard = int(episodes_per_shard)
+    self._max_shards = max_shards
+    self._max_bytes = max_bytes
+    self._retain_window_records = max(0, int(retain_window_records))
     self._shard_ordinal = 0
     self._writer = None
     self._tmp_path: Optional[str] = None
     self._episode_manifest: List[dict] = []
     self._record_count = 0
     self.committed_paths: List[str] = []
+    # Parallel to committed_paths: (records, bytes) per committed shard,
+    # oldest first — the GC's retention arithmetic.
+    self._committed_stats: List[tuple] = []
+    self.gced_paths: List[str] = []
 
   def _shard_name(self) -> str:
     return (f'{SHARD_PREFIX}a{self._actor_id}-p{os.getpid()}-'
@@ -193,12 +225,64 @@ class EpisodeShardWriter:
     os.replace(tmp_marker, marker_path)
     _fsync_dir(self._out_dir)
     self.committed_paths.append(final_path)
+    try:
+      shard_bytes = os.path.getsize(final_path)
+    except OSError:
+      shard_bytes = 0
+    self._committed_stats.append((self._record_count, shard_bytes))
     metrics_lib.counter('collect/shards_committed').inc()
     flight.event(
         'collect', 'collect/shard_committed',
         f'actor={self._actor_id} shard={ordinal} '
         f'records={self._record_count} '
         f'episodes={len(self._episode_manifest)}')
+    self._maybe_gc()
+
+  def _maybe_gc(self) -> None:
+    """Prunes this writer's oldest committed shards past the budget;
+    never touches the follow-window retention suffix (see class doc)."""
+    if self._max_shards is None and self._max_bytes is None:
+      return
+    # Newest shards covering the sampling window are untouchable: walk
+    # newest → oldest until the window's record count is covered (the
+    # shard that crosses the threshold is retained too).
+    protected = 0
+    covered = 0
+    for records, _ in reversed(self._committed_stats):
+      protected += 1
+      covered += records
+      if covered >= self._retain_window_records:
+        break
+    deletable = max(0, len(self.committed_paths) - protected)
+    total_bytes = sum(b for _, b in self._committed_stats)
+    victims = 0
+    while victims < deletable:
+      over_shards = (self._max_shards is not None and
+                     len(self.committed_paths) - victims > self._max_shards)
+      over_bytes = (self._max_bytes is not None and
+                    total_bytes > self._max_bytes)
+      if not over_shards and not over_bytes:
+        break
+      total_bytes -= self._committed_stats[victims][1]
+      victims += 1
+    for _ in range(victims):
+      path = self.committed_paths.pop(0)
+      records, shard_bytes = self._committed_stats.pop(0)
+      # Marker first: the shard drops out of every follower's committed
+      # set atomically (indistinguishable from torn) before its bytes go.
+      for victim in (commit_marker_path(path), path + '.idx', path):
+        try:
+          os.remove(victim)
+        except OSError:
+          pass
+      self.gced_paths.append(path)
+      metrics_lib.counter('collect/shards_gced').inc()
+      flight.event(
+          'collect', 'collect/shard_gced',
+          f'actor={self._actor_id} path={os.path.basename(path)} '
+          f'records={records} bytes={shard_bytes}')
+    if victims:
+      _fsync_dir(self._out_dir)
 
   def close(self) -> None:
     """Commits a non-empty partial shard; abandons an empty tmp file."""
@@ -225,6 +309,16 @@ class ActorConfig:
   export_root: str
   out_dir: str
   episodes_per_shard: int = 8
+  # Shard retention GC (see EpisodeShardWriter): budgets for THIS
+  # actor's committed shards; None = keep everything (the historical
+  # behavior — fine for drills, unbounded disk for soaks). Only
+  # commit-marked shards strictly older than the newest
+  # retain_window_records records are ever deleted, so the trainer's
+  # follow-mode sampling window (FollowConfig.window_records — keep
+  # these two in agreement) always survives.
+  max_shards: Optional[int] = None
+  max_bytes: Optional[int] = None
+  retain_window_records: int = 4096
   max_episodes: Optional[int] = None  # None = run until SIGTERM
   reload_interval_secs: float = 1.0
   restore_timeout_secs: float = 60.0
@@ -304,7 +398,11 @@ def run_actor(config: ActorConfig) -> int:
   policy = RegressionPolicy(t2r_model=model, predictor=predictor)
 
   writer = EpisodeShardWriter(config.out_dir, config.actor_id,
-                              config.episodes_per_shard)
+                              config.episodes_per_shard,
+                              max_shards=config.max_shards,
+                              max_bytes=config.max_bytes,
+                              retain_window_records=(
+                                  config.retain_window_records))
   episodes_counter = metrics_lib.counter('collect/episodes')
   reward_hist = metrics_lib.histogram('collect/episode_reward')
   version_gauge = metrics_lib.gauge('collect/policy_version')

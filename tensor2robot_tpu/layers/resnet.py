@@ -20,6 +20,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.remat import remat_module
+from tensor2robot_tpu.ops import _pallas_dispatch as pallas_dispatch
+from tensor2robot_tpu.ops import pool as pool_ops
 
 BLOCK_SIZES = {
     18: [2, 2, 2, 2],
@@ -170,6 +172,12 @@ class ResNet(nn.Module):
   # pass instead of keeping all of them live — same params, same values,
   # less HBM. 'none' is the historical behavior.
   remat_policy: str = 'none'
+  # Pallas kernel routing (ops/_pallas_dispatch.py): 'pool'/'pool_conv'
+  # send the initial 3×3/s2 max pool — the grasp2vec roofline's 2.7–3.0×
+  # select-and-scatter backward rows — through the argmax-emitting fused
+  # kernel (ops/pool.py). Size-gated, stock-XLA fallback off-TPU,
+  # bitwise-identical values and gradients either way.
+  kernel_policy: str = 'none'
 
   @nn.compact
   def __call__(self,
@@ -199,9 +207,13 @@ class ResNet(nn.Module):
         # never tie with it), but the padded copy of the largest
         # activation in the network never exists — on a v5e the pad
         # fusion alone was 1.38 ms/step of grasp2vec (460 MB at
-        # [48, 236, 236, 64]).
-        net = nn.max_pool(net, (3, 3), strides=(2, 2),
-                          padding=((1, 1), (1, 1)))
+        # [48, 236, 236, 64]). kernel_policy routes the same pool
+        # through the Pallas argmax kernel (overlapping 3×3/s2 windows;
+        # the backward accumulates in XLA's window order — bitwise).
+        pool_fn = (pool_ops.max_pool if pallas_dispatch.policy_enables_pool(
+            self.kernel_policy) else nn.max_pool)
+        net = pool_fn(net, (3, 3), strides=(2, 2),
+                      padding=((1, 1), (1, 1)))
       endpoints['initial_max_pool'] = net
 
     for i, num_blocks in enumerate(block_sizes):
@@ -282,6 +294,7 @@ class FilmResNet(nn.Module):
   enabled_block_layers: Optional[Sequence[bool]] = None
   dtype: Optional[Any] = None
   remat_policy: str = 'none'
+  kernel_policy: str = 'none'
 
   @nn.compact
   def __call__(self, images, embedding=None, train: bool = False):
@@ -291,6 +304,7 @@ class FilmResNet(nn.Module):
         version=self.version,
         dtype=self.dtype,
         remat_policy=self.remat_policy,
+        kernel_policy=self.kernel_policy,
         name='resnet')
     film_gamma_betas = None
     if embedding is not None:

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.remat import remat_method
 from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+from tensor2robot_tpu.ops import _pallas_dispatch as pallas_dispatch
+from tensor2robot_tpu.ops import pool as pool_ops
 
 _NUM_CHANNELS_PER_BLOCK = 32
 
@@ -146,6 +148,10 @@ class ImagesToFeaturesModelHighRes(nn.Module):
   filter_size: int = 3
   num_blocks: int = 5
   num_output_maps: int = 32
+  # Pallas kernel routing (ops/_pallas_dispatch.py): the per-block 2×2
+  # max pools go through the argmax-emitting fused kernel; size-gated,
+  # stock fallback off-TPU, bitwise-identical either way.
+  kernel_policy: str = 'none'
 
   @nn.compact
   def __call__(self, images: jnp.ndarray,
@@ -173,8 +179,11 @@ class ImagesToFeaturesModelHighRes(nn.Module):
     net = nn.relu(norm(net, False, 'norm2'))
     out = nn.Conv(32, (1, 1), name='conv2_1x1', **conv_kwargs)(net)
     block_outs.append(nn.relu(norm(out, False, 'norm2_1x1')))
+    max_pool = (pool_ops.max_pool
+                if pallas_dispatch.policy_enables_pool(self.kernel_policy)
+                else nn.max_pool)
     for i in range(1, self.num_blocks):
-      net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
+      net = max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
       net = nn.Conv(32, (self.filter_size, self.filter_size),
                     name=f'conv{i + 2}', **conv_kwargs)(net)
       net = nn.relu(norm(net, False, f'norm{i + 2}'))

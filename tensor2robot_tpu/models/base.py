@@ -121,6 +121,23 @@ class AbstractT2RModel(ModelInterface):
     (``layers.resnet.ResNet``, ``layers.vision_layers.
     ImagesToFeaturesModel``, the qtopt/grasp2vec networks) thread this
     through; models without towers accept and ignore it.
+  * ``kernel_policy``: hand-written Pallas kernel routing for the conv
+    towers (``'none' | 'pool' | 'pool_conv'``, see
+    :mod:`tensor2robot_tpu.ops._pallas_dispatch`) — same shape as
+    ``remat_policy``. ``'pool'`` routes max-pools through the
+    argmax-emitting fused kernel (``ops/pool.py``); ``'pool_conv'``
+    additionally routes the shallow first conv through the
+    space-to-depth Pallas matmul (``ops/conv_s2d.py``). Call sites are
+    size-gated and fall back to the stock XLA ops off-TPU or for
+    unsupported shapes; parameter trees are identical either way, so
+    checkpoints interchange. Off by default.
+  * ``matmul_precision``: contraction precision for Dense/Conv
+    (``'bf16' | 'fp8'``, see :mod:`tensor2robot_tpu.quantize.
+    fp8_training`). ``'fp8'`` runs the matmul contractions through
+    delayed-amax-scaled ``float8_e4m3fn`` quantize-dequantize (master
+    weights stay f32 in the optimizer state; gradients leave the ops
+    unscaled in full precision). ``TrainerConfig.matmul_precision``
+    overrides this at trainer construction.
   """
 
   def __init__(self,
@@ -130,8 +147,12 @@ class AbstractT2RModel(ModelInterface):
                use_avg_model_params: bool = False,
                avg_model_params_decay: float = 0.9999,
                init_from_checkpoint_fn: Optional[Callable] = None,
-               remat_policy: str = 'none'):
+               remat_policy: str = 'none',
+               kernel_policy: str = 'none',
+               matmul_precision: str = 'bf16'):
     from tensor2robot_tpu.layers import remat as remat_lib
+    from tensor2robot_tpu.ops import _pallas_dispatch as dispatch_lib
+    from tensor2robot_tpu.quantize import fp8_training as fp8_lib
 
     self._preprocessor_cls = preprocessor_cls
     self._create_optimizer_fn = create_optimizer_fn
@@ -142,6 +163,9 @@ class AbstractT2RModel(ModelInterface):
     self.avg_model_params_decay = avg_model_params_decay
     self.init_from_checkpoint_fn = init_from_checkpoint_fn
     self._remat_policy = remat_lib.validate_remat_policy(remat_policy)
+    self._kernel_policy = dispatch_lib.validate_kernel_policy(kernel_policy)
+    self._matmul_precision = fp8_lib.validate_matmul_precision(
+        matmul_precision)
 
   # ------------------------------------------------------------------ device
 
@@ -157,6 +181,25 @@ class AbstractT2RModel(ModelInterface):
   def remat_policy(self) -> str:
     """Activation-remat policy name ('none' | 'conv_towers' | 'full')."""
     return self._remat_policy
+
+  @property
+  def kernel_policy(self) -> str:
+    """Pallas kernel routing ('none' | 'pool' | 'pool_conv')."""
+    return self._kernel_policy
+
+  @property
+  def matmul_precision(self) -> str:
+    """Dense/Conv contraction precision ('bf16' | 'fp8')."""
+    return self._matmul_precision
+
+  def set_matmul_precision(self, precision: str) -> None:
+    """Trainer-level override (``TrainerConfig.matmul_precision``);
+    validates + gates on :func:`quantize.quantization.fp8_supported`.
+    Must run before :meth:`create_module`/``init_variables`` — modules
+    bake the precision in at construction."""
+    from tensor2robot_tpu.quantize import fp8_training as fp8_lib
+
+    self._matmul_precision = fp8_lib.require_fp8_support(precision)
 
   @property
   def compute_dtype(self):

@@ -13,7 +13,16 @@ Re-exporting the function here would shadow the module (the round-1
 ``run_meta_env`` registration bug all over again).
 """
 
-from tensor2robot_tpu.ops import flash_attention, photometric
+from tensor2robot_tpu.ops import _pallas_dispatch, flash_attention, photometric
+from tensor2robot_tpu.ops import conv_s2d, pool
+from tensor2robot_tpu.ops._pallas_dispatch import (
+    KERNEL_POLICIES,
+    force_kernels,
+    kernels_enabled,
+    policy_enables_conv,
+    policy_enables_pool,
+    validate_kernel_policy,
+)
 from tensor2robot_tpu.ops.flash_attention import (
     is_supported as flash_attention_supported,
 )

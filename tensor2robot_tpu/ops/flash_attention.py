@@ -37,15 +37,16 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tensor2robot_tpu.ops import _pallas_dispatch as dispatch
+
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/corr math
                   # finite without isfinite guards in the inner loop
 
 
 def _use_interpret() -> bool:
-  # Interpret everywhere Mosaic can't lower (cpu, gpu, ...), not just cpu:
-  # the framework is TPU-first, but the kernels must not hard-fail on
-  # other hosts.
-  return jax.default_backend() != 'tpu'
+  # Shared dispatch scaffolding (ops/_pallas_dispatch.py): interpret
+  # everywhere Mosaic can't lower, not just cpu.
+  return dispatch.use_interpret()
 
 def _block_live(q0, bq, k0):
   """Causal block-liveness: a key block starting at ``k0`` contributes to
@@ -376,7 +377,7 @@ def is_supported(t: int, d: int, block_q: Optional[int] = None,
     interpret = _use_interpret()
   block_q, block_k = _resolve_blocks(t, d, block_q, block_k, itemsize)
   bq, bk = min(block_q, t), min(block_k, t)
-  min_block = 8 if interpret else 128
+  min_block = dispatch.min_lane_block(interpret)
   return (0 < d <= 128 and d % 8 == 0 and
           t % bq == 0 and t % bk == 0 and
           bq % min_block == 0 and bk % min_block == 0)

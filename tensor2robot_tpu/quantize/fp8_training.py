@@ -1,0 +1,308 @@
+"""fp8 matmul training: delayed-amax-scaled quantize-dequantize injection.
+
+PR 7's quantization machinery (quantize/quantization.py) is weight-only
+and serving-side; this module extends it to TRAINING — the only lever
+that moves the 22% MFU ceiling itself (ROADMAP direction 3): the chip's
+low-precision MXU path runs at 2× the bf16 rate, and the matmul
+contractions of Dense/Conv are where the step's FLOPs live.
+
+Mechanism — the XLA-sanctioned Q-DQ pattern (what hardware fp8 training
+stacks emit): each contraction operand is quantized to ``float8_e4m3fn``
+and immediately dequantized back to the compute dtype *inside the jitted
+program*. Numerically the operands now hold exactly the values an fp8
+matmul would see (fp8-rounded, f32-accumulated); structurally the
+``dq(q(x)) · dq(q(w))`` chain around a dot/conv is the pattern XLA's
+fp8 rewriter folds into a native low-precision MXU matmul where the
+hardware has one — and computes faithfully (paying the rounding, not
+the speed) everywhere else, which is what makes the CPU parity drills
+meaningful. Backward: the incoming cotangent is quantized to
+``float8_e5m2`` (gradients need range, not mantissa) for the two grad
+contractions, and the resulting gradients leave the op in the full
+compute dtype — **unscaled f32/bf16 before any accumulation**, so
+grad-accum carries, the nonfinite guard, and the optimizer see ordinary
+gradients and the **master weights stay f32 in the optimizer state** by
+construction (params are never cast).
+
+Scaling is per-tensor DELAYED amax: each operand keeps a short amax
+history window in a dedicated ``'fp8_stats'`` flax collection (riding
+the same model_state plumbing as BatchNorm statistics — mutated in
+train steps, frozen in eval/serving); the quantization scale for step N
+comes from the window maximum over steps < N, so no same-step
+host/device sync ever serializes the matmul. The gradient qdq uses the
+current tensor's amax computed in the backward itself (cotangents have
+no forward-time history to consult; one reduction, stateless).
+
+Entry points: :class:`Fp8DotGeneral` drops into ``nn.Dense(
+dot_general_cls=...)``, :class:`Fp8ConvGeneralDilated` into ``nn.Conv(
+conv_general_dilated_cls=...)``, and :func:`conv_quantize_fn` hooks the
+Pallas :class:`~tensor2robot_tpu.ops.conv_s2d.SpaceToDepthConv` so the
+s2d kernel and fp8 compose. Models thread ``matmul_precision``
+(``'bf16' | 'fp8'``, validated here) the same way they thread
+``remat_policy``; ``TrainerConfig.matmul_precision`` overrides it at
+trainer construction, gated by :func:`quantization.fp8_supported`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.quantize.quantization import fp8_supported
+
+MATMUL_BF16 = 'bf16'
+MATMUL_FP8 = 'fp8'
+MATMUL_PRECISIONS = (MATMUL_BF16, MATMUL_FP8)
+
+# e4m3fn / e5m2 finite maxima (ml_dtypes.finfo); casts past them land on
+# NaN (e4m3fn has no inf), hence the explicit clamp in _qdq.
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+# Delayed-scaling history window (steps). Short on purpose: robot-
+# learning activation scales move with the data distribution; a long
+# window holds stale amaxes and over-quantizes after a scale drop.
+DEFAULT_HISTORY_LENGTH = 16
+
+FP8_STATS_COLLECTION = 'fp8_stats'
+
+
+def validate_matmul_precision(precision: Optional[str]) -> str:
+  """Normalizes/validates a matmul-precision name (None → 'bf16')."""
+  precision = MATMUL_BF16 if precision is None else str(precision)
+  if precision not in MATMUL_PRECISIONS:
+    raise ValueError(
+        f'Unknown matmul_precision {precision!r}; expected one of '
+        f'{MATMUL_PRECISIONS}.')
+  return precision
+
+
+def _fp8_max(dtype) -> float:
+  return E5M2_MAX if dtype == jnp.float8_e5m2 else E4M3_MAX
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def quantize_dequantize(x, scale, dtype):
+  """``dq(q(x))`` with a straight-through gradient.
+
+  The value path rounds ``x`` through ``dtype`` (clamped to its finite
+  range at the given per-tensor ``scale``); the cotangent passes through
+  untouched — quantization error is treated as noise, the standard fp8
+  recipe (rounding the rounding's gradient would double-count it).
+  """
+  compute_dtype = x.dtype
+  bound = _fp8_max(dtype)
+  scaled = x.astype(jnp.float32) / scale
+  scaled = jnp.clip(scaled, -bound, bound)
+  return (scaled.astype(dtype).astype(jnp.float32) * scale).astype(
+      compute_dtype)
+
+
+def _qdq_fwd(x, scale, dtype):
+  return quantize_dequantize(x, scale, dtype), jnp.shape(scale)
+
+
+def _qdq_bwd(dtype, scale_shape, g):
+  del dtype
+  return g, jnp.zeros(scale_shape, jnp.float32)
+
+
+quantize_dequantize.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def amax_scale(amax, dtype) -> jnp.ndarray:
+  """amax → quantization scale mapping the tensor onto the dtype's
+  finite range; an empty history (amax 0) keeps scale 1."""
+  amax = jnp.asarray(amax, jnp.float32)
+  return jnp.where(amax > 0.0, amax / _fp8_max(dtype), 1.0)
+
+
+def qdq_current(x, dtype) -> jnp.ndarray:
+  """Stateless qdq from the CURRENT tensor's amax (the cotangent path)."""
+  amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+  return quantize_dequantize(x, amax_scale(amax, dtype), dtype)
+
+
+class _DelayedAmax(nn.Module):
+  """One operand's delayed-scaling state: qdq by the history max from
+  PREVIOUS steps, then roll the current amax into the window (only when
+  the 'fp8_stats' collection is mutable — train steps; eval/serving and
+  abstract init leave it frozen)."""
+
+  history_length: int = DEFAULT_HISTORY_LENGTH
+  dtype: Any = jnp.float8_e4m3fn
+
+  @nn.compact
+  def __call__(self, x):
+    hist = self.variable(
+        FP8_STATS_COLLECTION, 'amax_history',
+        lambda: jnp.zeros((self.history_length,), jnp.float32))
+    scale = amax_scale(jnp.max(hist.value), self.dtype)
+    y = quantize_dequantize(x, scale, self.dtype)
+    if not self.is_initializing() and self.is_mutable_collection(
+        FP8_STATS_COLLECTION):
+      current = jnp.max(jnp.abs(x)).astype(jnp.float32)
+      hist.value = jnp.concatenate([hist.value[1:], current[None]])
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fp8_dot(lhs, rhs, dimension_numbers, precision):
+  return jax.lax.dot_general(lhs, rhs, dimension_numbers,
+                             precision=precision)
+
+
+def _fp8_dot_fwd(lhs, rhs, dimension_numbers, precision):
+  out = jax.lax.dot_general(lhs, rhs, dimension_numbers,
+                            precision=precision)
+  return out, (lhs, rhs)
+
+
+def _fp8_dot_bwd(dimension_numbers, precision, res, g):
+  """Grad contractions with the cotangent qdq'd to e5m2 — the operands
+  saved in residuals are ALREADY fp8-rounded (qdq'd before the dot), so
+  both grad matmuls run on fp8-valued tensors; outputs stay in the
+  compute dtype, unscaled, ready for f32 accumulation."""
+  lhs, rhs = res
+  gq = qdq_current(g, jnp.float8_e5m2)
+
+  def forward(a, b):
+    return jax.lax.dot_general(a, b, dimension_numbers,
+                               precision=precision)
+
+  _, vjp = jax.vjp(forward, lhs, rhs)
+  return vjp(gq)
+
+
+_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+class Fp8DotGeneral(nn.Module):
+  """``nn.Dense(dot_general_cls=Fp8DotGeneral)`` — the Dense injection.
+
+  Signature matches what flax's Dense calls: ``(lhs, rhs,
+  dimension_numbers, precision=None)``.
+  """
+
+  history_length: int = DEFAULT_HISTORY_LENGTH
+
+  @nn.compact
+  def __call__(self, lhs, rhs, dimension_numbers, precision=None,
+               preferred_element_type=None):
+    del preferred_element_type  # compute dtype already chosen by Dense
+    lhs = _DelayedAmax(self.history_length, name='lhs')(lhs)
+    rhs = _DelayedAmax(self.history_length, name='rhs')(rhs)
+    return _fp8_dot(lhs, rhs, dimension_numbers, precision)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _fp8_conv(lhs, rhs, window_strides, padding, lhs_dilation,
+              rhs_dilation, dimension_numbers, feature_group_count):
+  return jax.lax.conv_general_dilated(
+      lhs, rhs, window_strides, padding, lhs_dilation=lhs_dilation,
+      rhs_dilation=rhs_dilation, dimension_numbers=dimension_numbers,
+      feature_group_count=feature_group_count)
+
+
+def _fp8_conv_fwd(lhs, rhs, window_strides, padding, lhs_dilation,
+                  rhs_dilation, dimension_numbers, feature_group_count):
+  out = _fp8_conv(lhs, rhs, window_strides, padding, lhs_dilation,
+                  rhs_dilation, dimension_numbers, feature_group_count)
+  return out, (lhs, rhs)
+
+
+def _fp8_conv_bwd(window_strides, padding, lhs_dilation, rhs_dilation,
+                  dimension_numbers, feature_group_count, res, g):
+  lhs, rhs = res
+  gq = qdq_current(g, jnp.float8_e5m2)
+
+  def forward(a, b):
+    return jax.lax.conv_general_dilated(
+        a, b, window_strides, padding, lhs_dilation=lhs_dilation,
+        rhs_dilation=rhs_dilation, dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count)
+
+  _, vjp = jax.vjp(forward, lhs, rhs)
+  return vjp(gq)
+
+
+_fp8_conv.defvjp(_fp8_conv_fwd, _fp8_conv_bwd)
+
+
+class Fp8ConvGeneralDilated(nn.Module):
+  """``nn.Conv(conv_general_dilated_cls=Fp8ConvGeneralDilated)`` — the
+  Conv injection; signature matches flax's internal call."""
+
+  history_length: int = DEFAULT_HISTORY_LENGTH
+
+  @nn.compact
+  def __call__(self, lhs, rhs, window_strides, padding, lhs_dilation=None,
+               rhs_dilation=None, dimension_numbers=None,
+               feature_group_count=1, precision=None):
+    del precision  # fp8 rounding supersedes the XLA precision enum
+    lhs = _DelayedAmax(self.history_length, name='lhs')(lhs)
+    rhs = _DelayedAmax(self.history_length, name='rhs')(rhs)
+    if not isinstance(padding, str):
+      # custom_vjp nondiff args must hash; flax hands pads as a list.
+      padding = tuple((int(lo), int(hi)) for lo, hi in padding)
+    return _fp8_conv(
+        lhs, rhs, tuple(window_strides), padding,
+        tuple(lhs_dilation or (1,) * (lhs.ndim - 2)),
+        tuple(rhs_dilation or (1,) * (lhs.ndim - 2)),
+        dimension_numbers, feature_group_count)
+
+
+class _ConvOperandQdq(nn.Module):
+  """(x, kernel) → qdq'd pair: the SpaceToDepthConv.quantize_fn hook."""
+
+  history_length: int = DEFAULT_HISTORY_LENGTH
+
+  @nn.compact
+  def __call__(self, x, kernel):
+    x = _DelayedAmax(self.history_length, name='lhs')(x)
+    kernel = _DelayedAmax(self.history_length, name='rhs')(kernel)
+    return x, kernel
+
+
+def dense_kwargs(matmul_precision: Optional[str],
+                 history_length: int = DEFAULT_HISTORY_LENGTH) -> dict:
+  """kwargs to splat into an ``nn.Dense`` for the given precision —
+  ``{}`` for bf16 so call sites apply it unconditionally."""
+  if validate_matmul_precision(matmul_precision) != MATMUL_FP8:
+    return {}
+  return {'dot_general_cls': functools.partial(
+      Fp8DotGeneral, history_length=history_length)}
+
+
+def conv_kwargs(matmul_precision: Optional[str],
+                history_length: int = DEFAULT_HISTORY_LENGTH) -> dict:
+  """kwargs to splat into an ``nn.Conv`` for the given precision."""
+  if validate_matmul_precision(matmul_precision) != MATMUL_FP8:
+    return {}
+  return {'conv_general_dilated_cls': functools.partial(
+      Fp8ConvGeneralDilated, history_length=history_length)}
+
+
+def conv_quantize_cls(matmul_precision: Optional[str],
+                      history_length: int = DEFAULT_HISTORY_LENGTH):
+  """``quantize_cls`` factory for :class:`ops.conv_s2d.SpaceToDepthConv`
+  (None for bf16): the conv constructs it inside its own compact scope,
+  the ``dot_general_cls`` idiom, so the amax state lands under the conv
+  module."""
+  if validate_matmul_precision(matmul_precision) != MATMUL_FP8:
+    return None
+  return functools.partial(_ConvOperandQdq, history_length=history_length)
+
+
+def require_fp8_support(precision: Optional[str]) -> str:
+  """Validates and additionally gates 'fp8' on the jaxlib's dtype
+  support (the same ``fp8_supported()`` gate the serving plane uses)."""
+  precision = validate_matmul_precision(precision)
+  if precision == MATMUL_FP8 and not fp8_supported():
+    raise ValueError(
+        "matmul_precision='fp8' requested but this jaxlib/ml_dtypes "
+        'build does not support float8_e4m3fn')
+  return precision

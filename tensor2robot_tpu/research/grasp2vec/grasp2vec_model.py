@@ -133,10 +133,12 @@ class Grasp2VecModel(AbstractT2RModel):
     # vectors come back float32 and the loss head stays float32.
     return (networks.Embedding(resnet_size=self._resnet_size,
                                dtype=self.compute_dtype,
-                               remat_policy=self.remat_policy),
+                               remat_policy=self.remat_policy,
+                               kernel_policy=self.kernel_policy),
             networks.Embedding(resnet_size=self._resnet_size,
                                dtype=self.compute_dtype,
-                               remat_policy=self.remat_policy))
+                               remat_policy=self.remat_policy,
+                               kernel_policy=self.kernel_policy))
 
   def init_variables(self, rng, features, mode=ModeKeys.TRAIN):
     features, _ = self.validated_features(features, mode)

@@ -29,13 +29,15 @@ class Embedding(nn.Module):
   resnet_size: int = 50
   dtype: Optional[Any] = None
   remat_policy: str = 'none'
+  kernel_policy: str = 'none'
 
   @nn.compact
   def __call__(self, image: jnp.ndarray,
                train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     _, endpoints = ResNet(
         resnet_size=self.resnet_size, num_classes=None, dtype=self.dtype,
-        remat_policy=self.remat_policy, name='resnet')(image, train=train)
+        remat_policy=self.remat_policy, kernel_policy=self.kernel_policy,
+        name='resnet')(image, train=train)
     spatial = nn.relu(endpoints['pre_final_pool'])
     summed = jnp.mean(spatial.astype(jnp.float32), axis=(1, 2))
     return summed, spatial

@@ -25,6 +25,10 @@ import jax
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.remat import remat_module
+from tensor2robot_tpu.ops import _pallas_dispatch as pallas_dispatch
+from tensor2robot_tpu.ops import pool as pool_ops
+from tensor2robot_tpu.ops.conv_s2d import SpaceToDepthConv
+from tensor2robot_tpu.quantize import fp8_training as fp8_lib
 
 GRASP_PARAM_SIZES = {
     'projected_vector': 2,
@@ -48,6 +52,9 @@ class _ConvBN(nn.Module):
   # default). Flax BatchNorm computes mean/var in float32 internally even
   # when dtype is bfloat16, so statistics stay accurate.
   dtype: Optional[jnp.dtype] = None
+  # 'fp8' routes the conv contraction through the delayed-amax qdq
+  # injection (quantize/fp8_training.py); amax state rides 'fp8_stats'.
+  matmul_precision: str = 'bf16'
 
   @nn.compact
   def __call__(self, x, train: bool):
@@ -60,7 +67,8 @@ class _ConvBN(nn.Module):
         self.features, (self.kernel, self.kernel),
         strides=(self.strides, self.strides), padding=self.padding,
         dtype=self.dtype, use_bias=False,
-        kernel_init=nn.initializers.truncated_normal(stddev=0.01))(x)
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        **fp8_lib.conv_kwargs(self.matmul_precision))(x)
     x = nn.BatchNorm(
         use_running_average=not train, momentum=self.decay,
         epsilon=self.epsilon, use_scale=True, dtype=self.dtype)(x)
@@ -145,6 +153,15 @@ class Grasping44(nn.Module):
   # that moves the HBM batch cliff (batch 96 collapse, PERF_NOTES).
   # Identical params and numerics; 'none' is the historical program.
   remat_policy: str = 'none'
+  # Pallas kernel routing (ops/_pallas_dispatch.py): 'pool' sends the
+  # three max-pools through the argmax-emitting fused kernel (the
+  # roofline's 2.0×/2.4× pool1 rows); 'pool_conv' additionally runs
+  # conv1_1 as the space-to-depth Pallas matmul (the 3.9× conv1 row).
+  # Size-gated with stock-XLA fallback off-TPU; params identical.
+  kernel_policy: str = 'none'
+  # 'fp8' runs every Dense/Conv contraction through delayed-amax-scaled
+  # float8 qdq (quantize/fp8_training.py) — the 2×-bf16 MXU path.
+  matmul_precision: str = 'bf16'
 
   @nn.compact
   def __call__(self,
@@ -156,6 +173,10 @@ class Grasping44(nn.Module):
     # `train` (arg 2, counting self) selects BN batch-vs-running stats in
     # python, so it stays static under jax.checkpoint.
     conv_bn = remat_module(_ConvBN, self.remat_policy, static_argnums=(2,))
+    max_pool = (pool_ops.max_pool
+                if pallas_dispatch.policy_enables_pool(self.kernel_policy)
+                else nn.max_pool)
+    dense_kwargs = fp8_lib.dense_kwargs(self.matmul_precision)
     action_batched = grasp_params.ndim == 3
     if self.dtype is not None:
       images = images.astype(self.dtype)
@@ -170,32 +191,46 @@ class Grasping44(nn.Module):
     # --- image tower (networks.py:450-470)
     # use_bias=False: the following BatchNorm cancels any conv bias (see
     # _ConvBN); its gradient alone was a 456 MB reduction per step.
-    net = nn.Conv(
-        64, (6, 6), strides=(2, 2), padding='SAME', dtype=self.dtype,
-        use_bias=False,
-        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
-        name='conv1_1')(images)
+    if pallas_dispatch.policy_enables_conv(self.kernel_policy):
+      # Space-to-depth Pallas matmul form of the 6×6/s2 first conv;
+      # parameter tree identical to the nn.Conv branch (checkpoints
+      # interchange across kernel_policy settings).
+      net = SpaceToDepthConv(
+          64, (6, 6), strides=(2, 2), padding='SAME', dtype=self.dtype,
+          use_bias=False,
+          kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+          quantize_cls=fp8_lib.conv_quantize_cls(self.matmul_precision),
+          name='conv1_1')(images)
+    else:
+      net = nn.Conv(
+          64, (6, 6), strides=(2, 2), padding='SAME', dtype=self.dtype,
+          use_bias=False,
+          kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+          name='conv1_1',
+          **fp8_lib.conv_kwargs(self.matmul_precision))(images)
     # pool-then-normalize: exact rewrite of relu(bn) → pool (stats still
     # from the full 236×236 activation); see _PooledBatchNormRelu.
-    pooled = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    pooled = max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     net = _PooledBatchNormRelu(
         momentum=self.batch_norm_decay, epsilon=self.batch_norm_epsilon,
         dtype=self.dtype, name='bn1')(net, pooled, train)
     for l in range(2, 2 + self.num_convs[0]):
-      net = conv_bn(64, 5, dtype=self.dtype, name=f'conv{l}')(net, train)
-    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+      net = conv_bn(64, 5, dtype=self.dtype,
+                    matmul_precision=self.matmul_precision,
+                    name=f'conv{l}')(net, train)
+    net = max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     end_points['pool2'] = net
 
     # --- grasp-param embedding (networks.py:476-518)
     fcgrasp = nn.Dense(
         256, dtype=self.dtype, use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
-        name='fcgrasp')(grasp_params)
+        name='fcgrasp', **dense_kwargs)(grasp_params)
     fcgrasp = nn.relu(bn(fcgrasp))
     fcgrasp = nn.Dense(
         64, dtype=self.dtype,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
-        name='fcgrasp2')(fcgrasp)
+        name='fcgrasp2', **dense_kwargs)(fcgrasp)
     end_points['fcgrasp'] = fcgrasp
 
     # --- merge: broadcast-add action context onto image features
@@ -211,11 +246,14 @@ class Grasping44(nn.Module):
 
     for l in range(2 + self.num_convs[0],
                    2 + self.num_convs[0] + self.num_convs[1]):
-      net = conv_bn(64, 3, dtype=self.dtype, name=f'conv{l}')(net, train)
-    net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
+      net = conv_bn(64, 3, dtype=self.dtype,
+                    matmul_precision=self.matmul_precision,
+                    name=f'conv{l}')(net, train)
+    net = max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
     for l in range(2 + self.num_convs[0] + self.num_convs[1],
                    2 + sum(self.num_convs)):
       net = conv_bn(64, 3, padding='VALID', dtype=self.dtype,
+                    matmul_precision=self.matmul_precision,
                     name=f'conv{l}')(net, train)
     end_points['final_conv'] = net
 
@@ -224,13 +262,13 @@ class Grasping44(nn.Module):
       net = nn.Dense(
           64, dtype=self.dtype, use_bias=False,
           kernel_init=nn.initializers.truncated_normal(stddev=0.01),
-          name=f'fc{l}')(net)
+          name=f'fc{l}', **dense_kwargs)(net)
       net = nn.relu(bn(net, scale=True))
     name = 'logit' if self.num_classes == 1 else f'logit_{self.num_classes}'
     logits = nn.Dense(
         self.num_classes, dtype=self.dtype,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
-        name=name)(net)
+        name=name, **dense_kwargs)(net)
     # Loss-bearing outputs leave the network in float32: sigmoid + log loss
     # in bfloat16 would lose precision for no MXU benefit.
     logits = logits.astype(jnp.float32)

@@ -118,7 +118,9 @@ class GraspingModelWrapper(critic_model.CriticModel):
   def create_module(self) -> networks.Grasping44:
     return networks.Grasping44(
         num_convs=self._num_convs, dtype=self.compute_dtype,
-        remat_policy=self.remat_policy)
+        remat_policy=self.remat_policy,
+        kernel_policy=self.kernel_policy,
+        matmul_precision=self.matmul_precision)
 
   def param_sharding_rules(self, mesh):
     """Megatron-style TP pair on the grasp-param MLP: ``fcgrasp`` kernel

@@ -311,6 +311,20 @@ class TrainerConfig:
   # advance once per effective batch. Composes with steps_per_dispatch:
   # K host batches × M microbatches nest as one XLA program. B % M == 0.
   grad_accum_microbatches: int = 1
+  # Dense/Conv contraction precision for the training step
+  # (quantize/fp8_training.py). None leaves the model's own
+  # ``matmul_precision`` untouched; 'bf16' forces the historical
+  # program; 'fp8' routes every Dense/Conv contraction through
+  # delayed-amax-scaled float8_e4m3fn quantize-dequantize — the chip's
+  # 2×-bf16 MXU path, the only lever on the 22% MFU ceiling itself.
+  # Master weights stay float32 in the optimizer state (params are
+  # never cast); per-op gradients leave the injected ops unscaled in
+  # full precision before any accumulation; amax histories ride the
+  # 'fp8_stats' collection through model_state like BatchNorm
+  # statistics. Gated on quantization.fp8_supported(); accepted by a
+  # parity band vs. the bf16 run (tests/test_kernels.py), the same
+  # certificate discipline as the grasp2vec bf16 soak.
+  matmul_precision: Optional[str] = None
   # Per-dispatch step-time breakdown (observability/): decomposes each
   # dispatch's wall time into host wait-for-batch, H2D placement,
   # dispatch/enqueue, device step, and callback overhead, and merges
@@ -867,6 +881,15 @@ class Trainer:
                shutdown: Optional[resilience.GracefulShutdown] = None):
     self._model = model
     self._config = config
+    if config.matmul_precision is not None:
+      # Before any module build: modules bake the precision in at
+      # construction (the Dense/Conv injection classes).
+      if hasattr(model, 'set_matmul_precision'):
+        model.set_matmul_precision(config.matmul_precision)
+      else:
+        from tensor2robot_tpu.quantize import fp8_training as fp8_lib
+
+        fp8_lib.require_fp8_support(config.matmul_precision)
     self._nonfinite_policy = (
         resilience.NonFinitePolicy(config.nonfinite_mode,
                                    config.nonfinite_halt_after)

@@ -224,6 +224,86 @@ class TestShardCommitProtocol:
       faults.apply_actor_fault('explode:1')
 
 
+class TestShardRetentionGC:
+  """max_shards/max_bytes GC (PR 15 satellite): only commit-marked
+  shards strictly older than the follow-mode sampling window are ever
+  deleted; torn shards and the window-covering suffix are untouchable;
+  deletions count ``collect/shards_gced``."""
+
+  def teardown_method(self):
+    faults.clear_actor_faults()
+
+  def _gc_count(self):
+    from tensor2robot_tpu.observability import metrics as metrics_lib
+
+    return metrics_lib.counter('collect/shards_gced').value
+
+  def test_max_shards_prunes_oldest_committed(self, tmp_path):
+    out = str(tmp_path)
+    before = self._gc_count()
+    writer = EpisodeShardWriter(out, actor_id=0, episodes_per_shard=1,
+                                max_shards=2, retain_window_records=0)
+    for i in range(5):
+      writer.add_episode([_record(i)], {'request_id': f'r{i}'})
+    shards = sorted(glob.glob(os.path.join(out, '*.tfrecord')))
+    assert len(shards) == 2
+    # the SURVIVORS are the newest two, still marker-carrying
+    assert all(os.path.exists(commit_marker_path(s)) for s in shards)
+    assert [os.path.basename(p) for p in writer.committed_paths] == [
+        os.path.basename(s) for s in shards]
+    assert len(writer.gced_paths) == 3
+    assert self._gc_count() - before == 3
+    # markers and sidecars of the victims are gone too
+    leftovers = [f for f in os.listdir(out)
+                 if f.endswith('.commit') or f.endswith('.idx')]
+    assert len([f for f in leftovers if f.endswith('.commit')]) == 2
+
+  def test_follow_window_suffix_is_never_deleted(self, tmp_path):
+    out = str(tmp_path)
+    # 1 record per shard; window of 3 records protects the newest 3
+    # shards even under max_shards=1.
+    writer = EpisodeShardWriter(out, actor_id=0, episodes_per_shard=1,
+                                max_shards=1, retain_window_records=3)
+    for i in range(6):
+      writer.add_episode([_record(i)], {'request_id': f'r{i}'})
+    shards = sorted(glob.glob(os.path.join(out, '*.tfrecord')))
+    assert len(shards) == 3  # the sampling window survives the budget
+    assert all(os.path.exists(commit_marker_path(s)) for s in shards)
+
+  def test_max_bytes_budget(self, tmp_path):
+    out = str(tmp_path)
+    writer = EpisodeShardWriter(out, actor_id=0, episodes_per_shard=1,
+                                max_bytes=1, retain_window_records=2)
+    for i in range(4):
+      writer.add_episode([_record(i)], {'request_id': f'r{i}'})
+    # over-budget from shard 1 on, but the 2-record window (newest two
+    # shards) is sacrosanct: everything else goes.
+    assert len(glob.glob(os.path.join(out, '*.tfrecord'))) == 2
+
+  def test_torn_shards_are_not_gc_candidates(self, tmp_path):
+    out = str(tmp_path)
+    faults.TornShardInjector(at_shard=0).install()
+    writer = EpisodeShardWriter(out, actor_id=0, episodes_per_shard=1,
+                                max_shards=1, retain_window_records=0)
+    for i in range(4):
+      writer.add_episode([_record(i)], {'request_id': f'r{i}'})
+    shards = sorted(glob.glob(os.path.join(out, '*.tfrecord')))
+    # shard 0 is torn (never committed → never tracked → never deleted,
+    # it is crash evidence); committed shards pruned to the budget.
+    torn = [s for s in shards
+            if not os.path.exists(commit_marker_path(s))]
+    assert len(torn) == 1 and torn[0].endswith('00000.tfrecord')
+    assert len(shards) == 2  # torn survivor + 1 committed
+
+  def test_gc_off_by_default(self, tmp_path):
+    out = str(tmp_path)
+    writer = EpisodeShardWriter(out, actor_id=0, episodes_per_shard=1)
+    for i in range(5):
+      writer.add_episode([_record(i)], {'request_id': f'r{i}'})
+    assert len(glob.glob(os.path.join(out, '*.tfrecord'))) == 5
+    assert not writer.gced_paths
+
+
 def _write_committed_shard(out_dir, name, records, versions=None,
                            episodes=None):
   from tensor2robot_tpu.data import records as records_lib

@@ -251,17 +251,20 @@ def device_op_times_full(tracedir, device_prefix='/device:TPU'):
   return total / 1e9, {k: v / 1e9 for k, v in ops.items()}
 
 
-def _build_workload(name: str, remat: str = 'none'):
+def _build_workload(name: str, remat: str = 'none',
+                    kernel_policy: str = 'none'):
   """(model, batch_size) for each profiled workload; batch sizes match
   the PERF_NOTES / BASELINE.json recording configurations."""
   if name == 'qtopt':
     from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
 
-    return GraspingModelWrapper(device_type='tpu', remat_policy=remat), 32
+    return GraspingModelWrapper(device_type='tpu', remat_policy=remat,
+                                kernel_policy=kernel_policy), 32
   if name == 'grasp2vec':
     from tensor2robot_tpu.research.grasp2vec import Grasp2VecModel
 
-    return Grasp2VecModel(device_type='tpu', remat_policy=remat), 16
+    return Grasp2VecModel(device_type='tpu', remat_policy=remat,
+                          kernel_policy=kernel_policy), 16
   if name == 'wtl':
     from tensor2robot_tpu.research.vrgripper import (
         VRGripperEnvVisionTrialModel)
@@ -294,10 +297,15 @@ def main(argv=None):
   parser.add_argument('--remat', default='none',
                       choices=('none', 'conv_towers', 'full'),
                       help='activation remat policy on the towers')
+  parser.add_argument('--kernel-policy', default='none',
+                      choices=('none', 'pool', 'pool_conv'),
+                      help='Pallas kernel routing on the towers: roofline '
+                           'the hand-kernel program (qtopt/grasp2vec)')
   args = parser.parse_args(sys.argv[1:] if argv is None else argv)
 
   workload = args.workload
-  model, batch_size = _build_workload(workload, remat=args.remat)
+  model, batch_size = _build_workload(workload, remat=args.remat,
+                                      kernel_policy=args.kernel_policy)
   if args.batch is not None:
     batch_size = args.batch
   config = TrainerConfig(model_dir='', max_train_steps=1,
@@ -342,6 +350,8 @@ def main(argv=None):
               f'{total_ms / n / args.accum:.3f} ms/microbatch)')
   if args.remat != 'none':
     label += f'  [remat={args.remat}]'
+  if args.kernel_policy != 'none':
+    label += f'  [kernel_policy={args.kernel_policy}]'
   print(label)
   from tensor2robot_tpu.observability import memory as memory_lib
 

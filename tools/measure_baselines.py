@@ -165,7 +165,6 @@ def measure_pose_env_maml(batch_size: int = 64):
   anchor is therefore the xplane-traced DEVICE ms — channel-immune,
   like WTL's — with wall recorded as context only.
   """
-  from tensor2robot_tpu.meta_learning import MAMLModel
   from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModelMAML
   from tensor2robot_tpu.research.pose_env.pose_env_models import (
       PoseEnvRegressionModel)
@@ -177,12 +176,21 @@ def measure_pose_env_maml(batch_size: int = 64):
 
 
 def measure_qtopt_batch(batch_size: int, steps: int = 30,
-                        grad_accum: int = 1, remat: str = 'none'):
-  """One QT-Opt batch-size point: (wall steps/s, device ms/step)."""
+                        grad_accum: int = 1, remat: str = 'none',
+                        kernel_policy: str = 'none',
+                        matmul_precision: str = 'bf16'):
+  """One QT-Opt batch-size point: (wall steps/s, device ms/step).
+
+  ``kernel_policy``/``matmul_precision`` select the Pallas pool/conv
+  kernels and the fp8 contraction path (the PR-15 A/B axes; the bench's
+  ``qtopt_kernel_step_ms`` / ``qtopt_fp8_step_ms`` lines run this in a
+  subprocess per arm)."""
   from tensor2robot_tpu.research.qtopt import GraspingModelWrapper
 
   return _time_train_step(
-      GraspingModelWrapper(device_type='tpu', remat_policy=remat),
+      GraspingModelWrapper(device_type='tpu', remat_policy=remat,
+                           kernel_policy=kernel_policy,
+                           matmul_precision=matmul_precision),
       batch_size=batch_size, steps=steps, trace=True,
       grad_accum=grad_accum)
 
@@ -250,6 +258,14 @@ def main(argv=None):
                       choices=('none', 'conv_towers', 'full'),
                       help='activation remat policy for the --qtopt-batch '
                            'point')
+  parser.add_argument('--kernel-policy', default='none',
+                      choices=('none', 'pool', 'pool_conv'),
+                      help='Pallas kernel routing for the --qtopt-batch '
+                           'point (ops/pool.py + ops/conv_s2d.py)')
+  parser.add_argument('--matmul-precision', default='bf16',
+                      choices=('bf16', 'fp8'),
+                      help='Dense/Conv contraction precision for the '
+                           '--qtopt-batch point (quantize/fp8_training.py)')
   parser.add_argument('--only', default=None,
                       help='comma list of: pose_env, grasp2vec, wtl, '
                            'maml, qtopt_curve, qtopt_accum_curve '
@@ -264,7 +280,9 @@ def main(argv=None):
     from tensor2robot_tpu.observability import memory as memory_lib
 
     wall, device_ms = measure_qtopt_batch(
-        args.qtopt_batch, grad_accum=args.accum, remat=args.remat)
+        args.qtopt_batch, grad_accum=args.accum, remat=args.remat,
+        kernel_policy=args.kernel_policy,
+        matmul_precision=args.matmul_precision)
     # Allocator high-water mark AFTER the timed loop: with the whole
     # point in its own subprocess, the peak IS this configuration's —
     # the number that says on which side of the HBM cliff it ran.
@@ -280,6 +298,8 @@ def main(argv=None):
                                   if peak_mb is not None else None),
         'grad_accum_microbatches': args.accum,
         'remat_policy': args.remat,
+        'kernel_policy': args.kernel_policy,
+        'matmul_precision': args.matmul_precision,
     }))
     return
 
