@@ -38,7 +38,7 @@ import logging
 import os
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 EXPORT_NAME = 'latest_exporter_numpy'
 LOOP_EXIT_FILENAME = 'loop_exit.json'
@@ -75,6 +75,12 @@ class LoopConfig:
   actor_env: Optional[Dict[str, str]] = None
   # Drill accounting on the follow stream (sampled-record digests).
   trace_samples: bool = False
+  # Programmatic embedders (the chaos soak harness, fleet-ops tests)
+  # receive the LIVE fleet handles once everything is running:
+  # called with (supervisor, generator) right before the training loop
+  # enters, so an actuator engine can wire itself to the real
+  # ActorSupervisor and follow stream. Not part of the JSON surface.
+  on_fleet_started: Optional[Callable] = None
 
   @property
   def episodes_dir(self) -> str:
@@ -264,6 +270,8 @@ def run_collect_train(config: LoopConfig) -> LoopResult:
   supervisor.start()
   supervisor.start_monitor()
   train_iter = generator.create_iterator(ModeKeys.TRAIN)
+  if config.on_fleet_started is not None:
+    config.on_fleet_started(supervisor, generator)
   preempted = False
   t_train0 = time.monotonic()
   try:

@@ -533,6 +533,7 @@ class _ActorSlot:
     self.crashes = 0
     self.restarts = 0
     self.dead = False
+    self.retired = False  # retire_actor(): any exit is orderly, no respawn
     self.exit_code: Optional[int] = None  # last observed exit
     self.respawn_at: Optional[float] = None  # monotonic deadline
 
@@ -573,6 +574,7 @@ class ActorSupervisor:
     self._env = dict(env) if env is not None else None
     self._monitor: Optional[threading.Thread] = None
     self._stop_monitor = threading.Event()
+    self._stopping = False  # GUARDED_BY(self._lock)
     self._dead_gauge = metrics_lib.gauge('collect/actors_dead')
     self._alive_gauge = metrics_lib.gauge('collect/actors_alive')
 
@@ -616,7 +618,7 @@ class ActorSupervisor:
             continue
           slot.proc = None
           slot.exit_code = rc
-          if rc in ORDERLY_EXIT_CODES:
+          if rc in ORDERLY_EXIT_CODES or slot.retired:
             flight.event('collect', 'collect/actor_exit',
                          f'name={slot.name} code={rc} orderly=1')
             continue
@@ -637,6 +639,12 @@ class ActorSupervisor:
                 'Actor %s is DEAD: %d crash(es) exceeded the budget of %d; '
                 'not respawning. The fleet continues degraded.',
                 slot.name, slot.crashes, self._crash_budget)
+            continue
+          if self._stopping:
+            # Shutdown race: an actor SIGTERMed during its interpreter
+            # startup (no handler installed yet) dies with a crash code.
+            # Respawning it here would hand wait() a fresh process that
+            # was never signaled — a guaranteed straggler.
             continue
           delay = self._backoff.delay(slot.crashes - 1)
           slot.respawn_at = now + delay
@@ -671,6 +679,7 @@ class ActorSupervisor:
   def request_stop(self, sig: int = signal.SIGTERM) -> None:
     """Fans the shutdown signal out to every live actor."""
     with self._lock:
+      self._stopping = True  # the monitor must not respawn from here on
       for slot in self._slots.values():
         slot.respawn_at = None  # a stopping fleet schedules no respawns
         if slot.running:
@@ -736,6 +745,61 @@ class ActorSupervisor:
   def any_dead(self) -> bool:
     with self._lock:
       return any(s.dead for s in self._slots.values())
+
+  def alive_count(self) -> int:
+    with self._lock:
+      return sum(1 for s in self._slots.values() if s.running)
+
+  def add_actor(self, name: str, argv: List[str]) -> bool:
+    """Registers and spawns a new actor at runtime (the actor-fleet
+    autoscaler's grow/replace surface). False if ``name`` is taken."""
+    with self._lock:
+      if name in self._slots:
+        return False
+      slot = _ActorSlot(name, list(argv))
+      self._slots[name] = slot
+      self._spawn(slot)
+    metrics_lib.counter('collect/actors_added').inc()
+    self._publish()
+    return True
+
+  def retire_actor(self, name: Optional[str] = None,
+                   sig: int = signal.SIGTERM) -> Optional[str]:
+    """Gracefully removes one actor from the fleet (scale-down).
+
+    Picks ``name``, or the most recently added running actor when None.
+    The slot is marked retired — its exit is orderly whatever the code,
+    and it never respawns. Returns the retired name, or None when no
+    actor was eligible.
+    """
+    with self._lock:
+      slot = None
+      if name is not None:
+        candidate = self._slots.get(name)
+        if candidate is not None and not candidate.dead \
+            and not candidate.retired:
+          slot = candidate
+      else:
+        running = [s for s in self._slots.values()
+                   if s.running and not s.retired]
+        if running:
+          slot = running[-1]
+      if slot is None:
+        return None
+      slot.retired = True
+      slot.respawn_at = None
+      proc = slot.proc
+    if proc is not None and proc.poll() is None:
+      try:
+        proc.send_signal(sig)
+      except OSError:
+        pass
+    metrics_lib.counter('collect/actors_retired').inc()
+    flight.event('collect', 'collect/actor_retired',
+                 f'name={slot.name} signal={sig}')
+    logging.info('Actor %s retired from the fleet.', slot.name)
+    self._publish()
+    return slot.name
 
 
 def main(argv: Optional[List[str]] = None) -> int:

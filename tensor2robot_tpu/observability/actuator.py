@@ -1,0 +1,767 @@
+"""Actuator layer: the watch→act half of the closed fleet-ops loop.
+
+PRs 10–12 built the *watch* plane — traces, SLO burn alerts, anomaly
+quarantine, postmortem bundles — but none of it moved a control
+surface: a replica with anomalous p99 kept taking traffic, a starving
+actor fleet stayed its size. This module wires those signals to the
+control surfaces the fleet already exposes, with the safety machinery
+an unattended controller needs:
+
+* **deadband** — each actuator's ``decide()`` proposes nothing while
+  its signals sit inside the do-nothing band, so steady state costs
+  zero actions;
+* **hysteresis** — a signal must breach for ``trip_after`` consecutive
+  polls before an action fires and recover for ``clear_after`` polls
+  before the tripped state releases, so a single noisy sample cannot
+  flap a replica in and out of the fleet;
+* **per-window action budget** — at most ``max_actions_per_window``
+  applied actions per ``budget_window_secs``; proposals past the
+  budget are recorded (flight event + counter) but NOT applied, so a
+  pathological signal degrades to logging, never to a thrash storm;
+* **dry_run** — decisions are recorded exactly as if applied (flight
+  event, trace span, history) but the control surface is never
+  touched, so a new policy can soak against production signals first.
+
+Every decision — applied, denied by budget, refused by the surface, or
+dry-run — lands in the flight recorder (kind ``'actuator'``) and the
+trace ring (span kind ``'actuator'``), so a postmortem shows what the
+machinery did and why, on the same timeline as the requests it saved.
+
+Concrete actuators (see each class): :class:`FleetLatencyEjector`
+(balancer ejection of a replica anomalous *relative to the fleet*,
+with probation re-admission), :class:`ServingAutoscaler` (replica
+count from SLO burn + queue depth), :class:`ActorFleetAutoscaler`
+(collect-fleet size from follow staleness/starvation gauges), and
+:class:`RouterBudgetActuator` (HBM budget re-split from page-in
+churn). :class:`ActuatorEngine` polls them on one cadence.
+
+Pure stdlib, same dependency discipline as the rest of
+``observability/`` — control surfaces arrive as duck-typed handles
+(a Balancer, an ActorSupervisor, a ModelRouter), never as imports.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import tracing
+
+__all__ = [
+    'Action', 'Hysteresis', 'Actuator', 'ActuatorEngine',
+    'FleetLatencyEjector', 'ServingAutoscaler', 'ActorFleetAutoscaler',
+    'RouterBudgetActuator',
+]
+
+
+class Action(NamedTuple):
+  """One recorded actuator decision (applied or not)."""
+
+  time: float
+  actuator: str                  # actuator instance name
+  verb: str                      # e.g. 'eject', 'scale_up', 'grow_budget'
+  target: str                    # what it acted on (address, actor name…)
+  reason: str                    # the signals that justified it
+  applied: bool                  # False: dry_run, budget-denied, or refused
+  outcome: str                   # 'applied'|'dry_run'|'budget_denied'|'refused'|'error'
+
+  def as_dict(self) -> Dict[str, Any]:
+    return self._asdict()
+
+
+class _Proposal(NamedTuple):
+  """What ``decide()`` returns: an action wish + how to apply it.
+
+  ``apply`` returns True if the control surface accepted the action and
+  False if it refused (e.g. ejecting the last healthy replica); it is
+  only invoked outside dry-run and inside budget.
+  """
+
+  verb: str
+  target: str
+  reason: str
+  apply: Callable[[], bool]
+
+
+class Hysteresis:
+  """Consecutive-poll trip/clear latch.
+
+  ``update(breached)`` returns ``'trip'`` when the signal has breached
+  for ``trip_after`` consecutive polls (and, while still tripped,
+  again every further ``trip_after`` breaches — so a sustained breach
+  can justify repeated actions, paced by the actuator budget), and
+  ``'clear'`` when a tripped signal has recovered for ``clear_after``
+  consecutive polls. Any other poll returns None.
+  """
+
+  def __init__(self, trip_after: int = 2, clear_after: int = 2):
+    if trip_after < 1 or clear_after < 1:
+      raise ValueError('trip_after and clear_after must be >= 1')
+    self.trip_after = int(trip_after)
+    self.clear_after = int(clear_after)
+    self.tripped = False
+    self._breaches = 0
+    self._clears = 0
+
+  def update(self, breached: bool) -> Optional[str]:
+    if breached:
+      self._clears = 0
+      self._breaches += 1
+      if self._breaches >= self.trip_after:
+        self._breaches = 0
+        self.tripped = True
+        return 'trip'
+      return None
+    self._breaches = 0
+    if self.tripped:
+      self._clears += 1
+      if self._clears >= self.clear_after:
+        self._clears = 0
+        self.tripped = False
+        return 'clear'
+    return None
+
+
+def _median(values: Sequence[float]) -> float:
+  ordered = sorted(values)
+  n = len(ordered)
+  if n == 0:
+    return 0.0
+  mid = n // 2
+  if n % 2:
+    return float(ordered[mid])
+  return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+class Actuator:
+  """Base: budget, dry-run, and the flight/trace recording contract.
+
+  Subclasses implement :meth:`decide`, returning zero or more
+  :class:`_Proposal`\\ s — returning ``[]`` IS the deadband. The base
+  :meth:`poll` owns everything downstream of the decision: the
+  per-window budget, dry-run short-circuit, applying, and recording
+  every outcome as a flight event (kind ``'actuator'``) + trace span.
+  """
+
+  def __init__(self,
+               name: str,
+               max_actions_per_window: int = 4,
+               budget_window_secs: float = 60.0,
+               dry_run: bool = False):
+    if not name or any(c.isspace() for c in name):
+      raise ValueError(f'actuator name {name!r} must be a non-empty '
+                       'whitespace-free identifier')
+    self.name = name
+    self.dry_run = bool(dry_run)
+    self._max_actions = int(max_actions_per_window)
+    self._window_secs = float(budget_window_secs)
+    self._lock = threading.Lock()
+    # Timestamps of budget-consuming decisions in the current window.
+    self._action_times: collections.deque = (  # GUARDED_BY(self._lock)
+        collections.deque())
+    self._actions_total = 0       # GUARDED_BY(self._lock)
+    self._denied_total = 0        # GUARDED_BY(self._lock)
+    self._m_actions = metrics_lib.counter('actuator/actions')
+    self._m_denied = metrics_lib.counter('actuator/denied_budget')
+    self._m_refused = metrics_lib.counter('actuator/refused')
+    self._m_errors = metrics_lib.counter('actuator/errors')
+
+  # -------------------------------------------------------------- subclass
+
+  def decide(self, now: float) -> List[_Proposal]:
+    """Return proposals, or ``[]`` inside the deadband."""
+    raise NotImplementedError
+
+  # ------------------------------------------------------------------ poll
+
+  def _budget_admit(self, now: float) -> bool:
+    """True if a new action fits the window budget (and charges it)."""
+    with self._lock:
+      while self._action_times and (
+          now - self._action_times[0] > self._window_secs):
+        self._action_times.popleft()
+      if len(self._action_times) >= self._max_actions:
+        self._denied_total += 1
+        return False
+      self._action_times.append(now)
+      self._actions_total += 1
+      return True
+
+  def _record(self, action: Action) -> None:
+    detail = (f'target={action.target} outcome={action.outcome} '
+              f'dry_run={int(self.dry_run)} reason={action.reason}')
+    flight.event('actuator', f'actuator/{self.name}/{action.verb}', detail)
+    tracing.record_span(
+        f'actuator/{self.name}/{action.verb}', 'actuator',
+        tracing.mint_trace_id(), tracing.mint_span_id(), '',
+        action.time, time.time(), detail=detail)
+    logging.info('actuator %s: %s %s (%s)', self.name, action.verb,
+                 action.target, action.outcome)
+
+  def poll(self, now: Optional[float] = None) -> List[Action]:
+    """One decision pass; returns the actions recorded this poll."""
+    now = time.time() if now is None else float(now)
+    try:
+      proposals = self.decide(now)
+    except Exception:  # pylint: disable=broad-except
+      logging.exception('actuator %s: decide() failed (non-fatal)',
+                        self.name)
+      self._m_errors.inc()
+      return []
+    actions: List[Action] = []
+    for proposal in proposals:
+      if not self._budget_admit(now):
+        self._m_denied.inc()
+        action = Action(now, self.name, proposal.verb, proposal.target,
+                        proposal.reason, False, 'budget_denied')
+      elif self.dry_run:
+        action = Action(now, self.name, proposal.verb, proposal.target,
+                        proposal.reason, False, 'dry_run')
+      else:
+        try:
+          accepted = bool(proposal.apply())
+        except Exception:  # pylint: disable=broad-except
+          logging.exception('actuator %s: apply %s failed', self.name,
+                            proposal.verb)
+          self._m_errors.inc()
+          accepted = False
+          action = Action(now, self.name, proposal.verb, proposal.target,
+                          proposal.reason, False, 'error')
+        else:
+          if accepted:
+            self._m_actions.inc()
+            action = Action(now, self.name, proposal.verb, proposal.target,
+                            proposal.reason, True, 'applied')
+          else:
+            self._m_refused.inc()
+            action = Action(now, self.name, proposal.verb, proposal.target,
+                            proposal.reason, False, 'refused')
+      self._record(action)
+      actions.append(action)
+    return actions
+
+  def report(self) -> Dict[str, Any]:
+    with self._lock:
+      return {
+          'name': self.name,
+          'dry_run': self.dry_run,
+          'max_actions_per_window': self._max_actions,
+          'budget_window_secs': self._window_secs,
+          'window_actions': len(self._action_times),
+          'actions_total': self._actions_total,
+          'budget_denied_total': self._denied_total,
+      }
+
+
+class ActuatorEngine:
+  """Polls a set of actuators on one cadence, keeping a bounded action
+  history for ``/statz``-style reporting.
+
+  ``slo_engine`` / ``anomaly_watch`` are optional input planes; when
+  given AND ``drive_inputs=True``, each engine poll first runs
+  ``slo_engine.evaluate()`` and ``anomaly_watch.poll()`` so a single
+  loop drives signal refresh and actuation in order (the chaos-drill
+  wiring); leave it False when those planes run their own threads.
+  """
+
+  def __init__(self,
+               actuators: Sequence[Actuator],
+               poll_interval_secs: float = 1.0,
+               slo_engine: Optional[Any] = None,
+               anomaly_watch: Optional[Any] = None,
+               drive_inputs: bool = False,
+               history: int = 256,
+               register_report: bool = True):
+    if not actuators:
+      raise ValueError('ActuatorEngine needs at least one actuator')
+    names = [a.name for a in actuators]
+    if len(set(names)) != len(names):
+      raise ValueError(f'duplicate actuator names in {names}')
+    self._actuators = tuple(actuators)
+    self._interval = float(poll_interval_secs)
+    self._slo_engine = slo_engine
+    self._anomaly_watch = anomaly_watch
+    self._drive_inputs = bool(drive_inputs)
+    self._register_report = bool(register_report)
+    self._lock = threading.Lock()
+    self._history: collections.deque = (  # GUARDED_BY(self._lock)
+        collections.deque(maxlen=int(history)))
+    self._polls = 0  # GUARDED_BY(self._lock)
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  def poll(self, now: Optional[float] = None) -> List[Action]:
+    if self._drive_inputs:
+      if self._slo_engine is not None:
+        try:
+          self._slo_engine.evaluate(now)
+        except Exception:  # pylint: disable=broad-except
+          logging.exception('actuator engine: SLO evaluate failed')
+      if self._anomaly_watch is not None:
+        try:
+          self._anomaly_watch.poll()
+        except Exception:  # pylint: disable=broad-except
+          logging.exception('actuator engine: anomaly poll failed')
+    actions: List[Action] = []
+    for actuator in self._actuators:
+      actions.extend(actuator.poll(now))
+    with self._lock:
+      self._history.extend(actions)
+      self._polls += 1
+    return actions
+
+  def actions(self, last_secs: Optional[float] = None) -> List[Action]:
+    with self._lock:
+      recorded = list(self._history)
+    if last_secs is None:
+      return recorded
+    cutoff = time.time() - last_secs
+    return [a for a in recorded if a.time >= cutoff]
+
+  # -------------------------------------------------------------- lifecycle
+
+  def start(self) -> 'ActuatorEngine':
+    if self._thread is not None:
+      return self
+
+    def run():
+      while not self._stop.wait(self._interval):
+        try:
+          self.poll()
+        except Exception:  # pylint: disable=broad-except
+          logging.exception('actuator poll failed (non-fatal).')
+
+    self._stop.clear()
+    self._thread = threading.Thread(target=run, daemon=True,
+                                    name='t2r-actuator')
+    self._thread.start()
+    if self._register_report:
+      metrics_lib.register_report_provider('actuator', self.report)
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10.0)
+      self._thread = None
+      if self._register_report:
+        metrics_lib.unregister_report_provider('actuator')
+
+  def __enter__(self) -> 'ActuatorEngine':
+    return self.start()
+
+  def __exit__(self, *exc) -> None:
+    self.stop()
+
+  # -------------------------------------------------------------- reporting
+
+  def report(self) -> Dict[str, Any]:
+    with self._lock:
+      polls = self._polls
+      recent = [a.as_dict() for a in list(self._history)[-32:]]
+    return {
+        'polls': polls,
+        'poll_interval_secs': self._interval,
+        'actuators': [a.report() for a in self._actuators],
+        'recent_actions': recent,
+    }
+
+
+# ---------------------------------------------------------------- concrete
+
+
+class FleetLatencyEjector(Actuator):
+  """Ejects a serving replica whose latency is anomalous *relative to
+  the fleet* (its peers' median + MAD, leave-one-out — the carried
+  PR-12 follow-up: /healthz cannot see a wedged-but-200 replica), with
+  probation re-admission once its health probes stay clean.
+
+  The balancer handle must expose ``backend_latency_snapshot()``,
+  ``quarantine(index, reason)`` (which itself refuses to empty the
+  healthy set — the actuator ALSO pre-checks ``min_healthy`` so the
+  refusal normally never reaches the surface), and
+  ``readmit(index, reason)``.
+  """
+
+  def __init__(self,
+               balancer: Any,
+               k: float = 4.0,
+               rel_floor: float = 0.5,
+               abs_floor_ms: float = 20.0,
+               min_samples: int = 8,
+               min_healthy: int = 1,
+               probation_secs: float = 3.0,
+               trip_after: int = 2,
+               clear_after: int = 2,
+               name: str = 'fleet_latency',
+               **kwargs):
+    super().__init__(name, **kwargs)
+    self._balancer = balancer
+    self._k = float(k)
+    self._rel_floor = float(rel_floor)
+    self._abs_floor_ms = float(abs_floor_ms)
+    self._min_samples = int(min_samples)
+    self._min_healthy = int(min_healthy)
+    self._probation_secs = float(probation_secs)
+    self._trip_after = int(trip_after)
+    self._clear_after = int(clear_after)
+    self._hysteresis: Dict[int, Hysteresis] = {}
+    self._quarantined_at: Dict[int, float] = {}  # index -> eject time
+
+  def _latch(self, index: int) -> Hysteresis:
+    if index not in self._hysteresis:
+      self._hysteresis[index] = Hysteresis(self._trip_after,
+                                           self._clear_after)
+    return self._hysteresis[index]
+
+  def decide(self, now: float) -> List[_Proposal]:
+    snapshot = self._balancer.backend_latency_snapshot()
+    proposals: List[_Proposal] = []
+
+    # Probation re-admission: quarantined backends whose probes are
+    # clean again rejoin after serving out probation.
+    for backend in snapshot:
+      index = backend['index']
+      if not backend.get('quarantined'):
+        self._quarantined_at.pop(index, None)
+        continue
+      ejected_at = self._quarantined_at.setdefault(index, now)
+      if (now - ejected_at >= self._probation_secs
+          and backend.get('probing_ok')):
+        proposals.append(_Proposal(
+            'readmit', backend['address'],
+            f'probation={now - ejected_at:.1f}s probes clean',
+            lambda i=index: self._balancer.readmit(
+                i, reason=f'{self.name} probation complete')))
+
+    # Fleet-relative anomaly: a cross-section needs >= 2 comparable
+    # replicas; with fewer there is no fleet to be anomalous against.
+    # The baseline for each replica is LEAVE-ONE-OUT — its peers'
+    # median/MAD, never its own mean: in a small fleet (the 2-replica
+    # drill shape) a wedged replica would otherwise drag the median up
+    # and blow the MAD out so far that its own anomaly becomes
+    # structurally undetectable.
+    eligible = [b for b in snapshot
+                if b.get('healthy') and not b.get('quarantined')
+                and b.get('count', 0) >= self._min_samples]
+    if len(eligible) < 2:
+      return proposals
+    healthy_count = sum(1 for b in snapshot if b.get('healthy'))
+    for backend in eligible:
+      index = backend['index']
+      peers = [b['mean_ms'] for b in eligible if b['index'] != index]
+      med = _median(peers)
+      mad = _median([abs(m - med) for m in peers])
+      cutoff = med + max(self._k * 1.4826 * mad,
+                         self._rel_floor * med, self._abs_floor_ms)
+      transition = self._latch(index).update(backend['mean_ms'] > cutoff)
+      if transition != 'trip':
+        continue
+      reason = (f'mean={backend["mean_ms"]:.1f}ms peer_median='
+                f'{med:.1f}ms cutoff={cutoff:.1f}ms n={len(eligible)}')
+      if healthy_count - 1 < self._min_healthy:
+        # Graceful degradation over self-inflicted outage: record the
+        # refusal, leave the replica in the fleet.
+        proposals.append(_Proposal(
+            'eject_refused', backend['address'],
+            reason + f' refused: would leave {healthy_count - 1} healthy '
+                     f'< min_healthy={self._min_healthy}',
+            lambda: False))
+        continue
+      healthy_count -= 1
+      self._quarantined_at[index] = now
+      proposals.append(_Proposal(
+          'eject', backend['address'], reason,
+          lambda i=index, r=reason: self._balancer.quarantine(
+              i, reason=f'{self.name}: {r}')))
+    return proposals
+
+
+class ServingAutoscaler(Actuator):
+  """Grows/shrinks the serving replica fleet from SLO burn + queue
+  depth.
+
+  The scale mechanics are injected (``scale_up()``/``scale_down()``
+  callables returning True when they actually changed the fleet) so
+  the policy works for in-process replicas (tests, the chaos drill)
+  and subprocess replicas alike. The deadband is the gap between
+  ``up_queue_depth`` and ``down_queue_depth`` with no SLO alert.
+  """
+
+  def __init__(self,
+               scale_up: Callable[[], bool],
+               scale_down: Callable[[], bool],
+               queue_depth_fn: Callable[[], float],
+               replica_count_fn: Callable[[], int],
+               min_replicas: int = 1,
+               max_replicas: int = 4,
+               up_queue_depth: float = 8.0,
+               down_queue_depth: float = 1.0,
+               slo_engine: Optional[Any] = None,
+               trip_after: int = 2,
+               clear_after: int = 2,
+               name: str = 'serving_scale',
+               **kwargs):
+    super().__init__(name, **kwargs)
+    if min_replicas < 1 or max_replicas < min_replicas:
+      raise ValueError('need 1 <= min_replicas <= max_replicas')
+    if down_queue_depth >= up_queue_depth:
+      raise ValueError('down_queue_depth must sit below up_queue_depth '
+                       '(the gap is the deadband)')
+    self._scale_up = scale_up
+    self._scale_down = scale_down
+    self._queue_depth_fn = queue_depth_fn
+    self._replica_count_fn = replica_count_fn
+    self._min = int(min_replicas)
+    self._max = int(max_replicas)
+    self._up_depth = float(up_queue_depth)
+    self._down_depth = float(down_queue_depth)
+    self._slo_engine = slo_engine
+    self._up = Hysteresis(trip_after, clear_after)
+    self._down = Hysteresis(trip_after, clear_after)
+
+  def _alerting(self) -> List[str]:
+    if self._slo_engine is None:
+      return []
+    try:
+      return list(self._slo_engine.report().get('alerting', []))
+    except Exception:  # pylint: disable=broad-except
+      return []
+
+  def decide(self, now: float) -> List[_Proposal]:
+    depth = float(self._queue_depth_fn())
+    replicas = int(self._replica_count_fn())
+    burning = self._alerting()
+    want_up = bool(burning) or depth >= self._up_depth
+    want_down = not burning and depth <= self._down_depth
+    up_edge = self._up.update(want_up)
+    down_edge = self._down.update(want_down)
+    proposals: List[_Proposal] = []
+    if up_edge == 'trip' and replicas < self._max:
+      reason = (f'queue_depth={depth:.0f} slo_alerting={burning or "[]"} '
+                f'replicas={replicas}->{replicas + 1}')
+      proposals.append(_Proposal(
+          'scale_up', f'replicas={replicas + 1}', reason, self._scale_up))
+    elif down_edge == 'trip' and replicas > self._min:
+      reason = (f'queue_depth={depth:.0f} no alerts '
+                f'replicas={replicas}->{replicas - 1}')
+      proposals.append(_Proposal(
+          'scale_down', f'replicas={replicas - 1}', reason,
+          self._scale_down))
+    return proposals
+
+
+class ActorFleetAutoscaler(Actuator):
+  """Keeps the collect fleet sized to the training data appetite.
+
+  Signals, each its own hysteresis latch (reasons carry the signal
+  tokens — ``dead``, ``window_low``, ``torn``, ``staleness`` — so a
+  chaos verdict can match faults to the action that answered them):
+
+  * ``dead`` — live actors below target (a crash-looped actor went
+    DEAD): *replace* it with a fresh incarnation;
+  * ``window_low`` — follow window below ``low_window_records``
+    (starvation risk): grow the fleet;
+  * ``torn`` — torn shards pending in the follow stream: grow (a
+    writer is wedged mid-commit; more writers restore flow);
+  * ``staleness`` — ``max_staleness_steps`` at/over the threshold
+    (actors serving stale policy versions): grow.
+
+  The supervisor handle must expose ``alive_count()``, ``stats()``,
+  ``add_actor(name, argv)`` and ``retire_actor(name=None)``;
+  ``command_factory(seq)`` builds the argv for replacement/growth
+  actor #seq.
+  """
+
+  def __init__(self,
+               supervisor: Any,
+               command_factory: Callable[[int], Tuple[str, List[str]]],
+               target_actors: int,
+               min_actors: int = 1,
+               max_actors: int = 4,
+               low_window_records: Optional[float] = None,
+               staleness_steps: Optional[float] = None,
+               follow_prefix: str = 'data/follow',
+               trip_after: int = 2,
+               clear_after: int = 2,
+               name: str = 'actor_fleet',
+               **kwargs):
+    super().__init__(name, **kwargs)
+    if min_actors < 1 or max_actors < min_actors:
+      raise ValueError('need 1 <= min_actors <= max_actors')
+    self._supervisor = supervisor
+    self._command_factory = command_factory
+    self._target = max(min_actors, min(max_actors, int(target_actors)))
+    self._min = int(min_actors)
+    self._max = int(max_actors)
+    self._low_window = low_window_records
+    self._staleness = staleness_steps
+    self._prefix = follow_prefix.rstrip('/')
+    self._seq = 0
+    self._grow = Hysteresis(trip_after, clear_after)
+    self._shrink = Hysteresis(trip_after, clear_after)
+
+  @property
+  def target(self) -> int:
+    return self._target
+
+  def _gauge(self, snapshot: Dict[str, Any], leaf: str) -> Optional[float]:
+    value = snapshot.get(f'{self._prefix}/{leaf}')
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+      return float(value)
+    return None
+
+  def _next_command(self) -> Tuple[str, List[str]]:
+    self._seq += 1
+    return self._command_factory(self._seq)
+
+  def decide(self, now: float) -> List[_Proposal]:
+    snapshot = metrics_lib.snapshot(self._prefix)
+    window = self._gauge(snapshot, 'window_records')
+    torn = self._gauge(snapshot, 'torn_pending')
+    staleness = self._gauge(snapshot, 'max_staleness_steps')
+    alive = int(self._supervisor.alive_count())
+    proposals: List[_Proposal] = []
+
+    # Replacement is not a size change: a DEAD actor left a hole in the
+    # current target, so it bypasses the grow hysteresis (the
+    # supervisor's own crash budget already debounced the death). The
+    # DEAD-verdict gate matters: an actor merely awaiting its respawn
+    # backoff is the supervisor's job, not ours — replacing it too
+    # would race the respawn and overshoot the fleet.
+    dead = sum(1 for s in self._supervisor.stats().values()
+               if s.get('dead'))
+    if dead > 0 and alive < self._target:
+      name, argv = self._next_command()
+      reason = (f'dead: alive={alive} < target={self._target} '
+                f'dead_slots={dead}')
+      proposals.append(_Proposal(
+          'replace', name, reason,
+          lambda n=name, a=argv: self._supervisor.add_actor(n, a)))
+      return proposals
+
+    signals = []
+    if self._low_window is not None and window is not None:
+      if window < self._low_window:
+        signals.append(f'window_low={window:.0f}<{self._low_window:.0f}')
+    if torn:
+      signals.append(f'torn={torn:.0f}')
+    if self._staleness is not None and staleness is not None:
+      if staleness >= self._staleness:
+        signals.append(f'staleness={staleness:.0f}>={self._staleness:.0f}')
+
+    grow_edge = self._grow.update(bool(signals))
+    quiet = (not signals and window is not None
+             and (self._low_window is None or window >= self._low_window))
+    shrink_edge = self._shrink.update(quiet and alive > self._min)
+
+    if grow_edge == 'trip' and self._target < self._max:
+      name, argv = self._next_command()
+      reason = 'grow: ' + ' '.join(signals)
+      proposals.append(_Proposal(
+          'grow', name, reason,
+          lambda n=name, a=argv: self._apply_grow(n, a)))
+    elif shrink_edge == 'trip' and self._target > self._min:
+      reason = (f'shrink: window={window} no pressure '
+                f'target={self._target}->{self._target - 1}')
+      proposals.append(_Proposal('shrink', 'newest', reason,
+                                 self._apply_shrink))
+    return proposals
+
+  def _apply_grow(self, name: str, argv: List[str]) -> bool:
+    if not self._supervisor.add_actor(name, argv):
+      return False
+    self._target += 1
+    return True
+
+  def _apply_shrink(self) -> bool:
+    retired = self._supervisor.retire_actor()
+    if retired is None:
+      return False
+    self._target -= 1
+    return True
+
+
+class RouterBudgetActuator(Actuator):
+  """Re-splits the router's HBM paging budget from page-in churn.
+
+  Sustained page-in churn means the working set no longer fits the
+  budget — models thrash in and out of HBM; the actuator grows the
+  budget geometrically toward ``max_budget_bytes``. Sustained zero
+  churn with the budget far above residency shrinks it back toward
+  ``resident * shrink_headroom`` (never below ``min_budget_bytes``).
+  The router handle must expose ``hbm_budget``, ``resident_bytes()``
+  and ``set_hbm_budget(nbytes)``.
+  """
+
+  def __init__(self,
+               router: Any,
+               churn_page_ins_per_sec: float = 1.0,
+               grow_factor: float = 1.5,
+               max_budget_bytes: Optional[int] = None,
+               min_budget_bytes: int = 0,
+               shrink_headroom: float = 1.5,
+               page_in_counter: str = 'serving/page_ins',
+               trip_after: int = 2,
+               clear_after: int = 2,
+               name: str = 'router_budget',
+               **kwargs):
+    super().__init__(name, **kwargs)
+    if grow_factor <= 1.0:
+      raise ValueError('grow_factor must be > 1')
+    self._router = router
+    self._churn_rate = float(churn_page_ins_per_sec)
+    self._grow_factor = float(grow_factor)
+    self._max_budget = max_budget_bytes
+    self._min_budget = int(min_budget_bytes)
+    self._shrink_headroom = float(shrink_headroom)
+    self._counter = metrics_lib.counter(page_in_counter)
+    self._grow = Hysteresis(trip_after, clear_after)
+    self._shrink = Hysteresis(trip_after, clear_after)
+    self._last: Optional[Tuple[float, int]] = None  # (time, page_ins)
+
+  def decide(self, now: float) -> List[_Proposal]:
+    page_ins = int(self._counter.value)
+    last = self._last
+    self._last = (now, page_ins)
+    budget = self._router.hbm_budget
+    if last is None or budget is None:
+      return []
+    dt = max(1e-6, now - last[0])
+    churn = max(0, page_ins - last[1]) / dt
+    resident = int(self._router.resident_bytes())
+    proposals: List[_Proposal] = []
+
+    grow_edge = self._grow.update(churn >= self._churn_rate)
+    shrink_target = max(self._min_budget,
+                        int(resident * self._shrink_headroom))
+    shrink_edge = self._shrink.update(
+        churn == 0 and budget > shrink_target)
+
+    if grow_edge == 'trip':
+      new_budget = int(math.ceil(budget * self._grow_factor))
+      if self._max_budget is not None:
+        new_budget = min(self._max_budget, new_budget)
+      if new_budget > budget:
+        reason = (f'page_in_churn={churn:.2f}/s >= {self._churn_rate}/s '
+                  f'budget={budget}->{new_budget}')
+        proposals.append(_Proposal(
+            'grow_budget', f'{new_budget}B', reason,
+            lambda b=new_budget: self._apply_budget(b)))
+    elif shrink_edge == 'trip' and shrink_target < budget:
+      reason = (f'page_in_churn=0 resident={resident}B '
+                f'budget={budget}->{shrink_target}')
+      proposals.append(_Proposal(
+          'shrink_budget', f'{shrink_target}B', reason,
+          lambda b=shrink_target: self._apply_budget(b)))
+    return proposals
+
+  def _apply_budget(self, nbytes: int) -> bool:
+    self._router.set_hbm_budget(nbytes)
+    return True

@@ -32,8 +32,8 @@ Design constraints, in the observability tradition:
 Event shape: ``(time.time(), kind, name, detail)`` where ``kind`` is a
 coarse subsystem tag (``'span' | 'dispatch' | 'checkpoint' | 'swap' |
 'nonfinite' | 'budget' | 'shutdown' | 'liveness' | 'request' |
-'router' | 'balancer' | 'slo' | 'anomaly' | 'collect' | 'error'``),
-``name`` a
+'router' | 'balancer' | 'slo' | 'anomaly' | 'collect' | 'actuator' |
+'chaos' | 'error'``), ``name`` a
 slash-scoped identifier like metric names, and ``detail`` a short
 ``k=v``-style string (machine-greppable: the postmortem renderer parses
 ``dur_ms=`` / ``id=`` tokens out of it). ``'router'`` carries the
@@ -48,7 +48,12 @@ joining the ring to the cross-process ``/tracez`` span index.
 ``'collect'`` carries the actor–learner loop's lifecycle: actor
 spawn/crash/restart/DEAD verdicts (``collect/actor.py`` supervision),
 shard commits and suppressed markers, and follow-mode shard
-ingest/skip decisions (``data/follow.py``).
+ingest/skip decisions (``data/follow.py``). ``'actuator'`` carries
+every closed-loop fleet action — applied, dry-run, budget-denied, or
+refused — with the signals that justified it
+(``observability/actuator.py``), and ``'chaos'`` the chaos harness's
+fault injections/clears (``utils/chaos.py``): a soak's verdict is read
+by joining the two on the same timeline.
 """
 
 from __future__ import annotations

@@ -50,7 +50,8 @@ from tensor2robot_tpu.observability import timeseries
 
 __all__ = [
     'Objective', 'BurnWindow', 'SLOEngine', 'DEFAULT_WINDOWS',
-    'serving_objectives', 'global_engine', 'set_global_engine',
+    'derive_windows', 'serving_objectives', 'global_engine',
+    'set_global_engine',
 ]
 
 
@@ -63,12 +64,54 @@ class BurnWindow(NamedTuple):
   threshold: float
 
 
+# The timeseries cadence the classic pairs below were sized for; the
+# workbook pairs are really SAMPLE-COUNT pairs ((6, 30) and (30, 120)
+# samples), so other cadences scale through :func:`derive_windows`.
+DEFAULT_WINDOW_CADENCE_SECS = 10.0
+
 # The workbook's classic pairs, scaled to the 20-minute default ring
 # (120 slots x 10 s): a 14.4x burn caught in ~1 min, a 6x burn in ~5.
 DEFAULT_WINDOWS: Tuple[BurnWindow, ...] = (
     BurnWindow(60.0, 300.0, 14.4),
     BurnWindow(300.0, 1200.0, 6.0),
 )
+
+
+def derive_windows(interval_secs: float) -> Tuple[BurnWindow, ...]:
+  """The classic burn pairs re-derived for a timeseries cadence.
+
+  PR 12 hardcoded :data:`DEFAULT_WINDOWS` for the 10 s cadence; at any
+  other ``timeseries_interval_secs`` those spans cover the wrong
+  number of ring samples (a 1 s cadence would burn a whole classic
+  fast window in 60 samples of noise; a 60 s cadence would leave it
+  with zero interior samples). Scaling by ``interval / 10`` keeps each
+  window covering the same SAMPLE counts — fast windows of 6 and 30
+  samples, slow windows of 30 and 120 — with the workbook thresholds
+  unchanged (burn rate is cadence-free).
+  """
+  interval = float(interval_secs)
+  if interval <= 0.0:
+    raise ValueError(f'interval_secs must be > 0, got {interval_secs!r}')
+  scale = interval / DEFAULT_WINDOW_CADENCE_SECS
+  return tuple(
+      BurnWindow(w.fast_secs * scale, w.slow_secs * scale, w.threshold)
+      for w in DEFAULT_WINDOWS)
+
+
+def _validate_windows(windows: Sequence[BurnWindow],
+                      interval_secs: float) -> None:
+  """Raises loudly when a window spans fewer than 2 ring samples: such
+  a window can never hold two distinct samples, so its burn rate is
+  permanently 0.0 and the objective silently never alerts."""
+  for window in windows:
+    shortest = min(window.fast_secs, window.slow_secs)
+    if shortest < 2.0 * interval_secs:
+      raise ValueError(
+          f'burn window {window} spans {shortest / interval_secs:.2f} '
+          f'samples at the {interval_secs}s timeseries cadence; every '
+          'window needs >= 2 samples or its burn rate is identically '
+          'zero. Derive windows from the cadence (derive_windows) or '
+          'lengthen them.')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,7 +261,7 @@ class SLOEngine:
 
   def __init__(self,
                objectives: Sequence[Objective],
-               windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+               windows: Optional[Sequence[BurnWindow]] = None,
                recorder: Optional[timeseries.TimeSeriesRecorder] = None,
                postmortem_dir: Optional[str] = None,
                eval_interval_secs: Optional[float] = None,
@@ -229,6 +272,15 @@ class SLOEngine:
     if len(set(names)) != len(names):
       raise ValueError(f'duplicate objective names in {names}')
     self._objectives = tuple(objectives)
+    if windows is None:
+      # Derive from the configured timeseries cadence rather than
+      # assuming 10 s (the carried PR-12 fix). Explicit windows skip
+      # derivation but are still cadence-checked at start() — manual
+      # evaluate() drivers (tests, embedders) keep full freedom.
+      source = recorder or timeseries.global_recorder()
+      windows = derive_windows(
+          source.interval_secs if source is not None
+          else DEFAULT_WINDOW_CADENCE_SECS)
     self._windows = tuple(BurnWindow(*w) for w in windows)
     if not self._windows:
       raise ValueError('SLOEngine needs at least one burn window')
@@ -406,9 +458,13 @@ class SLOEngine:
   def start(self) -> 'SLOEngine':
     if self._thread is not None:
       return self
+    recorder = self._recorder or timeseries.global_recorder()
+    if recorder is not None:
+      # A periodically-driven engine whose windows cannot span 2 ring
+      # samples would silently never alert; refuse to start that way.
+      _validate_windows(self._windows, recorder.interval_secs)
     interval = self._eval_interval
     if interval is None:
-      recorder = self._recorder or timeseries.global_recorder()
       interval = recorder.interval_secs if recorder is not None else 10.0
     self._stop.clear()
 

@@ -54,6 +54,7 @@ flight ring (kind ``'balancer'``).
 
 from __future__ import annotations
 
+import collections
 import http.client
 import http.server
 import itertools
@@ -80,7 +81,7 @@ class _Backend:
 
   __slots__ = ('host', 'port', 'index', 'healthy', 'outstanding',
                'consecutive_failures', 'consecutive_successes',
-               'proxied', 'ejections')
+               'proxied', 'ejections', 'quarantined', 'latency_ms')
 
   def __init__(self, host: str, port: int, index: int):
     self.host = host
@@ -92,6 +93,11 @@ class _Backend:
     self.consecutive_successes = 0  # GUARDED_BY(balancer lock)
     self.proxied = 0  # GUARDED_BY(balancer lock)
     self.ejections = 0  # GUARDED_BY(balancer lock)
+    # Actuator-forced ejection: /healthz success must NOT readmit.
+    self.quarantined = False  # GUARDED_BY(balancer lock)
+    # Rolling proxied-request latencies (status-200 only), the raw
+    # material for fleet-relative anomaly ejection.
+    self.latency_ms = collections.deque(maxlen=64)  # GUARDED_BY(balancer lock)
 
   @property
   def address(self) -> str:
@@ -234,6 +240,7 @@ class Balancer:
     self._m_no_backend = s.counter('no_backend_503')
     self._m_ejections = s.counter('ejections')
     self._m_readmissions = s.counter('readmissions')
+    self._m_eject_refused = s.counter('eject_refusals')
     self._m_healthy = s.gauge('backends_healthy')
 
   # ------------------------------------------------------------- lifecycle
@@ -313,6 +320,105 @@ class Balancer:
     with self._lock:
       return sum(1 for b in self._backends if b.healthy)
 
+  def quarantine(self, index: int, reason: str = '') -> bool:
+    """Actuator-forced ejection of backend ``index``.
+
+    Unlike a health-loop ejection, a quarantined backend is NOT
+    re-admitted by clean ``/healthz`` probes — only :meth:`readmit`
+    releases it (the actuator's probation policy owns that decision).
+    REFUSED (returns False, flight ``balancer/eject_refused``) when the
+    target is the last healthy backend: graceful degradation beats a
+    self-inflicted total outage.
+    """
+    with self._lock:
+      if not 0 <= index < len(self._backends):
+        return False
+      backend = self._backends[index]
+      if backend.quarantined:
+        return False
+      healthy_others = sum(1 for b in self._backends
+                           if b.healthy and b is not backend)
+      refused = backend.healthy and healthy_others == 0
+      if not refused:
+        if backend.healthy:
+          backend.ejections += 1
+        backend.healthy = False
+        backend.quarantined = True
+      healthy = sum(1 for b in self._backends if b.healthy)
+    if refused:
+      self._m_eject_refused.inc()
+      flight.event('balancer', 'balancer/eject_refused',
+                   f'backend={backend.address} last_healthy=1 '
+                   f'reason={reason}')
+      logging.warning('Balancer REFUSED ejecting last healthy backend %s '
+                      '(%s)', backend.address, reason)
+      return False
+    self._m_ejections.inc()
+    self._m_healthy.set(float(healthy))
+    flight.event('balancer', 'balancer/eject',
+                 f'backend={backend.address} forced=1 healthy={healthy} '
+                 f'reason={reason}')
+    logging.warning('Balancer quarantined backend %s (%s)',
+                    backend.address, reason)
+    return True
+
+  def readmit(self, index: int, reason: str = '') -> bool:
+    """Releases a quarantined backend back into the pick set."""
+    with self._lock:
+      if not 0 <= index < len(self._backends):
+        return False
+      backend = self._backends[index]
+      if not backend.quarantined:
+        return False
+      backend.quarantined = False
+      backend.healthy = True
+      backend.consecutive_failures = 0
+      backend.consecutive_successes = 0
+      healthy = sum(1 for b in self._backends if b.healthy)
+    self._m_readmissions.inc()
+    self._m_healthy.set(float(healthy))
+    flight.event('balancer', 'balancer/readmit',
+                 f'backend={backend.address} forced=1 healthy={healthy} '
+                 f'reason={reason}')
+    logging.info('Balancer re-admitted quarantined backend %s (%s)',
+                 backend.address, reason)
+    return True
+
+  def add_backend(self, host: str, port: int) -> int:
+    """Registers (and immediately probes) a new replica; returns its
+    index. The serving autoscaler's scale-up surface."""
+    backend = _Backend(host, int(port), -1)
+    ok = self._probe(backend)
+    with self._lock:
+      backend.index = len(self._backends)
+      backend.healthy = ok
+      backend.consecutive_successes = 1 if ok else 0
+      backend.consecutive_failures = 0 if ok else 1
+      self._backends.append(backend)
+      healthy = sum(1 for b in self._backends if b.healthy)
+    self._m_healthy.set(float(healthy))
+    flight.event('balancer', 'balancer/backend_added',
+                 f'backend={backend.address} healthy={int(ok)}')
+    logging.info('Balancer added backend %s (healthy=%s)',
+                 backend.address, ok)
+    return backend.index
+
+  def backend_latency_snapshot(self) -> List[Dict[str, Any]]:
+    """Per-backend rolling latency cross-section for the fleet-relative
+    ejector: one dict per backend with its mean proxied latency."""
+    with self._lock:
+      return [{
+          'index': b.index,
+          'address': b.address,
+          'healthy': b.healthy,
+          'quarantined': b.quarantined,
+          'probing_ok': b.consecutive_failures == 0,
+          'outstanding': b.outstanding,
+          'count': len(b.latency_ms),
+          'mean_ms': (sum(b.latency_ms) / len(b.latency_ms)
+                      if b.latency_ms else 0.0),
+      } for b in self._backends]
+
   def _pick(self, tried: set) -> Optional[_Backend]:
     """Healthy, untried backend with the fewest outstanding requests."""
     with self._lock:
@@ -339,7 +445,9 @@ class Balancer:
       if ok:
         backend.consecutive_failures = 0
         backend.consecutive_successes += 1
-        transition = (not backend.healthy and
+        # A quarantined backend stays out however clean its probes:
+        # only an explicit readmit() (actuator probation) releases it.
+        transition = (not backend.healthy and not backend.quarantined and
                       backend.consecutive_successes >= self._readmit_after)
         if transition:
           backend.healthy = True
@@ -460,6 +568,7 @@ class Balancer:
         attempt_headers[tracing.TRACEPARENT_HEADER] = (
             tracing.format_traceparent(
                 tracing.TraceContext(trace.trace_id, attempt_span)))
+      proxy_t0 = time.monotonic()
       try:
         try:
           status, payload, retry_after = self._proxy_once(
@@ -467,6 +576,12 @@ class Balancer:
           self._note_attempt_span(trace, proxy_span, attempt_span,
                                   attempt_start, backend,
                                   f'status={status}', request_id)
+          if status == 200:
+            # Completed-request latency only: sheds are fast by design
+            # and would dilute the fleet-relative anomaly signal.
+            elapsed_ms = (time.monotonic() - proxy_t0) * 1000.0
+            with self._lock:
+              backend.latency_ms.append(elapsed_ms)
         except _TRANSPORT_ERRORS as e:
           self._note_attempt_span(trace, proxy_span, attempt_span,
                                   attempt_start, backend,
@@ -500,7 +615,9 @@ class Balancer:
 
   def _health_loop(self) -> None:
     while not self._health_stop.wait(self._health_interval):
-      for backend in self._backends:
+      with self._lock:
+        backends = list(self._backends)  # add_backend() may append
+      for backend in backends:
         ok = self._probe(backend)
         self._note_health(backend, ok=ok)
 
@@ -572,10 +689,13 @@ class Balancer:
       backends = [{
           'address': b.address,
           'healthy': b.healthy,
+          'quarantined': b.quarantined,
           'outstanding': b.outstanding,
           'proxied': b.proxied,
           'ejections': b.ejections,
           'consecutive_failures': b.consecutive_failures,
+          'latency_ms_mean': (sum(b.latency_ms) / len(b.latency_ms)
+                              if b.latency_ms else 0.0),
       } for b in self._backends]
     return {
         'backends': backends,
@@ -588,6 +708,7 @@ class Balancer:
         'no_backend_503': snap.get('balancer/no_backend_503', 0),
         'ejections': snap.get('balancer/ejections', 0),
         'readmissions': snap.get('balancer/readmissions', 0),
+        'eject_refusals': snap.get('balancer/eject_refusals', 0),
         'eject_after': self._eject_after,
         'readmit_after': self._readmit_after,
         'health_interval_secs': self._health_interval,

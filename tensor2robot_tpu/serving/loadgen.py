@@ -52,7 +52,15 @@ class ShedError(RuntimeError):
 
   Open-loop runs count sheds separately from errors — a shed is the
   admission controller WORKING, not the plane failing.
+  ``retry_after_secs`` carries the plane's advertised ``Retry-After``
+  (None when the 503 carried no hint); cooperative best-effort clients
+  resubmit after that delay instead of treating the shed as terminal.
   """
+
+  def __init__(self, message: str = '',
+               retry_after_secs: Optional[float] = None):
+    super().__init__(message)
+    self.retry_after_secs = retry_after_secs
 
 
 class Reservoir:
@@ -186,7 +194,9 @@ def router_submit_fn(router, model_fn: Optional[Callable[[int], str]] = None,
       return router.submit(features, model=model,
                            priority=priority).result(timeout=timeout)
     except batching_lib.OverloadedError as e:
-      raise ShedError(str(e)) from e
+      raise ShedError(
+          str(e),
+          retry_after_secs=getattr(e, 'retry_after_secs', None)) from e
 
   return submit
 
@@ -256,7 +266,13 @@ def http_open_submit_fn(host: str, port: int,
       local.conn = None  # drop the broken keep-alive connection
       raise
     if response.status == 503:
-      raise ShedError(str(payload.get('error', payload)))
+      retry_after = response.getheader('Retry-After')
+      try:
+        retry_after = float(retry_after) if retry_after else None
+      except (TypeError, ValueError):
+        retry_after = None
+      raise ShedError(str(payload.get('error', payload)),
+                      retry_after_secs=retry_after)
     if response.status != 200:
       raise RuntimeError(
           f'HTTP {response.status}: {payload.get("error", payload)}')
@@ -414,6 +430,7 @@ class OpenLoopReport(NamedTuple):
   ok: int
   shed: int
   errors: int
+  resubmitted: int
   latency_ms_p50: float
   latency_ms_p99: float
   latency_ms_mean: float
@@ -429,6 +446,7 @@ class OpenLoopReport(NamedTuple):
         'ok': self.ok,
         'shed': self.shed,
         'errors': self.errors,
+        'resubmitted': self.resubmitted,
         'latency_ms_p50': round(self.latency_ms_p50, 2),
         'latency_ms_p99': round(self.latency_ms_p99, 2),
         'latency_ms_mean': round(self.latency_ms_mean, 2),
@@ -449,7 +467,9 @@ def run_open_loop(submit: Callable,
                   burst_duty: float = 0.2,
                   rate_trace: Optional[Sequence[float]] = None,
                   reservoir_size: int = DEFAULT_RESERVOIR_SIZE,
-                  warmup_requests: int = 1) -> OpenLoopReport:
+                  warmup_requests: int = 1,
+                  honor_retry_after: bool = True,
+                  max_resubmits: int = 3) -> OpenLoopReport:
   """Open-loop Poisson load: ``submit(index, features, priority)``.
 
   Arrivals are scheduled ahead of time from the seeded Poisson process;
@@ -460,6 +480,16 @@ def run_open_loop(submit: Callable,
   error. ``best_effort_fraction`` of arrivals carry the
   ``'best_effort'`` class, the rest ``'interactive'`` — per-class
   outcome counts and percentiles ride the report.
+
+  ``honor_retry_after`` makes best-effort arrivals cooperative: a shed
+  carrying the plane's advertised ``Retry-After`` delay resubmits after
+  that delay (up to ``max_resubmits`` times, never past the end of the
+  run) instead of counting a terminal shed. Resubmissions are counted
+  separately (``resubmitted``) and an eventually-accepted request's
+  latency still runs from its ORIGINAL scheduled arrival — the retry
+  wait lands in the percentiles, not under the rug. Interactive
+  arrivals never resubmit (a shed interactive request is itself a bug
+  worth counting loudly).
   """
   if not 0.0 <= best_effort_fraction <= 1.0:
     raise ValueError(f'best_effort_fraction must be in [0, 1], got '
@@ -477,7 +507,8 @@ def run_open_loop(submit: Callable,
   per_class = {name: Reservoir(reservoir_size, seed=seed + 2)
                for name in class_names}
   counts_lock = threading.Lock()
-  counts = {name: {'arrivals': 0, 'ok': 0, 'shed': 0, 'errors': 0}
+  counts = {name: {'arrivals': 0, 'ok': 0, 'shed': 0, 'errors': 0,
+                   'resubmitted': 0}
             for name in class_names}  # GUARDED_BY(counts_lock)
   next_index = itertools.count()
 
@@ -499,13 +530,26 @@ def run_open_loop(submit: Callable,
       if now < scheduled:
         time.sleep(scheduled - now)
       priority = priorities[i]
-      outcome = 'ok'
-      try:
-        submit(i, features_fn(i), priority)
-      except ShedError:
-        outcome = 'shed'
-      except Exception:  # pylint: disable=broad-except
-        outcome = 'errors'
+      features = features_fn(i)
+      resubmits = 0
+      while True:
+        outcome = 'ok'
+        try:
+          submit(i, features, priority)
+        except ShedError as e:
+          outcome = 'shed'
+          delay = getattr(e, 'retry_after_secs', None)
+          if (honor_retry_after and priority == 'best_effort'
+              and delay is not None and resubmits < max_resubmits
+              and (time.monotonic() - t0) + delay < duration_secs):
+            # Cooperative client: reschedule after the advertised
+            # delay instead of a terminal shed.
+            resubmits += 1
+            time.sleep(delay)
+            continue
+        except Exception:  # pylint: disable=broad-except
+          outcome = 'errors'
+        break
       latency_ms = 1e3 * (time.monotonic() - scheduled)
       if outcome == 'ok':
         overall.add(latency_ms)
@@ -513,6 +557,7 @@ def run_open_loop(submit: Callable,
       with counts_lock:
         counts[priority]['arrivals'] += 1
         counts[priority][outcome] += 1
+        counts[priority]['resubmitted'] += resubmits
 
   threads = [threading.Thread(target=worker, daemon=True)
              for _ in range(max(1, int(workers)))]
@@ -525,7 +570,7 @@ def run_open_loop(submit: Callable,
   stats = overall.summary()
   with counts_lock:
     totals = {k: sum(c[k] for c in counts.values())
-              for k in ('ok', 'shed', 'errors')}
+              for k in ('ok', 'shed', 'errors', 'resubmitted')}
     classes = {}
     for name in class_names:
       cstats = per_class[name].summary()
@@ -542,6 +587,7 @@ def run_open_loop(submit: Callable,
       ok=totals['ok'],
       shed=totals['shed'],
       errors=totals['errors'],
+      resubmitted=totals['resubmitted'],
       latency_ms_p50=stats['p50'],
       latency_ms_p99=stats['p99'],
       latency_ms_mean=stats['mean'],

@@ -342,6 +342,37 @@ class ModelRouter:
     with self._lock:
       return sorted(e.name for e, _, _ in self._residency_locked())
 
+  def resident_bytes(self) -> int:
+    with self._lock:
+      return sum(b for _, _, b in self._residency_locked())
+
+  @property
+  def hbm_budget(self) -> Optional[int]:
+    with self._lock:
+      return self._hbm_budget
+
+  def set_hbm_budget(self, nbytes: Optional[int]) -> None:
+    """Re-splits the paging budget at runtime (the actuator surface).
+
+    ``None`` disables paging. A shrink is enforced immediately (LRU
+    page-outs down to the new budget); a grow takes effect lazily as
+    requests page models back in. The re-split lands in the flight ring
+    (kind ``'router'``) so postmortems show budget moves on the request
+    timeline.
+    """
+    nbytes = None if nbytes is None else int(nbytes)
+    with self._lock:
+      old = self._hbm_budget
+      if nbytes == old:
+        return
+      self._hbm_budget = nbytes
+      self._enforce_budget_locked(keep=None)
+      self._publish_residency_locked()
+    self._m_budget.set(float(nbytes or 0))
+    flight.event('router', f'{self._metrics_prefix}/router/budget_resplit',
+                 f'old={old} new={nbytes}')
+    logging.info('Router HBM budget re-split: %s -> %s bytes', old, nbytes)
+
   # ------------------------------------------------------------- reporting
 
   def report(self) -> Dict[str, Any]:

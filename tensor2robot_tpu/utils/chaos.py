@@ -1,0 +1,394 @@
+"""Declarative, seeded chaos schedules for the closed fleet-ops loop.
+
+The proof half of the actuator layer (``observability/actuator.py``):
+a :class:`ChaosSchedule` declares WHEN each fault lands — process
+kills, torn shards, stale exports, injected latency, replica wedges —
+and the :class:`ChaosRunner` fires them against a live
+collect→train→export→serve loop, recording every injection in the
+flight ring (kind ``'chaos'``). Afterwards :func:`verdict_report`
+joins the two sides of the timeline: every injected fault is matched
+to the automatic actuator action(s) that answered it (flight kind
+``'actuator'``), and every SLO burn alert to the postmortem bundle it
+escalated into. A soak PASSES only when the machinery — not an
+operator — closed every loop.
+
+Fault kinds and how they are injected:
+
+* ``wedge_replica`` — a serving replica answers slowly but
+  successfully (the failure mode ``/healthz`` cannot see). Injected at
+  runtime by arming a :class:`LatencyWedge` around the replica's
+  predictor; cleared after ``duration_secs``. Expected recovery:
+  fleet-relative ejection, then probation re-admission.
+* ``kill_actor`` — an actor process dies mid-commit, every
+  incarnation (the crash-loop shape). Armed at spawn through the
+  actor's own fault hooks (``utils/faults.py`` ``kill_before_commit``)
+  so the death is genuinely mid-commit, not a polite shutdown.
+  Expected recovery: supervisor DEAD verdict → actor-fleet *replace*.
+* ``torn_shard`` — a shard's payload lands without its commit marker.
+  Armed at spawn (``torn_shard:<n>``). Expected recovery: actor-fleet
+  grow on the ``torn`` signal (follow mode already refuses to read the
+  torn payload).
+* ``stale_export`` — an actor stops reloading new policy exports
+  (``hold_export:<n>``), so its episodes carry stale versions.
+  Expected recovery: actor-fleet grow on the ``staleness`` signal.
+
+The schedule is data (``k=v`` spec strings or :meth:`seeded`), the
+injectors are callables, and nothing here imports the planes it
+torments — the harness (``tools/run_chaos_soak.py``) wires both.
+
+Pure stdlib + observability imports, so the schedule/verdict halves
+load anywhere the flight ring does.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from tensor2robot_tpu.observability import flight
+
+__all__ = [
+    'ChaosFault', 'ChaosSchedule', 'ChaosRunner', 'LatencyWedge',
+    'verdict_report', 'ACTOR_FAULT_KINDS',
+]
+
+# Fault kinds armed through ActorConfig.faults at spawn time (the actor
+# process applies them via utils/faults.py); the runner only records
+# their scheduled injection instant for the verdict timeline.
+ACTOR_FAULT_KINDS = ('kill_actor', 'torn_shard', 'stale_export')
+
+# What automatic recovery looks like per fault kind: an applied
+# actuator action whose verb matches AND (when tokens are given) whose
+# detail names one of the signal tokens. The actor-fleet actuator's
+# reasons deliberately carry these tokens (see ActorFleetAutoscaler).
+_RECOVERY_SIGNATURES: Dict[str, Any] = {
+    'wedge_replica': (('eject', 'readmit'), ()),
+    'kill_actor': (('replace',), ('dead',)),
+    'torn_shard': (('grow', 'replace'), ('torn', 'dead')),
+    'stale_export': (('grow', 'replace'), ('staleness', 'window_low')),
+}
+
+
+class ChaosFault(NamedTuple):
+  """One scheduled fault injection."""
+
+  at_secs: float          # offset from schedule start
+  kind: str               # one of the fault kinds above
+  target: str             # replica index / actor index, kind-specific
+  arg: str = ''           # kind-specific (wedge delay, shard index…)
+  duration_secs: float = 0.0  # 0: no scheduled clear
+
+  def spec(self) -> str:
+    return (f'at={self.at_secs} kind={self.kind} target={self.target}'
+            + (f' arg={self.arg}' if self.arg else '')
+            + (f' duration={self.duration_secs}'
+               if self.duration_secs else ''))
+
+
+class ChaosSchedule:
+  """An ordered set of :class:`ChaosFault`\\ s, buildable three ways:
+  directly, from ``k=v`` spec strings, or seeded-random for soaks."""
+
+  def __init__(self, faults: Sequence[ChaosFault]):
+    self.faults = tuple(sorted(faults, key=lambda f: f.at_secs))
+
+  def __len__(self) -> int:
+    return len(self.faults)
+
+  def __iter__(self):
+    return iter(self.faults)
+
+  @classmethod
+  def from_specs(cls, specs: Sequence[str]) -> 'ChaosSchedule':
+    """Parses ``'at=2.0 kind=wedge_replica target=1 arg=0.35
+    duration=6'``-style strings (whitespace-separated ``k=v``)."""
+    faults = []
+    for spec in specs:
+      fields: Dict[str, str] = {}
+      for token in spec.split():
+        key, sep, value = token.partition('=')
+        if not sep:
+          raise ValueError(f'chaos spec token {token!r} is not k=v '
+                           f'(in {spec!r})')
+        fields[key] = value
+      try:
+        faults.append(ChaosFault(
+            at_secs=float(fields['at']),
+            kind=fields['kind'],
+            target=fields.get('target', ''),
+            arg=fields.get('arg', ''),
+            duration_secs=float(fields.get('duration', 0.0))))
+      except KeyError as e:
+        raise ValueError(f'chaos spec {spec!r} missing {e}') from None
+    return cls(faults)
+
+  @classmethod
+  def seeded(cls, seed: int, duration_secs: float,
+             replicas: int = 2, actors: int = 2,
+             faults_per_kind: int = 1,
+             wedge_delay_secs: float = 0.35,
+             wedge_duration_secs: float = 6.0) -> 'ChaosSchedule':
+    """A reproducible random schedule covering every fault kind at
+    least ``faults_per_kind`` times inside ``duration_secs``."""
+    rng = random.Random(seed)
+    faults: List[ChaosFault] = []
+    window = max(1.0, duration_secs * 0.6)  # leave tail room to recover
+    for _ in range(faults_per_kind):
+      faults.append(ChaosFault(
+          rng.uniform(0.1 * window, window), 'wedge_replica',
+          str(rng.randrange(replicas)), f'{wedge_delay_secs}',
+          wedge_duration_secs))
+      faults.append(ChaosFault(
+          rng.uniform(0.0, window), 'kill_actor',
+          str(rng.randrange(actors)), '1'))
+      faults.append(ChaosFault(
+          rng.uniform(0.0, window), 'torn_shard',
+          str(rng.randrange(actors)), '1'))
+      faults.append(ChaosFault(
+          rng.uniform(0.0, window), 'stale_export',
+          str(rng.randrange(actors)), str(rng.randrange(4, 16))))
+    return cls(faults)
+
+  def actor_fault_specs(self) -> Dict[int, List[str]]:
+    """Translates the actor-armed kinds into ``ActorConfig.faults``
+    spec strings (``utils/faults.py`` grammar), keyed by actor index.
+
+    Distinct targets keep distinct failure modes: the harness hands
+    each actor its own arming list at spawn, and the runner's timeline
+    entry for these kinds is the arming record.
+    """
+    specs: Dict[int, List[str]] = {}
+    grammar = {
+        'kill_actor': 'kill_before_commit:{arg}',
+        'torn_shard': 'torn_shard:{arg}',
+        'stale_export': 'hold_export:{arg}',
+    }
+    for fault in self.faults:
+      if fault.kind not in grammar:
+        continue
+      try:
+        index = int(fault.target)
+      except ValueError:
+        raise ValueError(f'{fault.kind} target {fault.target!r} must be '
+                         'an actor index') from None
+      specs.setdefault(index, []).append(
+          grammar[fault.kind].format(arg=fault.arg or '1'))
+    return specs
+
+
+class LatencyWedge:
+  """Predictor wrapper: ``arm(delay)`` makes every predict slow-but-
+  successful — the wedged-replica failure mode health checks miss.
+
+  Everything except ``predict`` delegates to the wrapped predictor, so
+  a wedged replica still reloads, reports versions, etc.
+  """
+
+  def __init__(self, predictor: Any):
+    self._predictor = predictor
+    self._delay_secs = 0.0
+
+  def arm(self, delay_secs: float) -> None:
+    self._delay_secs = float(delay_secs)
+
+  def disarm(self) -> None:
+    self._delay_secs = 0.0
+
+  @property
+  def armed(self) -> bool:
+    return self._delay_secs > 0.0
+
+  def predict(self, features):
+    delay = self._delay_secs
+    if delay > 0.0:
+      time.sleep(delay)
+    return self._predictor.predict(features)
+
+  def stateless_serving_fn(self):
+    # The batcher prefers a stateless jax core when the predictor
+    # offers one — and a jitted executor would call the core directly,
+    # bypassing :meth:`predict` and with it the armed delay. Refusing
+    # here forces the per-batch callable dispatch path, which the wedge
+    # CAN intercept at runtime.
+    raise NotImplementedError(
+        'LatencyWedge forces the predict() dispatch path so an armed '
+        'delay is honored')
+
+  def __getattr__(self, item):
+    return getattr(self._predictor, item)
+
+
+class ChaosRunner:
+  """Fires a schedule's faults at their offsets on a daemon thread.
+
+  ``injectors`` maps fault kind → ``callable(fault)``; kinds without an
+  injector (the spawn-armed actor kinds) still get their timeline entry
+  — the flight event IS the record the verdict joins on. ``clearers``
+  maps kind → ``callable(fault)`` run ``duration_secs`` after
+  injection (e.g. disarming a wedge).
+  """
+
+  def __init__(self,
+               schedule: ChaosSchedule,
+               injectors: Optional[Dict[str, Callable]] = None,
+               clearers: Optional[Dict[str, Callable]] = None):
+    self._schedule = schedule
+    self._injectors = dict(injectors or {})
+    self._clearers = dict(clearers or {})
+    self._lock = threading.Lock()
+    self._injected: List[Dict[str, Any]] = []  # GUARDED_BY(self._lock)
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self._t0_wall: Optional[float] = None
+
+  @property
+  def t0_wall(self) -> Optional[float]:
+    return self._t0_wall
+
+  def start(self) -> 'ChaosRunner':
+    if self._thread is not None:
+      return self
+    self._t0_wall = time.time()
+    self._stop.clear()
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name='t2r-chaos')
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10.0)
+      self._thread = None
+
+  def join(self, timeout_secs: Optional[float] = None) -> bool:
+    """Waits for the whole schedule (injections AND clears) to fire."""
+    if self._thread is None:
+      return True
+    self._thread.join(timeout=timeout_secs)
+    return not self._thread.is_alive()
+
+  def injected(self) -> List[Dict[str, Any]]:
+    with self._lock:
+      return list(self._injected)
+
+  def _run(self) -> None:
+    t0 = time.monotonic()
+    work: List = []  # (offset, phase, fault); phase orders inject<clear
+    for fault in self._schedule:
+      work.append((fault.at_secs, 0, fault))
+      if fault.duration_secs > 0 and fault.kind in self._clearers:
+        work.append((fault.at_secs + fault.duration_secs, 1, fault))
+    work.sort(key=lambda item: (item[0], item[1]))
+    for offset, phase, fault in work:
+      delay = offset - (time.monotonic() - t0)
+      if delay > 0 and self._stop.wait(delay):
+        return
+      if self._stop.is_set():
+        return
+      if phase == 0:
+        self._fire(fault, 'inject',
+                   self._injectors.get(fault.kind))
+      else:
+        self._fire(fault, 'clear', self._clearers.get(fault.kind))
+
+  def _fire(self, fault: ChaosFault, phase: str,
+            hook: Optional[Callable]) -> None:
+    detail = (f'target={fault.target} arg={fault.arg} '
+              f'duration={fault.duration_secs} at={fault.at_secs}')
+    flight.event('chaos', f'chaos/{fault.kind}/{phase}', detail)
+    logging.warning('CHAOS %s: %s (%s)', phase, fault.kind, detail)
+    if phase == 'inject':
+      with self._lock:
+        self._injected.append({'time': time.time(),
+                               **fault._asdict()})
+    if hook is None:
+      return
+    try:
+      hook(fault)
+    except Exception:  # pylint: disable=broad-except
+      logging.exception('chaos %s hook for %s failed', phase, fault.kind)
+      flight.event('chaos', f'chaos/{fault.kind}/hook_error',
+                   f'phase={phase} ' + detail)
+
+
+def _event_verb(name: str) -> str:
+  return name.rsplit('/', 1)[-1]
+
+
+def verdict_report(schedule: ChaosSchedule,
+                   t0_wall: float,
+                   postmortem_dir: Optional[str] = None,
+                   grace_secs: float = 0.5) -> Dict[str, Any]:
+  """Joins injections to recoveries; the soak's pass/fail document.
+
+  For every scheduled fault: the applied actuator actions (flight kind
+  ``'actuator'``) recorded at/after its injection instant whose verb
+  and signal tokens match the fault's recovery signature. For every
+  SLO burn alert (flight kind ``'slo'``): the live postmortem bundle
+  it escalated into under ``postmortem_dir``. ``verdict`` is ``PASS``
+  iff every fault found at least one recovery action and every breach
+  its bundle.
+  """
+  actuator_events = flight.events(kinds=['actuator'])
+  fault_docs = []
+  for fault in schedule:
+    injected_at = t0_wall + fault.at_secs
+    verbs, tokens = _RECOVERY_SIGNATURES.get(fault.kind, ((), ()))
+    matches = []
+    for event in actuator_events:
+      if event['time'] < injected_at - grace_secs:
+        continue
+      if 'outcome=applied' not in event.get('detail', ''):
+        continue
+      if verbs and _event_verb(event['name']) not in verbs:
+        continue
+      if tokens and not any(t in event.get('detail', '') for t in tokens):
+        continue
+      matches.append({'time': event['time'], 'name': event['name'],
+                      'detail': event.get('detail', '')})
+    fault_docs.append({
+        'fault': fault._asdict(),
+        'injected_at': injected_at,
+        'recovered': bool(matches),
+        'recovery_actions': matches,
+    })
+
+  breach_docs = []
+  if postmortem_dir is not None:
+    from tensor2robot_tpu.observability import postmortem
+
+    bundle_dir = os.path.join(postmortem_dir,
+                              postmortem.POSTMORTEM_DIRNAME)
+    bundles = sorted(glob.glob(os.path.join(bundle_dir, '*.json')))
+    for event in flight.events(kinds=['slo']):
+      if '/burn_alert' not in event['name']:
+        continue
+      # slo/<name>/burn_alert escalates to a slo_burn_<name> bundle.
+      objective = event['name'].split('/')[1]
+      matched = [b for b in bundles if f'slo_burn_{objective}' in b]
+      breach_docs.append({
+          'time': event['time'],
+          'objective': objective,
+          'detail': event.get('detail', ''),
+          'postmortem_bundles': matched,
+          'bundled': bool(matched),
+      })
+
+  verdict = ('PASS' if all(d['recovered'] for d in fault_docs)
+             and all(d['bundled'] for d in breach_docs) else 'FAIL')
+  return {
+      'verdict': verdict,
+      'faults': fault_docs,
+      'faults_recovered': sum(1 for d in fault_docs if d['recovered']),
+      'faults_total': len(fault_docs),
+      'slo_breaches': breach_docs,
+      'actuator_actions_total': sum(
+          1 for e in actuator_events
+          if 'outcome=applied' in e.get('detail', '')),
+  }

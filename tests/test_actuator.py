@@ -1,0 +1,737 @@
+"""Actuator-layer safety pins: the guarantees an unattended controller
+must keep before it is allowed anywhere near a fleet.
+
+The acceptance-pinned behaviors, each drilled directly:
+
+* **deadband** — steady signals produce ZERO actions and zero flight
+  events; doing nothing must cost nothing;
+* **budget** — a pathologically breaching signal is capped at
+  ``max_actions_per_window`` applied actions per window; the excess is
+  recorded (``budget_denied``) but never applied;
+* **dry_run** — decisions are recorded exactly as if applied, but no
+  control surface is touched;
+* **last-healthy refusal** — ejecting the only healthy replica is
+  refused at BOTH layers: the ejector's ``min_healthy`` pre-check and
+  the real balancer's own quarantine guard.
+
+Plus per-actuator policy units (fleet-relative ejection + probation,
+serving/actor autoscaling, router budget re-split) against duck-typed
+fakes, and the engine's drive-inputs/history/report plumbing.
+
+Marker: ``obs`` (tier-1; ``tools/run_tier1.sh -m obs`` selects).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.observability import actuator as actuator_lib
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import postmortem as postmortem_lib
+from tensor2robot_tpu.observability import slo as slo_lib
+from tensor2robot_tpu.observability import timeseries
+from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.predictors import AbstractPredictor
+from tensor2robot_tpu.serving import balancer as balancer_lib
+from tensor2robot_tpu.serving import server as server_lib
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+  flight.recorder().clear()
+  flight.set_enabled(True)
+  tracing.span_index().clear()
+  postmortem_lib._reset_rate_limit_for_tests()
+  slo_lib.set_global_engine(None)
+  yield
+  slo_lib.set_global_engine(None)
+  timeseries.stop_global()
+
+
+def _actuator_events():
+  return flight.events(kinds=['actuator'])
+
+
+class _EchoPredictor(AbstractPredictor):
+  """Pure-stdlib predictor: enough for a real batcher + health probes."""
+
+  def predict(self, features):
+    return {'echo': np.asarray(features['measured_position'])}
+
+  def get_feature_specification(self):
+    spec = SpecStruct()
+    spec['measured_position'] = TensorSpec(shape=(2,), dtype=np.float32,
+                                           name='measured_position')
+    return spec
+
+  def restore(self):
+    return True
+
+  @property
+  def is_loaded(self):
+    return True
+
+  @property
+  def global_step(self):
+    return 1
+
+
+def _free_port() -> int:
+  with socket.socket() as sock:
+    sock.bind(('127.0.0.1', 0))
+    return sock.getsockname()[1]
+
+
+class _AlwaysActuator(actuator_lib.Actuator):
+  """Proposes one action every poll: the budget/dry-run drill vehicle."""
+
+  def __init__(self, apply_result=True, **kwargs):
+    super().__init__('always', **kwargs)
+    self.applied_calls = 0
+    self._apply_result = apply_result
+
+  def decide(self, now):
+    def apply():
+      self.applied_calls += 1
+      if isinstance(self._apply_result, Exception):
+        raise self._apply_result
+      return self._apply_result
+    return [actuator_lib._Proposal('tune', 'knob', 'sig=1', apply)]
+
+
+# ------------------------------------------------------------- hysteresis
+
+
+class TestHysteresis:
+
+  def test_trips_only_after_consecutive_breaches(self):
+    latch = actuator_lib.Hysteresis(trip_after=3, clear_after=2)
+    assert latch.update(True) is None
+    assert latch.update(True) is None
+    assert latch.update(True) == 'trip'
+    assert latch.tripped
+
+  def test_single_blip_never_trips(self):
+    latch = actuator_lib.Hysteresis(trip_after=2, clear_after=2)
+    for _ in range(10):
+      assert latch.update(True) is None
+      assert latch.update(False) is None
+    assert not latch.tripped
+
+  def test_retrips_while_breach_sustained(self):
+    latch = actuator_lib.Hysteresis(trip_after=2, clear_after=2)
+    edges = [latch.update(True) for _ in range(6)]
+    assert edges == [None, 'trip', None, 'trip', None, 'trip']
+
+  def test_clears_after_consecutive_recoveries(self):
+    latch = actuator_lib.Hysteresis(trip_after=1, clear_after=3)
+    assert latch.update(True) == 'trip'
+    assert latch.update(False) is None
+    assert latch.update(False) is None
+    assert latch.update(False) == 'clear'
+    assert not latch.tripped
+
+  def test_rejects_degenerate_thresholds(self):
+    with pytest.raises(ValueError):
+      actuator_lib.Hysteresis(trip_after=0)
+    with pytest.raises(ValueError):
+      actuator_lib.Hysteresis(clear_after=0)
+
+
+# ------------------------------------------------------- base safety rails
+
+
+class TestActuatorSafety:
+
+  def test_budget_caps_flapping(self):
+    act = _AlwaysActuator(max_actions_per_window=2,
+                          budget_window_secs=60.0)
+    outcomes = [act.poll(now=float(i))[0].outcome for i in range(5)]
+    assert outcomes == ['applied', 'applied', 'budget_denied',
+                        'budget_denied', 'budget_denied']
+    assert act.applied_calls == 2
+    report = act.report()
+    assert report['actions_total'] == 2
+    assert report['budget_denied_total'] == 3
+    # Denials are still evidence: every one landed in the flight ring.
+    denied = [e for e in _actuator_events()
+              if 'outcome=budget_denied' in e['detail']]
+    assert len(denied) == 3
+    # The window is a sliding deque, not a permanent latch: once the
+    # old actions age out, the budget readmits.
+    assert act.poll(now=100.0)[0].outcome == 'applied'
+
+  def test_dry_run_changes_nothing_but_the_log(self):
+    act = _AlwaysActuator(dry_run=True)
+    actions = act.poll(now=0.0)
+    assert [a.outcome for a in actions] == ['dry_run']
+    assert not actions[0].applied
+    # The control surface was never touched...
+    assert act.applied_calls == 0
+    # ...but the decision is fully recorded, flagged as dry-run.
+    events = _actuator_events()
+    assert len(events) == 1
+    assert 'outcome=dry_run' in events[0]['detail']
+    assert 'dry_run=1' in events[0]['detail']
+
+  def test_dry_run_still_charges_the_budget(self):
+    # A dry-run soak must report the SAME budget denials the live
+    # policy would have hit, or the soak proves nothing about flap.
+    act = _AlwaysActuator(dry_run=True, max_actions_per_window=1,
+                          budget_window_secs=60.0)
+    assert act.poll(now=0.0)[0].outcome == 'dry_run'
+    assert act.poll(now=1.0)[0].outcome == 'budget_denied'
+
+  def test_surface_refusal_is_recorded_not_raised(self):
+    act = _AlwaysActuator(apply_result=False)
+    actions = act.poll(now=0.0)
+    assert [a.outcome for a in actions] == ['refused']
+    assert not actions[0].applied
+
+  def test_apply_exception_degrades_to_error_outcome(self):
+    act = _AlwaysActuator(apply_result=RuntimeError('surface exploded'))
+    actions = act.poll(now=0.0)
+    assert [a.outcome for a in actions] == ['error']
+
+  def test_decide_exception_is_non_fatal(self):
+    class Broken(actuator_lib.Actuator):
+      def decide(self, now):
+        raise RuntimeError('bad signal plane')
+
+    assert Broken('broken').poll(now=0.0) == []
+
+  def test_rejects_whitespace_names(self):
+    with pytest.raises(ValueError):
+      actuator_lib.Actuator('bad name')
+    with pytest.raises(ValueError):
+      actuator_lib.Actuator('')
+
+
+# ------------------------------------------------------ fleet ejector
+
+
+class _FakeBalancer:
+  """Snapshot-backed balancer double recording quarantine/readmit."""
+
+  def __init__(self, mean_ms, counts=None, healthy=None):
+    self.snapshot = []
+    for i, mean in enumerate(mean_ms):
+      self.snapshot.append({
+          'index': i,
+          'address': f'127.0.0.1:{9000 + i}',
+          'healthy': True if healthy is None else healthy[i],
+          'quarantined': False,
+          'probing_ok': True,
+          'outstanding': 0,
+          'count': 20 if counts is None else counts[i],
+          'mean_ms': float(mean),
+      })
+    self.quarantines = []
+    self.readmissions = []
+
+  def backend_latency_snapshot(self):
+    return [dict(b) for b in self.snapshot]
+
+  def quarantine(self, index, reason=''):
+    self.quarantines.append((index, reason))
+    self.snapshot[index]['quarantined'] = True
+    self.snapshot[index]['healthy'] = False
+    return True
+
+  def readmit(self, index, reason=''):
+    self.readmissions.append((index, reason))
+    self.snapshot[index]['quarantined'] = False
+    self.snapshot[index]['healthy'] = True
+    return True
+
+
+class TestFleetLatencyEjector:
+
+  def _ejector(self, fake, **kwargs):
+    defaults = dict(k=4.0, rel_floor=1.0, abs_floor_ms=50.0,
+                    min_samples=8, min_healthy=1, probation_secs=3.0,
+                    trip_after=2, clear_after=2,
+                    max_actions_per_window=8)
+    defaults.update(kwargs)
+    return actuator_lib.FleetLatencyEjector(fake, **defaults)
+
+  def test_ejects_fleet_relative_outlier_after_hysteresis(self):
+    fake = _FakeBalancer([10.0, 11.0, 400.0])
+    ejector = self._ejector(fake)
+    # First breach arms the latch; no action yet (flap protection).
+    assert ejector.poll(now=0.0) == []
+    actions = ejector.poll(now=1.0)
+    assert [a.verb for a in actions] == ['eject']
+    assert actions[0].outcome == 'applied'
+    assert fake.quarantines and fake.quarantines[0][0] == 2
+    # The reason names the fleet cross-section that justified it.
+    assert 'peer_median=' in actions[0].reason
+
+  def test_two_replica_fleet_can_still_eject(self):
+    # The drill shape: leave-one-out baselining keeps a wedged replica
+    # from hiding inside its own contribution to the cross-section.
+    fake = _FakeBalancer([10.0, 400.0])
+    ejector = self._ejector(fake)
+    ejector.poll(now=0.0)
+    actions = ejector.poll(now=1.0)
+    assert [a.verb for a in actions] == ['eject']
+    assert fake.quarantines and fake.quarantines[0][0] == 1
+
+  def test_probation_readmission_after_clean_probes(self):
+    fake = _FakeBalancer([10.0, 11.0, 400.0])
+    ejector = self._ejector(fake, probation_secs=3.0)
+    ejector.poll(now=0.0)
+    ejector.poll(now=1.0)          # eject fires at t=1
+    assert fake.snapshot[2]['quarantined']
+    # Probation not yet served: no readmission.
+    assert ejector.poll(now=2.5) == []
+    actions = ejector.poll(now=4.5)
+    assert [a.verb for a in actions] == ['readmit']
+    assert actions[0].outcome == 'applied'
+    assert fake.readmissions and fake.readmissions[0][0] == 2
+
+  def test_dirty_probes_block_readmission(self):
+    fake = _FakeBalancer([10.0, 11.0, 400.0])
+    ejector = self._ejector(fake, probation_secs=1.0)
+    ejector.poll(now=0.0)
+    ejector.poll(now=1.0)
+    fake.snapshot[2]['probing_ok'] = False
+    assert ejector.poll(now=10.0) == []
+
+  def test_refuses_to_eject_below_min_healthy(self):
+    # A 2-point cross-section has a degenerate MAD, so the outlier
+    # needs a 3-replica fleet; min_healthy=3 then forces the refusal
+    # branch when the ejection would leave only 2 healthy.
+    fake = _FakeBalancer([10.0, 11.0, 400.0])
+    ejector = self._ejector(fake, min_healthy=3)
+    ejector.poll(now=0.0)
+    actions = ejector.poll(now=1.0)
+    assert [a.verb for a in actions] == ['eject_refused']
+    assert actions[0].outcome == 'refused'
+    assert not fake.quarantines
+    assert 'min_healthy=3' in actions[0].reason
+
+  def test_cold_replicas_are_not_a_fleet(self):
+    # Below min_samples there is no cross-section to be anomalous
+    # against — a cold replica's compile spike must not eject it.
+    fake = _FakeBalancer([10.0, 400.0], counts=[20, 3])
+    ejector = self._ejector(fake)
+    for i in range(4):
+      assert ejector.poll(now=float(i)) == []
+
+  def test_steady_fleet_is_deadband(self):
+    fake = _FakeBalancer([10.0, 11.0, 12.0])
+    ejector = self._ejector(fake)
+    for i in range(6):
+      assert ejector.poll(now=float(i)) == []
+    assert _actuator_events() == []
+
+
+class TestBalancerQuarantineGuard:
+  """The surface-level half of the last-healthy refusal: the REAL
+  balancer refuses the actuator's quarantine when it would empty the
+  healthy set."""
+
+  def test_real_balancer_refuses_last_healthy_quarantine(self):
+    server = server_lib.ServingServer(
+        _EchoPredictor(), timeseries_interval_secs=0.0,
+        register_report=False).start()
+    dead_port = _free_port()
+    balancer = balancer_lib.Balancer(
+        [('127.0.0.1', server.port), ('127.0.0.1', dead_port)],
+        health_interval_secs=30.0, eject_after=1, register_report=False)
+    balancer.start()
+    try:
+      assert balancer.healthy_backend_count() == 1
+      refused_before = [e for e in flight.events(kinds=['balancer'])
+                        if e['name'] == 'balancer/eject_refused']
+      assert not balancer.quarantine(0, reason='drill')
+      refusals = [e for e in flight.events(kinds=['balancer'])
+                  if e['name'] == 'balancer/eject_refused']
+      assert len(refusals) == len(refused_before) + 1
+      assert balancer.healthy_backend_count() == 1
+      # The dead backend is not the last healthy one: quarantining it
+      # is allowed, and only readmit() releases it.
+      assert balancer.quarantine(1, reason='drill')
+      assert balancer.readmit(1, reason='drill over')
+    finally:
+      balancer.close()
+      server.close()
+
+
+# ------------------------------------------------------ serving autoscaler
+
+
+class _FakeScaler:
+
+  def __init__(self, replicas=1):
+    self.replicas = replicas
+    self.ups = 0
+    self.downs = 0
+
+  def up(self):
+    self.ups += 1
+    self.replicas += 1
+    return True
+
+  def down(self):
+    self.downs += 1
+    self.replicas -= 1
+    return True
+
+
+class _FakeSLO:
+
+  def __init__(self, alerting=()):
+    self.alerting = list(alerting)
+
+  def report(self):
+    return {'alerting': list(self.alerting)}
+
+
+class TestServingAutoscaler:
+
+  def _scaler(self, fake, depth_fn, **kwargs):
+    defaults = dict(min_replicas=1, max_replicas=3, up_queue_depth=8.0,
+                    down_queue_depth=1.0, trip_after=2, clear_after=2,
+                    max_actions_per_window=8)
+    defaults.update(kwargs)
+    return actuator_lib.ServingAutoscaler(
+        fake.up, fake.down, depth_fn, lambda: fake.replicas, **defaults)
+
+  def test_deadband_no_op_on_steady_signals(self):
+    fake = _FakeScaler(replicas=2)
+    scaler = self._scaler(fake, lambda: 4.0)  # inside (1, 8) band
+    for i in range(10):
+      assert scaler.poll(now=float(i)) == []
+    assert fake.ups == 0 and fake.downs == 0
+    assert _actuator_events() == []
+
+  def test_scales_up_on_sustained_queue_depth(self):
+    fake = _FakeScaler(replicas=1)
+    scaler = self._scaler(fake, lambda: 20.0)
+    assert scaler.poll(now=0.0) == []
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['scale_up']
+    assert fake.replicas == 2
+
+  def test_slo_burn_alone_scales_up(self):
+    fake = _FakeScaler(replicas=1)
+    scaler = self._scaler(fake, lambda: 0.0,
+                          slo_engine=_FakeSLO(['fleet_latency']))
+    scaler.poll(now=0.0)
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['scale_up']
+    assert 'fleet_latency' in actions[0].reason
+
+  def test_scales_down_when_quiet(self):
+    fake = _FakeScaler(replicas=2)
+    scaler = self._scaler(fake, lambda: 0.0)
+    scaler.poll(now=0.0)
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['scale_down']
+    assert fake.replicas == 1
+
+  def test_respects_replica_bounds(self):
+    fake = _FakeScaler(replicas=3)
+    scaler = self._scaler(fake, lambda: 50.0, max_replicas=3)
+    for i in range(5):
+      assert scaler.poll(now=float(i)) == []
+    fake = _FakeScaler(replicas=1)
+    scaler = self._scaler(fake, lambda: 0.0, min_replicas=1)
+    for i in range(5):
+      assert scaler.poll(now=float(i)) == []
+
+  def test_rejects_inverted_deadband(self):
+    fake = _FakeScaler()
+    with pytest.raises(ValueError):
+      self._scaler(fake, lambda: 0.0, up_queue_depth=2.0,
+                   down_queue_depth=5.0)
+
+
+# -------------------------------------------------------- actor autoscaler
+
+
+class _FakeSupervisor:
+
+  def __init__(self, alive=2, dead_slots=0):
+    self.alive = alive
+    self.dead_slots = dead_slots
+    self.added = []
+    self.retired = []
+    self.retire_result = 'actor-old'
+
+  def alive_count(self):
+    return self.alive
+
+  def stats(self):
+    out = {f'actor{i}': {'dead': False} for i in range(self.alive)}
+    for i in range(self.dead_slots):
+      out[f'dead{i}'] = {'dead': True}
+    return out
+
+  def add_actor(self, name, argv):
+    self.added.append((name, argv))
+    self.alive += 1
+    return True
+
+  def retire_actor(self, name=None):
+    self.retired.append(name)
+    if self.retire_result is None:
+      return None
+    self.alive -= 1
+    return self.retire_result
+
+
+def _set_follow_gauges(prefix, window=1000.0, torn=0.0, staleness=0.0):
+  metrics_lib.gauge(f'{prefix}/window_records').set(window)
+  metrics_lib.gauge(f'{prefix}/torn_pending').set(torn)
+  metrics_lib.gauge(f'{prefix}/max_staleness_steps').set(staleness)
+
+
+class TestActorFleetAutoscaler:
+
+  def _scaler(self, sup, prefix, **kwargs):
+    defaults = dict(target_actors=2, min_actors=1, max_actors=4,
+                    trip_after=2, clear_after=2, follow_prefix=prefix,
+                    max_actions_per_window=8)
+    defaults.update(kwargs)
+    seq_names = []
+
+    def factory(seq):
+      name = f'actor{100 + seq}'
+      seq_names.append(name)
+      return name, ['argv', str(seq)]
+
+    scaler = actuator_lib.ActorFleetAutoscaler(sup, factory, **defaults)
+    scaler._drill_seq_names = seq_names
+    return scaler
+
+  def test_dead_actor_is_replaced_without_hysteresis(self):
+    prefix = 'test/afa_dead'
+    _set_follow_gauges(prefix)
+    sup = _FakeSupervisor(alive=1, dead_slots=1)
+    scaler = self._scaler(sup, prefix)
+    actions = scaler.poll(now=0.0)  # dead bypasses the grow latch
+    assert [a.verb for a in actions] == ['replace']
+    assert actions[0].outcome == 'applied'
+    assert 'dead' in actions[0].reason
+    assert len(sup.added) == 1
+    # Hole filled: the next poll proposes nothing.
+    sup.dead_slots = 0
+    assert scaler.poll(now=1.0) == []
+
+  def test_respawn_backoff_is_not_replaced(self):
+    # alive < target but NO dead verdict: the supervisor is mid-respawn
+    # and replacement would overshoot the fleet.
+    prefix = 'test/afa_backoff'
+    _set_follow_gauges(prefix)
+    sup = _FakeSupervisor(alive=1, dead_slots=0)
+    actions = self._scaler(sup, prefix).poll(now=0.0)
+    assert not [a for a in actions if a.verb == 'replace']
+    assert not sup.added
+
+  def test_torn_shards_grow_the_fleet(self):
+    prefix = 'test/afa_torn'
+    _set_follow_gauges(prefix, torn=2.0)
+    sup = _FakeSupervisor(alive=2)
+    scaler = self._scaler(sup, prefix)
+    assert scaler.poll(now=0.0) == []
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['grow']
+    assert 'torn=' in actions[0].reason
+    assert scaler.target == 3
+    assert len(sup.added) == 1
+
+  def test_staleness_grows_the_fleet(self):
+    prefix = 'test/afa_stale'
+    _set_follow_gauges(prefix, staleness=80.0)
+    sup = _FakeSupervisor(alive=2)
+    scaler = self._scaler(sup, prefix, staleness_steps=50.0)
+    scaler.poll(now=0.0)
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['grow']
+    assert 'staleness=' in actions[0].reason
+
+  def test_window_starvation_grows_the_fleet(self):
+    prefix = 'test/afa_window'
+    _set_follow_gauges(prefix, window=5.0)
+    sup = _FakeSupervisor(alive=2)
+    scaler = self._scaler(sup, prefix, low_window_records=100.0)
+    scaler.poll(now=0.0)
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['grow']
+    assert 'window_low=' in actions[0].reason
+
+  def test_growth_capped_at_max_actors(self):
+    prefix = 'test/afa_cap'
+    _set_follow_gauges(prefix, torn=5.0)
+    sup = _FakeSupervisor(alive=4)
+    scaler = self._scaler(sup, prefix, target_actors=4, max_actors=4)
+    for i in range(6):
+      assert scaler.poll(now=float(i)) == []
+    assert not sup.added
+
+  def test_quiet_fleet_shrinks_to_min(self):
+    prefix = 'test/afa_shrink'
+    _set_follow_gauges(prefix, window=5000.0)
+    sup = _FakeSupervisor(alive=3)
+    scaler = self._scaler(sup, prefix, target_actors=3,
+                          low_window_records=100.0)
+    scaler.poll(now=0.0)
+    actions = scaler.poll(now=1.0)
+    assert [a.verb for a in actions] == ['shrink']
+    assert scaler.target == 2
+    assert sup.retired == [None]
+
+  def test_steady_fleet_is_deadband(self):
+    prefix = 'test/afa_steady'
+    _set_follow_gauges(prefix, window=5000.0)
+    sup = _FakeSupervisor(alive=2)  # already at target == min
+    scaler = self._scaler(sup, prefix, min_actors=2,
+                          low_window_records=100.0)
+    for i in range(8):
+      assert scaler.poll(now=float(i)) == []
+    assert _actuator_events() == []
+
+
+# --------------------------------------------------------- router budget
+
+
+class _FakeRouter:
+
+  def __init__(self, budget=1000, resident=100):
+    self.hbm_budget = budget
+    self._resident = resident
+    self.set_calls = []
+
+  def resident_bytes(self):
+    return self._resident
+
+  def set_hbm_budget(self, nbytes):
+    self.set_calls.append(nbytes)
+    self.hbm_budget = nbytes
+
+
+class TestRouterBudgetActuator:
+
+  def test_page_in_churn_grows_the_budget(self):
+    counter = metrics_lib.counter('test/rba_grow/page_ins')
+    router = _FakeRouter(budget=1000)
+    act = actuator_lib.RouterBudgetActuator(
+        router, churn_page_ins_per_sec=1.0, grow_factor=1.5,
+        page_in_counter='test/rba_grow/page_ins', trip_after=2,
+        max_actions_per_window=8)
+    assert act.poll(now=0.0) == []  # first poll only baselines
+    counter.inc(10)
+    assert act.poll(now=1.0) == []  # breach 1 arms the latch
+    counter.inc(10)
+    actions = act.poll(now=2.0)
+    assert [a.verb for a in actions] == ['grow_budget']
+    assert router.hbm_budget == 1500
+
+  def test_growth_respects_max_budget(self):
+    counter = metrics_lib.counter('test/rba_max/page_ins')
+    router = _FakeRouter(budget=1000)
+    act = actuator_lib.RouterBudgetActuator(
+        router, page_in_counter='test/rba_max/page_ins', trip_after=1,
+        max_budget_bytes=1200, max_actions_per_window=8)
+    act.poll(now=0.0)
+    counter.inc(10)
+    act.poll(now=1.0)
+    assert router.hbm_budget == 1200
+
+  def test_zero_churn_shrinks_toward_residency(self):
+    router = _FakeRouter(budget=1000, resident=100)
+    act = actuator_lib.RouterBudgetActuator(
+        router, page_in_counter='test/rba_shrink/page_ins',
+        shrink_headroom=1.5, trip_after=2, max_actions_per_window=8)
+    act.poll(now=0.0)
+    act.poll(now=1.0)
+    actions = act.poll(now=2.0)
+    assert [a.verb for a in actions] == ['shrink_budget']
+    assert router.hbm_budget == 150
+
+  def test_fitting_working_set_is_deadband(self):
+    # Budget already at the shrink target and no churn: nothing moves.
+    router = _FakeRouter(budget=150, resident=100)
+    act = actuator_lib.RouterBudgetActuator(
+        router, page_in_counter='test/rba_steady/page_ins',
+        shrink_headroom=1.5, trip_after=2, max_actions_per_window=8)
+    for i in range(6):
+      assert act.poll(now=float(i)) == []
+    assert not router.set_calls
+
+
+# --------------------------------------------------------------- engine
+
+
+class _FakeWatch:
+
+  def __init__(self):
+    self.polls = 0
+
+  def poll(self):
+    self.polls += 1
+    return []
+
+
+class _FakeEvalSLO(_FakeSLO):
+
+  def __init__(self):
+    super().__init__()
+    self.evaluations = 0
+
+  def evaluate(self, now=None):
+    self.evaluations += 1
+    return {}
+
+
+class TestActuatorEngine:
+
+  def test_rejects_empty_and_duplicate_actuators(self):
+    with pytest.raises(ValueError):
+      actuator_lib.ActuatorEngine([])
+    with pytest.raises(ValueError):
+      actuator_lib.ActuatorEngine([_AlwaysActuator(), _AlwaysActuator()])
+
+  def test_drive_inputs_refreshes_signal_planes_first(self):
+    slo = _FakeEvalSLO()
+    watch = _FakeWatch()
+    engine = actuator_lib.ActuatorEngine(
+        [_AlwaysActuator(max_actions_per_window=8)],
+        slo_engine=slo, anomaly_watch=watch, drive_inputs=True,
+        register_report=False)
+    engine.poll(now=0.0)
+    assert slo.evaluations == 1
+    assert watch.polls == 1
+
+  def test_history_and_report(self):
+    engine = actuator_lib.ActuatorEngine(
+        [_AlwaysActuator(max_actions_per_window=8)],
+        register_report=False)
+    for i in range(3):
+      engine.poll(now=float(i))
+    assert len(engine.actions()) == 3
+    report = engine.report()
+    assert report['polls'] == 3
+    assert report['actuators'][0]['name'] == 'always'
+    assert len(report['recent_actions']) == 3
+
+  def test_background_loop_polls(self):
+    act = _AlwaysActuator(max_actions_per_window=100,
+                          budget_window_secs=60.0)
+    engine = actuator_lib.ActuatorEngine(
+        [act], poll_interval_secs=0.02, register_report=False)
+    with engine:
+      deadline = time.time() + 5.0
+      while not engine.actions() and time.time() < deadline:
+        time.sleep(0.01)
+    assert engine.actions()
+    assert engine.report()['polls'] > 0

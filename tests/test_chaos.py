@@ -1,0 +1,341 @@
+"""Chaos-scheduled fleet drills: injected faults must be recovered by
+the ACTUATORS, with the proof read off the flight-ring timeline.
+
+Two layers:
+
+* **Units** — schedule parsing/seeding, the latency wedge, the runner's
+  inject/clear timeline, and the verdict join (fault → applied actuator
+  action; SLO breach → postmortem bundle), all against synthetic flight
+  events so every matching rule is pinned in milliseconds.
+* **The tier-1 drill** — ``tools/run_chaos_soak.run_soak`` against a
+  REAL 2-replica serving fleet + 2-actor collect loop: a wedged
+  replica, an actor SIGKILLed mid-commit (crash-loop → DEAD), a torn
+  shard, and a held (stale) export, under open-loop interactive load.
+  The test body contains no operator-shaped step: every recovery in the
+  verdict is an automatic actuator action. A seeded hours-long soak of
+  the same shape is marked ``slow`` (CHAOS_SOAK_SECS scales it).
+
+Marker: ``chaos`` (tier-1; ``tools/run_tier1.sh -m chaos`` selects).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import postmortem as postmortem_lib
+from tensor2robot_tpu.observability import slo as slo_lib
+from tensor2robot_tpu.observability import timeseries
+from tensor2robot_tpu.observability import tracing
+from tensor2robot_tpu.utils import chaos as chaos_lib
+
+from tools import run_chaos_soak
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_state():
+  flight.recorder().clear()
+  flight.set_enabled(True)
+  tracing.span_index().clear()
+  postmortem_lib._reset_rate_limit_for_tests()
+  slo_lib.set_global_engine(None)
+  yield
+  slo_lib.set_global_engine(None)
+  timeseries.stop_global()
+
+
+# ------------------------------------------------------------- schedules
+
+
+class TestChaosSchedule:
+
+  def test_from_specs_parses_and_sorts(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs([
+        'at=5 kind=kill_actor target=0 arg=1',
+        'at=2.0 kind=wedge_replica target=1 arg=0.4 duration=6.0',
+    ])
+    assert [f.kind for f in schedule] == ['wedge_replica', 'kill_actor']
+    wedge = schedule.faults[0]
+    assert wedge.at_secs == 2.0
+    assert wedge.arg == '0.4'
+    assert wedge.duration_secs == 6.0
+
+  def test_spec_round_trips(self):
+    fault = chaos_lib.ChaosFault(2.0, 'wedge_replica', '1', '0.4', 6.0)
+    parsed = chaos_lib.ChaosSchedule.from_specs([fault.spec()]).faults[0]
+    assert parsed == fault
+
+  def test_malformed_specs_raise(self):
+    with pytest.raises(ValueError, match='not k=v'):
+      chaos_lib.ChaosSchedule.from_specs(['at=1 oops'])
+    with pytest.raises(ValueError, match='missing'):
+      chaos_lib.ChaosSchedule.from_specs(['kind=kill_actor target=0'])
+
+  def test_seeded_is_deterministic_and_covers_every_kind(self):
+    a = chaos_lib.ChaosSchedule.seeded(7, duration_secs=60.0)
+    b = chaos_lib.ChaosSchedule.seeded(7, duration_secs=60.0)
+    assert a.faults == b.faults
+    kinds = {f.kind for f in a}
+    assert kinds == {'wedge_replica', 'kill_actor', 'torn_shard',
+                     'stale_export'}
+    # Faults land inside the front of the window, leaving recovery tail.
+    assert all(f.at_secs <= 60.0 * 0.6 for f in a)
+
+  def test_actor_fault_specs_use_the_faults_grammar(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs([
+        'at=0 kind=kill_actor target=0 arg=1',
+        'at=0 kind=torn_shard target=1 arg=2',
+        'at=0 kind=stale_export target=1 arg=8',
+        'at=2 kind=wedge_replica target=0 arg=0.4 duration=6',
+    ])
+    specs = schedule.actor_fault_specs()
+    assert specs == {0: ['kill_before_commit:1'],
+                     1: ['torn_shard:2', 'hold_export:8']}
+
+  def test_actor_fault_specs_reject_non_integer_targets(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0 kind=kill_actor target=backend arg=1'])
+    with pytest.raises(ValueError, match='actor index'):
+      schedule.actor_fault_specs()
+
+  def test_default_drill_covers_acceptance_faults(self):
+    drill = run_chaos_soak.default_drill_schedule()
+    kinds = {f.kind for f in drill}
+    assert kinds == {'wedge_replica', 'kill_actor', 'torn_shard',
+                     'stale_export'}
+    wedge = [f for f in drill if f.kind == 'wedge_replica'][0]
+    assert wedge.duration_secs > 0  # the wedge must also clear
+
+
+# ------------------------------------------------------------ latency wedge
+
+
+class TestLatencyWedge:
+
+  class _Inner:
+
+    loaded = True
+
+    def predict(self, features):
+      return {'ok': features}
+
+  def test_armed_wedge_slows_but_succeeds(self):
+    wedge = chaos_lib.LatencyWedge(self._Inner())
+    assert not wedge.armed
+    t0 = time.monotonic()
+    assert wedge.predict({'x': 1})['ok'] == {'x': 1}
+    assert time.monotonic() - t0 < 0.05
+    wedge.arm(0.1)
+    t0 = time.monotonic()
+    assert wedge.predict({'x': 2})['ok'] == {'x': 2}
+    assert time.monotonic() - t0 >= 0.1
+    wedge.disarm()
+    assert not wedge.armed
+
+  def test_everything_else_delegates(self):
+    wedge = chaos_lib.LatencyWedge(self._Inner())
+    assert wedge.loaded is True
+
+  def test_wedge_forces_the_callable_dispatch_path(self):
+    # A jitted stateless core would bypass predict() — and with it the
+    # armed delay — so the wedge must refuse to expose one even when
+    # the wrapped predictor has it.
+    class Stateless(self._Inner):
+
+      def stateless_serving_fn(self):
+        return 'jitted core'
+
+    wedge = chaos_lib.LatencyWedge(Stateless())
+    with pytest.raises(NotImplementedError):
+      wedge.stateless_serving_fn()
+
+
+# ------------------------------------------------------------------ runner
+
+
+class TestChaosRunner:
+
+  def test_fires_injections_and_clears_on_the_timeline(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0.05 kind=wedge_replica target=0 arg=0.2 duration=0.1'])
+    injected, cleared = [], []
+    runner = chaos_lib.ChaosRunner(
+        schedule,
+        injectors={'wedge_replica': injected.append},
+        clearers={'wedge_replica': cleared.append})
+    runner.start()
+    assert runner.join(timeout_secs=5.0)
+    runner.stop()
+    assert len(injected) == 1 and injected[0].kind == 'wedge_replica'
+    assert len(cleared) == 1
+    names = [e['name'] for e in flight.events(kinds=['chaos'])]
+    assert names == ['chaos/wedge_replica/inject',
+                     'chaos/wedge_replica/clear']
+    timeline = runner.injected()
+    assert len(timeline) == 1
+    assert timeline[0]['kind'] == 'wedge_replica'
+
+  def test_kinds_without_injectors_still_get_timeline_entries(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0.0 kind=kill_actor target=0 arg=1'])
+    runner = chaos_lib.ChaosRunner(schedule)  # armed at spawn elsewhere
+    runner.start()
+    assert runner.join(timeout_secs=5.0)
+    runner.stop()
+    assert [e['name'] for e in flight.events(kinds=['chaos'])] == [
+        'chaos/kill_actor/inject']
+
+  def test_hook_exceptions_are_recorded_not_raised(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0.0 kind=wedge_replica target=0 arg=0.1'])
+
+    def explode(fault):
+      raise RuntimeError('injector broke')
+
+    runner = chaos_lib.ChaosRunner(schedule,
+                                   injectors={'wedge_replica': explode})
+    runner.start()
+    assert runner.join(timeout_secs=5.0)
+    runner.stop()
+    names = [e['name'] for e in flight.events(kinds=['chaos'])]
+    assert 'chaos/wedge_replica/hook_error' in names
+
+
+# ------------------------------------------------------------ verdict join
+
+
+def _applied(name, detail_tokens, t=None):
+  flight.recorder().record(
+      'actuator', name,
+      f'target=x outcome=applied dry_run=0 reason={detail_tokens}', t=t)
+
+
+class TestVerdictReport:
+
+  def test_matches_fault_to_applied_action_with_signature_tokens(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0 kind=kill_actor target=0 arg=1'])
+    t0 = time.time() - 5.0
+    _applied('actuator/actor_fleet/replace', 'dead: alive=1 < target=2')
+    verdict = chaos_lib.verdict_report(schedule, t0)
+    assert verdict['verdict'] == 'PASS'
+    assert verdict['faults_recovered'] == 1
+    assert verdict['faults'][0]['recovery_actions']
+
+  def test_unapplied_outcomes_never_count_as_recovery(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0 kind=kill_actor target=0 arg=1'])
+    t0 = time.time() - 5.0
+    flight.event('actuator', 'actuator/actor_fleet/replace',
+                 'target=x outcome=dry_run dry_run=1 reason=dead')
+    flight.event('actuator', 'actuator/actor_fleet/replace',
+                 'target=x outcome=budget_denied dry_run=0 reason=dead')
+    verdict = chaos_lib.verdict_report(schedule, t0)
+    assert verdict['verdict'] == 'FAIL'
+    assert verdict['faults_recovered'] == 0
+
+  def test_wrong_verb_or_token_never_matches(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=0 kind=kill_actor target=0 arg=1'])
+    t0 = time.time() - 5.0
+    _applied('actuator/serving_scale/scale_up', 'queue_depth=20')  # verb
+    _applied('actuator/actor_fleet/replace', 'window_low=3')       # token
+    verdict = chaos_lib.verdict_report(schedule, t0)
+    assert verdict['verdict'] == 'FAIL'
+
+  def test_actions_before_injection_never_match(self):
+    schedule = chaos_lib.ChaosSchedule.from_specs(
+        ['at=10 kind=kill_actor target=0 arg=1'])
+    t0 = time.time() - 5.0  # injection lands 5s in the future
+    _applied('actuator/actor_fleet/replace', 'dead: alive=1',
+             t=time.time() - 3.0)
+    verdict = chaos_lib.verdict_report(schedule, t0)
+    assert verdict['verdict'] == 'FAIL'
+
+  def test_slo_breach_requires_its_postmortem_bundle(self, tmp_path):
+    schedule = chaos_lib.ChaosSchedule(())
+    t0 = time.time() - 5.0
+    flight.event('slo', 'slo/fleet_latency/burn_alert', 'burn=20.0')
+    verdict = chaos_lib.verdict_report(schedule, t0,
+                                       postmortem_dir=str(tmp_path))
+    assert verdict['verdict'] == 'FAIL'
+    assert not verdict['slo_breaches'][0]['bundled']
+    bundle_dir = tmp_path / postmortem_lib.POSTMORTEM_DIRNAME
+    bundle_dir.mkdir()
+    (bundle_dir / '20260806-000000_slo_burn_fleet_latency.json').write_text(
+        '{}')
+    verdict = chaos_lib.verdict_report(schedule, t0,
+                                       postmortem_dir=str(tmp_path))
+    assert verdict['verdict'] == 'PASS'
+    assert verdict['slo_breaches'][0]['bundled']
+
+
+# ----------------------------------------------------------- the drill
+
+
+class TestChaosDrill:
+
+  def test_closed_loop_drill_recovers_every_fault(self, tmp_path):
+    """The acceptance drill: wedge + mid-commit SIGKILL + torn shard +
+    stale export against a live 2-replica / 2-actor loop under
+    interactive load; ZERO dropped interactive requests and every fault
+    recovered by an automatic actuator action. No operator steps."""
+    verdict = run_chaos_soak.run_soak(
+        str(tmp_path / 'fleet'), rate_rps=30.0, load_secs=10.0,
+        recovery_timeout_secs=60.0, seed=0)
+
+    assert verdict['verdict'] == 'PASS'
+
+    load = verdict['load']
+    assert load['arrivals'] > 100
+    assert load['errors'] == 0
+    assert load['shed'] == 0
+    interactive = load['classes']['interactive']
+    assert interactive['errors'] == 0
+    assert interactive['shed'] == 0
+
+    assert verdict['faults_total'] == 4
+    assert verdict['faults_recovered'] == 4
+    kinds = {doc['fault']['kind'] for doc in verdict['faults']}
+    assert kinds == {'wedge_replica', 'kill_actor', 'torn_shard',
+                     'stale_export'}
+    for doc in verdict['faults']:
+      assert doc['recovered'], doc
+      for action in doc['recovery_actions']:
+        # Every recovery is an actuator flight event, actually applied.
+        assert action['name'].startswith('actuator/')
+        assert 'outcome=applied' in action['detail']
+        assert action['time'] >= doc['injected_at'] - 1.0
+
+    # Any SLO breach the torment caused must have escalated to a bundle.
+    assert all(b['bundled'] for b in verdict['slo_breaches'])
+
+    # The verdict document is on disk for the postmortem reader.
+    path = tmp_path / 'fleet' / run_chaos_soak.VERDICT_FILENAME
+    assert path.exists()
+    on_disk = json.loads(path.read_text())
+    assert on_disk['verdict'] == 'PASS'
+    assert on_disk['actuators']['polls'] > 0
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+
+  def test_seeded_soak_recovers_every_fault(self, tmp_path):
+    """The long-form soak: a seeded-random schedule over a scalable
+    window (CHAOS_SOAK_SECS; default 120 s, point it at hours for a
+    TPU-day burn). Seed 2 keeps the stale-export carrier distinct from
+    the crash-looped actor so every fault can manifest."""
+    soak_secs = float(os.environ.get('CHAOS_SOAK_SECS', '120'))
+    schedule = chaos_lib.ChaosSchedule.seeded(2, duration_secs=soak_secs)
+    verdict = run_chaos_soak.run_soak(
+        str(tmp_path / 'soak'), schedule=schedule, rate_rps=40.0,
+        load_secs=soak_secs,
+        recovery_timeout_secs=max(90.0, soak_secs / 2), seed=2)
+    assert verdict['verdict'] == 'PASS'
+    assert verdict['load']['errors'] == 0
+    assert verdict['faults_recovered'] == verdict['faults_total']

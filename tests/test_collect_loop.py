@@ -537,6 +537,28 @@ class TestActorSupervisor:
       assert stats['crashes'] == 0 and stats['restarts'] == 0
       assert not stats['dead']
 
+  def test_stopping_fleet_never_respawns_a_crashed_actor(self):
+    # Shutdown race (PR-16 drill straggler): an actor SIGTERMed during
+    # interpreter startup dies with a crash code; a monitor tick racing
+    # request_stop must NOT respawn it — the replacement would never be
+    # signaled and wait() would burn its whole straggler timeout.
+    sup = self._supervisor(
+        'import signal, time; signal.signal(signal.SIGTERM, '
+        'signal.SIG_DFL); time.sleep(60)')
+    sup.start()
+    self._drive(sup, lambda s: s.any_alive())
+    sup.request_stop()
+    self._drive(sup, lambda s: not s.any_alive())
+    # Extra monitor ticks after the crash-coded exit (-SIGTERM): a
+    # stopping supervisor schedules no respawns.
+    for _ in range(5):
+      sup.poll()
+      time.sleep(0.02)
+    stats = sup.stats()['fake0']
+    assert not stats['running'] and stats['restarts'] == 0
+    codes = sup.wait(timeout_secs=2.0)
+    assert codes['fake0'] == -signal.SIGTERM
+
 
 def _committed_and_torn(episodes_dir):
   committed, torn = set(), set()

@@ -632,3 +632,182 @@ def test_live_bundle_renders_with_postmortem_tool(tmp_path, capsys):
   assert tool.main([path, '--json']) == 0
   summary = json.loads(capsys.readouterr().out)
   assert summary['live'] is True and summary['reason'] == 'slo_burn_demo'
+
+
+# ------------------------- PR-16 satellites: cadence-derived burn windows
+
+
+class TestBurnWindowDerivation:
+
+  def _objective(self, name):
+    return slo_lib.Objective.availability(
+        name, good=[f'fleetobs/{name}/ok'], bad=[f'fleetobs/{name}/bad'],
+        objective=0.99)
+
+  def test_default_cadence_is_identity(self):
+    assert slo_lib.derive_windows(10.0) == slo_lib.DEFAULT_WINDOWS
+
+  def test_windows_scale_to_keep_sample_counts(self):
+    fast = slo_lib.derive_windows(1.0)
+    assert fast[0] == slo_lib.BurnWindow(6.0, 30.0, 14.4)
+    assert fast[1] == slo_lib.BurnWindow(30.0, 120.0, 6.0)
+    slow = slo_lib.derive_windows(60.0)
+    assert slow[0].fast_secs == 360.0
+    # Burn rate is cadence-free: thresholds never scale.
+    assert [w.threshold for w in slow] == [14.4, 6.0]
+
+  def test_non_positive_cadence_raises(self):
+    with pytest.raises(ValueError):
+      slo_lib.derive_windows(0.0)
+    with pytest.raises(ValueError):
+      slo_lib.derive_windows(-1.0)
+
+  def test_engine_derives_windows_from_its_recorder_cadence(self):
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=0.5,
+                                             capacity=16)
+    engine = slo_lib.SLOEngine([self._objective('derive_demo')],
+                               recorder=recorder, register_report=False)
+    windows = engine.report()['windows']
+    assert windows[0]['fast_secs'] == pytest.approx(3.0)
+    assert windows[0]['slow_secs'] == pytest.approx(15.0)
+    assert windows[1]['slow_secs'] == pytest.approx(60.0)
+
+  def test_engine_refuses_windows_under_two_samples(self):
+    # A 15 s fast window at a 10 s cadence spans 1.5 ring samples: its
+    # burn rate would be identically zero and the objective would
+    # silently never alert — start() must raise loudly instead.
+    recorder = timeseries.TimeSeriesRecorder(interval_secs=10.0,
+                                             capacity=16)
+    engine = slo_lib.SLOEngine(
+        [self._objective('short_window')],
+        windows=[slo_lib.BurnWindow(15.0, 60.0, 14.4)],
+        recorder=recorder, register_report=False)
+    with pytest.raises(ValueError, match='2 samples'):
+      engine.start()
+
+
+# --------------------- PR-16 satellites: anomaly regime re-baseline edges
+
+
+class TestRegimeRebaselineEdges:
+
+  def _detector(self):
+    return anomaly_lib.RobustDetector(k=6.0, min_history=3, window=64,
+                                      rel_floor=0.1, rebaseline_after=3)
+
+  def test_n_minus_one_anomalies_then_return_keeps_the_old_baseline(self):
+    detector = self._detector()
+    for _ in range(4):
+      assert detector.observe(10.0) is None
+    # N-1 consecutive anomalies: quarantined, baseline untouched.
+    for _ in range(2):
+      record = detector.observe(100.0)
+      assert record is not None
+      assert record['baseline_median'] == pytest.approx(10.0)
+    assert detector.history == 4  # quarantine is NOT in the baseline
+    # Return to baseline: accepted, and the pending quarantine is
+    # dropped without ever contaminating the accepted series.
+    assert detector.observe(10.0) is None
+    assert detector.history == 5
+    # A later excursion is still judged against the ORIGINAL level.
+    record = detector.observe(100.0)
+    assert record is not None
+    assert record['baseline_median'] == pytest.approx(10.0)
+
+  def test_exactly_n_anomalies_adopt_the_new_regime(self):
+    detector = self._detector()
+    for _ in range(3):
+      assert detector.observe(10.0) is None
+    # Exactly N consecutive anomalies: each still flags (a sustained
+    # regression must keep alerting)...
+    flagged = [detector.observe(100.0) for _ in range(3)]
+    assert all(record is not None for record in flagged)
+    # ...but the N-th folds the quarantine in as the new baseline, so
+    # the new level is in-band from here on.
+    assert detector.observe(100.0) is None
+
+  def test_interleaved_inband_values_reset_the_quarantine_count(self):
+    detector = self._detector()
+    for _ in range(4):
+      assert detector.observe(10.0) is None
+    # anomaly, anomaly, in-band, anomaly, anomaly, in-band... never
+    # reaches N consecutive: the baseline must never move.
+    for _ in range(3):
+      assert detector.observe(100.0) is not None
+      assert detector.observe(100.0) is not None
+      assert detector.observe(10.0) is None
+    record = detector.observe(100.0)
+    assert record is not None
+    assert record['baseline_median'] == pytest.approx(10.0)
+
+
+# ----------------------- PR-16 satellites: Retry-After-honoring loadgen
+
+
+class TestRetryAfterClients:
+
+  def _shedding_submit(self, retry_after_secs, shed_times=1):
+    lock = threading.Lock()
+    attempts = {}
+
+    def submit(index, features, priority):
+      del features, priority
+      with lock:
+        seen = attempts.get(index, 0)
+        attempts[index] = seen + 1
+      if seen < shed_times:
+        raise loadgen.ShedError('shed for drill',
+                                retry_after_secs=retry_after_secs)
+      return {'echo': np.zeros(1, np.float32)}
+
+    return submit
+
+  def test_best_effort_resubmits_instead_of_terminal_shed(self):
+    report = loadgen.run_open_loop(
+        self._shedding_submit(0.05), lambda i: _features(0.1),
+        rate_rps=20.0, duration_secs=1.0, workers=8, seed=0,
+        best_effort_fraction=1.0, warmup_requests=0)
+    assert report.arrivals > 0
+    assert report.shed == 0
+    assert report.ok == report.arrivals
+    # Resubmissions are reported separately, never hidden in ok counts.
+    assert report.resubmitted == report.arrivals
+    assert report.classes['best_effort']['resubmitted'] == report.arrivals
+
+  def test_resubmission_gives_up_after_max_resubmits(self):
+    report = loadgen.run_open_loop(
+        self._shedding_submit(0.01, shed_times=100),
+        lambda i: _features(0.1),
+        rate_rps=20.0, duration_secs=1.0, workers=8, seed=0,
+        best_effort_fraction=1.0, warmup_requests=0, max_resubmits=2)
+    assert report.ok == 0
+    assert report.shed == report.arrivals
+    # Every arrival burned its full resubmit budget before shedding.
+    assert report.resubmitted == 2 * report.arrivals
+
+  def test_interactive_requests_never_resubmit(self):
+    # Interactive latency SLOs would be poisoned by silent retries:
+    # a shed interactive request is terminal regardless of Retry-After.
+    report = loadgen.run_open_loop(
+        self._shedding_submit(0.05), lambda i: _features(0.1),
+        rate_rps=20.0, duration_secs=1.0, workers=8, seed=0,
+        best_effort_fraction=0.0, warmup_requests=0)
+    assert report.shed == report.arrivals
+    assert report.resubmitted == 0
+
+  def test_missing_retry_after_is_a_terminal_shed(self):
+    report = loadgen.run_open_loop(
+        self._shedding_submit(None), lambda i: _features(0.1),
+        rate_rps=20.0, duration_secs=1.0, workers=8, seed=0,
+        best_effort_fraction=1.0, warmup_requests=0)
+    assert report.shed == report.arrivals
+    assert report.resubmitted == 0
+
+  def test_honor_retry_after_false_restores_terminal_sheds(self):
+    report = loadgen.run_open_loop(
+        self._shedding_submit(0.05), lambda i: _features(0.1),
+        rate_rps=20.0, duration_secs=1.0, workers=8, seed=0,
+        best_effort_fraction=1.0, warmup_requests=0,
+        honor_retry_after=False)
+    assert report.shed == report.arrivals
+    assert report.resubmitted == 0

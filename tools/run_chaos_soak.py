@@ -1,0 +1,565 @@
+"""Chaos soak: the closed fleet-ops loop under a seeded fault schedule.
+
+Stands up the WHOLE loop in one process tree — a 2-replica serving
+fleet behind the balancer, a supervised actor fleet committing episode
+shards, an export ticker publishing fresh policy versions, a follow
+stream consuming the shards, the SLO/anomaly watch planes, and the
+actuator engine wired to every control surface — then fires a
+:class:`~tensor2robot_tpu.utils.chaos.ChaosSchedule` at it while an
+open-loop client drives interactive traffic through the front door.
+
+The run's product is the verdict document
+(:func:`~tensor2robot_tpu.utils.chaos.verdict_report`): every injected
+fault joined to the automatic actuator action(s) that recovered it,
+every SLO burn alert joined to its live postmortem bundle, plus the
+load report proving zero dropped interactive requests. No operator
+steps anywhere — recovery is the actuators' job or the run FAILs.
+
+Fault→recovery expectations (drilled by ``tests/test_chaos.py``):
+
+* ``wedge_replica`` (slow-but-200 replica) → fleet-relative ejection
+  by :class:`FleetLatencyEjector`, probation re-admission after the
+  wedge clears;
+* ``kill_actor`` (SIGKILL mid-commit, every incarnation) → supervisor
+  DEAD verdict → :class:`ActorFleetAutoscaler` *replace*;
+* ``torn_shard`` (payload without commit marker) → follow-mode
+  ``torn_pending`` → actor-fleet *grow*;
+* ``stale_export`` (actor pinned to policy v0) → follow-mode
+  ``max_staleness_steps`` → actor-fleet *grow*.
+
+Usage (bounded drill, ~1 min):
+
+  python -m tools.run_chaos_soak --out-dir /tmp/chaos
+
+Hours-long seeded soak (the ``slow``-marked shape):
+
+  python -m tools.run_chaos_soak --out-dir /tmp/chaos \
+      --seeded --seed 7 --load-secs 3600 --recovery-timeout-secs 600
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.bin.run_collect_train import (LoopConfig,
+                                                    ensure_initial_export)
+from tensor2robot_tpu.collect.actor import ActorConfig, ActorSupervisor
+from tensor2robot_tpu.data import follow as follow_lib
+from tensor2robot_tpu.observability import actuator as actuator_lib
+from tensor2robot_tpu.observability import anomaly as anomaly_lib
+from tensor2robot_tpu.observability import slo as slo_lib
+from tensor2robot_tpu.observability import timeseries
+from tensor2robot_tpu.serving import balancer as balancer_lib
+from tensor2robot_tpu.serving import loadgen
+from tensor2robot_tpu.serving import server as server_lib
+from tensor2robot_tpu.utils import chaos as chaos_lib
+
+# One shared batcher scope for every replica: registry counters and the
+# latency histogram aggregate across the fleet, which is exactly the
+# granularity the fleet SLO and anomaly watch reason over.
+METRICS_PREFIX = 'serving/chaos'
+VERDICT_FILENAME = 'chaos_verdict.json'
+
+
+def _mock_predictor():
+  """A loaded in-process predictor (the serving replicas' model)."""
+  from tensor2robot_tpu.predictors import CheckpointPredictor
+  from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+  predictor = CheckpointPredictor(
+      MockT2RModel(device_type='tpu', hidden_size=16),
+      model_dir='/nonexistent')
+  predictor.init_randomly()
+  return predictor
+
+
+def _features(index: int) -> Dict[str, np.ndarray]:
+  del index
+  return {'measured_position': np.full((1, 2), 0.25, np.float32)}
+
+
+def default_drill_schedule(wedge_at_secs: float = 2.0,
+                           wedge_delay_secs: float = 0.4,
+                           wedge_duration_secs: float = 6.0,
+                           hold_versions: int = 8
+                           ) -> chaos_lib.ChaosSchedule:
+  """The acceptance drill's fixed schedule: one fault of every kind.
+
+  The actor kinds sit at offset 0 because they are ARMED at spawn
+  (``ChaosSchedule.actor_fault_specs`` → ``ActorConfig.faults``) and
+  fire when the actor reaches the faulted operation; the wedge is the
+  one genuinely runtime-injected fault.
+  """
+  return chaos_lib.ChaosSchedule.from_specs([
+      (f'at={wedge_at_secs} kind=wedge_replica target=1 '
+       f'arg={wedge_delay_secs} duration={wedge_duration_secs}'),
+      'at=0.0 kind=kill_actor target=0 arg=1',
+      'at=0.0 kind=torn_shard target=1 arg=1',
+      f'at=0.0 kind=stale_export target=1 arg={hold_versions}',
+  ])
+
+
+class _ExportTicker:
+  """A trainer stand-in: publishes a fresh export version on a cadence.
+
+  The bounded drill cannot afford real train steps, but the staleness
+  fault needs the fleet's policy version to ADVANCE — an actor holding
+  v0 is only stale relative to something newer. The ticker re-exports
+  the (unchanged) model under a growing global step, which is exactly
+  the signal surface the loop cares about.
+  """
+
+  def __init__(self, config: LoopConfig,
+               interval_secs: float = 1.5,
+               step_increment: Optional[int] = None):
+    import jax
+
+    from tensor2robot_tpu.bin import run_collect_train as loop_mod
+    from tensor2robot_tpu.export import exporters as exporters_lib
+    from tensor2robot_tpu.modes import ModeKeys
+    from tensor2robot_tpu.specs import algebra, numpy_gen
+    from tensor2robot_tpu.train import train_state as ts_lib
+
+    self._config = config
+    self._interval = float(interval_secs)
+    self._increment = int(step_increment or config.save_interval_steps)
+    self._model = loop_mod._build_model(config)  # pylint: disable=protected-access
+    spec = algebra.filter_required_flat_tensor_spec(
+        self._model.preprocessor.get_in_feature_specification(
+            ModeKeys.PREDICT))
+    features = numpy_gen.make_random_numpy(spec, batch_size=1)
+    features_p, _ = self._model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT, None)
+    self._state = ts_lib.create_train_state(
+        self._model, self._model.create_optimizer(),
+        jax.random.PRNGKey(config.seed), features_p, ModeKeys.PREDICT)
+    self._exporter = exporters_lib.ModelExporter(serialize_serving=False)
+    self._step = 0
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  def start(self) -> '_ExportTicker':
+    if self._thread is None:
+      self._stop.clear()
+      self._thread = threading.Thread(target=self._run, daemon=True,
+                                      name='t2r-export-ticker')
+      self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=30.0)
+      self._thread = None
+
+  def _run(self) -> None:
+    while not self._stop.wait(self._interval):
+      self._step += self._increment
+      try:
+        self._exporter.export(self._model,
+                              self._state.replace(step=self._step),
+                              self._config.export_root)
+      except Exception:  # pylint: disable=broad-except
+        logging.exception('export ticker failed at step %d (non-fatal)',
+                          self._step)
+
+
+class _ReplicaFleet:
+  """In-process serving replicas + their wedges: the scale surface.
+
+  Each replica's predictor is wrapped in a
+  :class:`~tensor2robot_tpu.utils.chaos.LatencyWedge` so the chaos
+  runner can wedge any of them at runtime. ``scale_up`` spawns a fresh
+  replica and registers it with the balancer; ``scale_down`` only ever
+  removes autoscaler-grown replicas (the seed fleet is the operator's
+  floor), by quarantining the backend and closing the server.
+  """
+
+  def __init__(self, predictor_factory: Callable[[], Any],
+               seed_replicas: int = 2,
+               max_batch: int = 4,
+               batch_deadline_ms: float = 2.0,
+               max_queue: int = 64):
+    self._factory = predictor_factory
+    self._kwargs = dict(max_batch=max_batch,
+                        batch_deadline_ms=batch_deadline_ms,
+                        max_queue=max_queue,
+                        metrics_prefix=METRICS_PREFIX,
+                        register_report=False,
+                        timeseries_interval_secs=0.0)
+    self._lock = threading.Lock()
+    self.wedges: List[chaos_lib.LatencyWedge] = []
+    self.servers: List[server_lib.ServingServer] = []
+    self._seed_count = int(seed_replicas)
+    self._grown: List[Tuple[server_lib.ServingServer, int]] = []
+    self.balancer: Optional[balancer_lib.Balancer] = None
+    for _ in range(seed_replicas):
+      self._spawn_locked()
+
+  def _spawn_locked(self) -> server_lib.ServingServer:
+    wedge = chaos_lib.LatencyWedge(self._factory())
+    server = server_lib.ServingServer(wedge, **self._kwargs).start()
+    self.wedges.append(wedge)
+    self.servers.append(server)
+    return server
+
+  def addresses(self) -> List[Tuple[str, int]]:
+    return [('127.0.0.1', s.port) for s in self.servers]
+
+  def wedge(self, index: int, delay_secs: float) -> None:
+    self.wedges[index].arm(delay_secs)
+
+  def unwedge(self, index: int) -> None:
+    self.wedges[index].disarm()
+
+  def replica_count(self) -> int:
+    with self._lock:
+      return len(self.servers)
+
+  def queue_depth(self) -> float:
+    with self._lock:
+      servers = list(self.servers)
+    return float(sum(s.batcher.queue_depth for s in servers
+                     if s.batcher is not None))
+
+  def scale_up(self) -> bool:
+    if self.balancer is None:
+      return False
+    with self._lock:
+      server = self._spawn_locked()
+    index = self.balancer.add_backend('127.0.0.1', server.port)
+    with self._lock:
+      self._grown.append((server, index))
+    return True
+
+  def scale_down(self) -> bool:
+    with self._lock:
+      if not self._grown:
+        return False  # never shrinks below the seed fleet
+      server, index = self._grown.pop()
+    if self.balancer is not None:
+      self.balancer.quarantine(index, reason='scale_down')
+    server.close()
+    with self._lock:
+      self.servers.remove(server)
+    return True
+
+  def close(self) -> None:
+    with self._lock:
+      servers, self.servers = self.servers, []
+    for server in servers:
+      server.close()
+
+
+def _actor_configs(config: LoopConfig) -> List[ActorConfig]:
+  """The actor fleet's wiring, mirroring ``run_collect_train``."""
+  return [
+      ActorConfig(
+          actor_id=i,
+          export_root=config.export_root,
+          out_dir=config.episodes_dir,
+          episodes_per_shard=config.episodes_per_shard,
+          reload_interval_secs=config.actor_reload_interval_secs,
+          episode_interval_secs=config.actor_episode_interval_secs,
+          seed=config.seed * 1000 + i,
+          env_kwargs={'seed': config.seed * 100 + i},
+          explore_stddev=config.explore_stddev,
+          faults=(config.actor_faults or {}).get(i),
+      ) for i in range(config.num_actors)
+  ]
+
+
+def _replacement_command_factory(config: LoopConfig
+                                 ) -> Callable[[int], Tuple[str, List[str]]]:
+  """Builds argv for actuator-spawned actors: clean configs (no armed
+  faults — a replacement inheriting its predecessor's kill switch would
+  crash-loop forever), fresh ids past the seed fleet's range."""
+
+  def factory(seq: int) -> Tuple[str, List[str]]:
+    actor_id = 100 + seq
+    actor = ActorConfig(
+        actor_id=actor_id,
+        export_root=config.export_root,
+        out_dir=config.episodes_dir,
+        episodes_per_shard=config.episodes_per_shard,
+        reload_interval_secs=config.actor_reload_interval_secs,
+        episode_interval_secs=config.actor_episode_interval_secs,
+        seed=config.seed * 1000 + actor_id,
+        env_kwargs={'seed': config.seed * 100 + actor_id},
+        explore_stddev=config.explore_stddev,
+    )
+    argv = [sys.executable, '-m', 'tensor2robot_tpu.collect.actor_main',
+            '--config-json', actor.to_json()]
+    return f'actor{actor_id}', argv
+
+  return factory
+
+
+def _drill_objectives(latency_threshold_ms: float) -> List[slo_lib.Objective]:
+  """Fleet SLOs over the shared replica scope (plain-batcher metrics;
+  the drill fleet has no router, so no admission-class counters)."""
+  return [
+      slo_lib.Objective.availability(
+          'fleet_availability',
+          good=[f'{METRICS_PREFIX}/requests'],
+          bad=[f'{METRICS_PREFIX}/request_errors'],
+          objective=0.999),
+      slo_lib.Objective.latency(
+          'fleet_latency',
+          histogram=f'{METRICS_PREFIX}/request_latency_ms',
+          threshold_ms=latency_threshold_ms,
+          objective=0.99),
+  ]
+
+
+def _warm_replicas(fleet: _ReplicaFleet, requests_each: int = 3) -> None:
+  """Warms every replica DIRECTLY (not via the balancer) so bucket
+  compiles land before the ejector starts reading fleet latencies —
+  a cold replica's first-request compile looks exactly like a wedge."""
+  for server in list(fleet.servers):
+    submit = loadgen.http_open_submit_fn('127.0.0.1', server.port,
+                                         timeout=60.0)
+    for i in range(requests_each):
+      try:
+        submit(i, _features(i), None)
+      except Exception:  # pylint: disable=broad-except
+        logging.warning('warmup request to replica %s failed', server.port,
+                        exc_info=True)
+
+
+def _consume_follow(stream: follow_lib.FollowStream,
+                    stop: threading.Event) -> None:
+  """Samples the follow window on a trainer-ish cadence: the staleness
+  gauges only move when records are actually SAMPLED."""
+  while not stop.is_set():
+    try:
+      next(stream)
+    except StopIteration:
+      return
+    except follow_lib.FollowStarvedError:
+      continue  # the actor fleet is being tormented; keep sampling
+    stop.wait(0.02)
+
+
+def run_soak(out_dir: str,
+             schedule: Optional[chaos_lib.ChaosSchedule] = None,
+             rate_rps: float = 40.0,
+             load_secs: float = 12.0,
+             recovery_timeout_secs: float = 75.0,
+             seed: int = 0,
+             replicas: int = 2,
+             actors: int = 2,
+             timeseries_interval_secs: float = 0.25,
+             latency_threshold_ms: float = 200.0,
+             staleness_steps: float = 50.0,
+             dry_run: bool = False,
+             predictor_factory: Callable[[], Any] = _mock_predictor
+             ) -> Dict[str, Any]:
+  """One full chaos run; returns (and writes) the verdict document.
+
+  The run has three phases: bring-up (seed export, replicas, balancer,
+  actor fleet, watch planes, actuator engine), torment (chaos runner +
+  open-loop interactive load), and recovery (keep the engine polling
+  until every fault's recovery signature lands or the timeout passes).
+  Everything it asserts on rides the flight ring; the verdict is
+  computed from that shared timeline, not from private state.
+  """
+  os.makedirs(out_dir, exist_ok=True)
+  schedule = schedule or default_drill_schedule()
+  config = LoopConfig(
+      model_dir=out_dir,
+      num_actors=actors,
+      episodes_per_shard=2,
+      crash_budget=1,
+      actor_reload_interval_secs=0.5,
+      actor_episode_interval_secs=0.05,
+      seed=seed,
+      actor_faults=schedule.actor_fault_specs(),
+  )
+  os.makedirs(config.episodes_dir, exist_ok=True)
+  logging.info('chaos soak: seeding v0 export under %s', out_dir)
+  ensure_initial_export(config)
+
+  recorder = timeseries.TimeSeriesRecorder(
+      interval_secs=timeseries_interval_secs, capacity=512).start()
+  fleet = _ReplicaFleet(predictor_factory, seed_replicas=replicas)
+  balancer = balancer_lib.Balancer(
+      fleet.addresses(), health_interval_secs=0.25,
+      register_report=False).start()
+  fleet.balancer = balancer
+  if not balancer_lib.wait_healthy(balancer, replicas, timeout_secs=15.0):
+    raise RuntimeError('serving fleet failed to come up healthy')
+  _warm_replicas(fleet)
+
+  supervisor = ActorSupervisor.for_configs(
+      _actor_configs(config), crash_budget=config.crash_budget)
+  supervisor.start()
+  supervisor.start_monitor(interval_secs=0.25)
+
+  ticker = _ExportTicker(config).start()
+  stream = follow_lib.FollowStream(
+      follow_lib.FollowConfig(
+          directory=config.episodes_dir, window_records=512,
+          min_window_records=1, starve_timeout_secs=600.0, seed=seed),
+      batch_size=1)
+  consumer_stop = threading.Event()
+  consumer = threading.Thread(
+      target=_consume_follow, args=(stream, consumer_stop), daemon=True,
+      name='t2r-chaos-consumer')
+  consumer.start()
+
+  slo_engine = slo_lib.SLOEngine(
+      _drill_objectives(latency_threshold_ms), recorder=recorder,
+      postmortem_dir=out_dir, register_report=False)
+  watch = anomaly_lib.AnomalyWatch(
+      specs=(f'{METRICS_PREFIX}/request_latency_ms:p99',
+             f'{METRICS_PREFIX}/queue_depth'),
+      recorder=recorder, postmortem_dir=out_dir, register_report=False)
+
+  safety = dict(dry_run=dry_run, budget_window_secs=30.0)
+  ejector = actuator_lib.FleetLatencyEjector(
+      balancer, k=4.0, rel_floor=1.0, abs_floor_ms=100.0, min_samples=6,
+      min_healthy=1, probation_secs=2.0, trip_after=2, clear_after=2,
+      max_actions_per_window=6, **safety)
+  serving_scaler = actuator_lib.ServingAutoscaler(
+      fleet.scale_up, fleet.scale_down, fleet.queue_depth,
+      fleet.replica_count, min_replicas=replicas, max_replicas=replicas + 1,
+      up_queue_depth=16.0, down_queue_depth=1.0, slo_engine=slo_engine,
+      trip_after=3, clear_after=8, max_actions_per_window=2, **safety)
+  actor_scaler = actuator_lib.ActorFleetAutoscaler(
+      supervisor, _replacement_command_factory(config),
+      # min_actors pins the seed fleet: the shrink path may only retire
+      # actors the grow path added, never the scripted fault carriers
+      # (retiring a carrier before its fault manifests would void the
+      # drill's verdict join).
+      target_actors=actors, min_actors=actors, max_actors=actors + 2,
+      staleness_steps=staleness_steps, trip_after=2, clear_after=4,
+      max_actions_per_window=4, **safety)
+  engine = actuator_lib.ActuatorEngine(
+      [ejector, serving_scaler, actor_scaler], poll_interval_secs=0.5,
+      slo_engine=slo_engine, anomaly_watch=watch, drive_inputs=True,
+      register_report=False).start()
+
+  runner = chaos_lib.ChaosRunner(
+      schedule,
+      injectors={'wedge_replica':
+                 lambda f: fleet.wedge(int(f.target), float(f.arg))},
+      clearers={'wedge_replica':
+                lambda f: fleet.unwedge(int(f.target))})
+
+  load_report: Optional[loadgen.OpenLoopReport] = None
+  try:
+    runner.start()
+    logging.info('chaos soak: driving %.0f rps interactive for %.0fs',
+                 rate_rps, load_secs)
+    load_report = loadgen.run_open_loop(
+        loadgen.http_open_submit_fn('127.0.0.1', balancer.port,
+                                    timeout=30.0),
+        _features, rate_rps=rate_rps, duration_secs=load_secs,
+        workers=24, seed=seed, best_effort_fraction=0.0,
+        warmup_requests=2)
+    logging.info('chaos soak: load done (ok=%d shed=%d errors=%d); '
+                 'waiting for recoveries', load_report.ok,
+                 load_report.shed, load_report.errors)
+    deadline = time.monotonic() + recovery_timeout_secs
+    verdict = chaos_lib.verdict_report(schedule, runner.t0_wall,
+                                       postmortem_dir=out_dir)
+    while (verdict['faults_recovered'] < verdict['faults_total']
+           and time.monotonic() < deadline):
+      time.sleep(0.5)
+      verdict = chaos_lib.verdict_report(schedule, runner.t0_wall,
+                                         postmortem_dir=out_dir)
+  finally:
+    runner.stop()
+    engine.stop()
+    consumer_stop.set()
+    supervisor.request_stop()
+    supervisor.wait(timeout_secs=30.0)
+    stream.close()
+    consumer.join(timeout=5.0)
+    ticker.stop()
+    balancer.close()
+    fleet.close()
+    recorder.stop()
+
+  verdict = chaos_lib.verdict_report(schedule, runner.t0_wall,
+                                     postmortem_dir=out_dir)
+  verdict['load'] = load_report.as_dict() if load_report else None
+  verdict['dry_run'] = dry_run
+  verdict['actuators'] = engine.report()
+  path = os.path.join(out_dir, VERDICT_FILENAME)
+  tmp = f'{path}.tmp{os.getpid()}'
+  with open(tmp, 'w') as f:
+    json.dump(verdict, f, indent=2, default=str)
+  os.replace(tmp, path)
+  logging.info('chaos soak verdict: %s (%d/%d faults recovered) -> %s',
+               verdict['verdict'], verdict['faults_recovered'],
+               verdict['faults_total'], path)
+  return verdict
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--out-dir', required=True)
+  parser.add_argument('--rate-rps', type=float, default=40.0)
+  parser.add_argument('--load-secs', type=float, default=12.0)
+  parser.add_argument('--recovery-timeout-secs', type=float, default=75.0)
+  parser.add_argument('--seed', type=int, default=0)
+  parser.add_argument('--replicas', type=int, default=2)
+  parser.add_argument('--actors', type=int, default=2)
+  parser.add_argument('--timeseries-interval-secs', type=float,
+                      default=0.25)
+  parser.add_argument('--latency-threshold-ms', type=float, default=200.0)
+  parser.add_argument(
+      '--fault', action='append', default=[],
+      help='Chaos spec string (repeatable), e.g. '
+           '"at=2 kind=wedge_replica target=1 arg=0.4 duration=6"; '
+           'omitted -> the default drill schedule.')
+  parser.add_argument(
+      '--seeded', action='store_true',
+      help='Seeded-random schedule over the load window instead of the '
+           'default drill (soak shape; combine with --seed).')
+  parser.add_argument(
+      '--dry-run', action='store_true',
+      help='Actuators record decisions but never touch a control '
+           'surface (policy soak; the verdict will show FAIL).')
+  args = parser.parse_args(argv)
+  logging.basicConfig(level=logging.INFO)
+
+  if args.fault and args.seeded:
+    parser.error('--fault and --seeded are mutually exclusive')
+  if args.fault:
+    schedule = chaos_lib.ChaosSchedule.from_specs(args.fault)
+  elif args.seeded:
+    schedule = chaos_lib.ChaosSchedule.seeded(
+        args.seed, duration_secs=args.load_secs,
+        replicas=args.replicas, actors=args.actors)
+  else:
+    schedule = None
+
+  verdict = run_soak(
+      args.out_dir,
+      schedule=schedule,
+      rate_rps=args.rate_rps,
+      load_secs=args.load_secs,
+      recovery_timeout_secs=args.recovery_timeout_secs,
+      seed=args.seed,
+      replicas=args.replicas,
+      actors=args.actors,
+      timeseries_interval_secs=args.timeseries_interval_secs,
+      latency_threshold_ms=args.latency_threshold_ms,
+      dry_run=args.dry_run)
+  return 0 if verdict['verdict'] == 'PASS' else 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())
