@@ -1479,6 +1479,20 @@ def main():
     print(json.dumps({'metric': 'observability_report',
                       'error': repr(e)[:200]}))
 
+  # Compiled-program ledger beside the report: every executable this
+  # bench compiled (train step, serving buckets) with its FLOPs/bytes/
+  # fingerprint/donation map, so an arm's headline carries the cost
+  # model that explains it. `tools/program_report.py --diff` renders
+  # the bytes-accessed delta between two arms' ledger lines.
+  try:
+    from tensor2robot_tpu.observability import programs as programs_lib
+
+    print(json.dumps({'metric': 'program_ledger',
+                      **programs_lib.document()}))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'program_ledger',
+                      'error': repr(e)[:200]}))
+
   # Distributed-resilience gauges (heartbeat ages, per-host steps,
   # coordinated stops, barrier timeouts, torn-checkpoint skips) beside
   # the report: on a pod, BENCH rounds record whether the run was

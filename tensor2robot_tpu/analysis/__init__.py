@@ -1,6 +1,6 @@
 """AST-based static-analysis suite (stdlib-only, zero runtime cost).
 
-Seven rule families gate tier-1 through ``tools/analyze.py`` and
+Eight rule families gate tier-1 through ``tools/analyze.py`` and
 ``tests/test_static_analysis.py``:
 
 * ``lock-discipline`` — ``# GUARDED_BY(lock)`` / ``# HOLDS(lock)``
@@ -14,6 +14,9 @@ Seven rule families gate tier-1 through ``tools/analyze.py`` and
   other indefinite waits) inside a ``with lock:`` block.
 * ``donated-reuse`` — reads of an array after it was passed through
   ``donate_argnums`` / a donated ``lax.scan`` carry.
+* ``donation-discipline`` — the ``state = step(state, ...)`` rebind
+  idiom calling a jit with NO ``donate_argnums``: the input buffer is
+  dead after the call, yet both copies stay resident per dispatch.
 * ``metric-cardinality`` — registry metric names built from
   runtime-variable f-strings/concats outside the allowlisted scope
   pattern (unbounded label cardinality is the classic registry leak).

@@ -43,7 +43,8 @@ __all__ = [
 # 'family:check' for a specific sub-rule).
 ALL_RULES = ('lock-discipline', 'jit-hazard', 'recompile-hazard',
              'dead-code', 'blocking-under-lock', 'donated-reuse',
-             'metric-cardinality', 'waiver-discipline')
+             'donation-discipline', 'metric-cardinality',
+             'waiver-discipline')
 
 _GUARDED_BY_RE = re.compile(r'GUARDED_BY\(\s*([^)]+?)\s*\)')
 _HOLDS_RE = re.compile(r'HOLDS\(\s*([^)]+?)\s*\)')
@@ -246,6 +247,7 @@ def run_checkers(program: Program, checkers=None) -> List[Finding]:
   from tensor2robot_tpu.analysis import blocking_under_lock
   from tensor2robot_tpu.analysis import dead_code
   from tensor2robot_tpu.analysis import donated_reuse
+  from tensor2robot_tpu.analysis import donation_discipline
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
   from tensor2robot_tpu.analysis import metric_cardinality
@@ -255,7 +257,7 @@ def run_checkers(program: Program, checkers=None) -> List[Finding]:
     checkers = (lock_discipline.check, jit_hazards.check,
                 recompile_hazards.check, dead_code.check,
                 blocking_under_lock.check, donated_reuse.check,
-                metric_cardinality.check)
+                donation_discipline.check, metric_cardinality.check)
   findings: List[Finding] = []
   for module in program.modules:
     for checker in checkers:

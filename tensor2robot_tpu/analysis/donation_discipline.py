@@ -1,0 +1,159 @@
+"""Donation-discipline checker: rebind idiom without ``donate_argnums``.
+
+The mirror image of ``donated_reuse``: that rule catches donating too
+*eagerly* (reading a buffer after giving it away); this one catches not
+donating at all when the call site proves donation is free. The
+``state = step(state, batch)`` rebind idiom is that proof — the caller
+overwrites its only reference to the argument with the result, so the
+old buffer is dead the moment the call returns. A jitted step-shaped
+function called this way WITHOUT ``donate_argnums`` keeps two full
+copies of the train state resident (input + output) for the duration of
+every dispatch: on a memory-bound TPU program that is the difference
+between a batch size fitting and the 8.6× HBM-pressure cliff the batch
+curve shows. The runtime twin is the program ledger's donation audit
+(``observability/programs.py`` records requested vs actually-aliased
+parameters per executable); this rule catches the hazard before the
+program ever compiles.
+
+One finding shape:
+
+* ``undonated-rebind`` — a call site rebinds a result over a positional
+  argument name (``x = f(x, ...)`` / ``x, aux = f(x, ...)``) of a
+  callable KNOWN to be jitted without any donation spec: a name bound
+  from ``jax.jit(...)`` with no ``donate_argnums``/``donate_argnames``
+  (direct assign, local-factory return, or ``@jax.jit`` /
+  ``@partial(jax.jit, ...)`` decorator).
+
+Calls to donating jits are ``donated_reuse``'s jurisdiction and never
+fire here. Waive intentional non-donation inline with
+``# ANALYSIS_OK(donation-discipline): <why the input buffer must
+survive the call — e.g. it is re-read on rollback>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'donation-discipline'
+
+_JIT_WRAPPERS = {'jax.jit', 'jit', 'jax.pjit', 'pjit'}
+_PARTIAL_NAMES = {'functools.partial', 'partial'}
+_DONATE_KWARGS = ('donate_argnums', 'donate_argnames')
+
+
+def _jit_call_donation(call: ast.Call) -> Optional[bool]:
+  """None if not a jit(...) call; else True when it donates.
+
+  ``partial(jax.jit, ...)`` counts as a jit call (the decorator idiom);
+  a donate kwarg anywhere in the call counts as donating — positions
+  don't matter here, only whether the author THOUGHT about donation.
+  """
+  name = core.call_name(call)
+  if name in _PARTIAL_NAMES and call.args:
+    inner = core.expr_text(call.args[0])
+    if inner not in _JIT_WRAPPERS:
+      return None
+  elif name not in _JIT_WRAPPERS:
+    return None
+  return any(kw.arg in _DONATE_KWARGS for kw in call.keywords)
+
+
+def _nondonating_names(module: core.ModuleInfo) -> Dict[str, int]:
+  """Names bound to jitted callables with NO donation spec → def line."""
+  # Local factories whose return value is a donation-less jit: the name
+  # a caller binds the factory's result to inherits the hazard.
+  factory_lines: Dict[str, int] = {}
+  for fn in core.func_defs(module.tree):
+    for node in ast.walk(fn):
+      if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+        donates = _jit_call_donation(node.value)
+        if donates is False:
+          factory_lines[fn.name] = node.value.lineno
+        elif donates:
+          factory_lines.pop(fn.name, None)
+  out: Dict[str, int] = {}
+  for node in ast.walk(module.tree):
+    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+      donates = _jit_call_donation(node.value)
+      line: Optional[int] = None
+      if donates is False:
+        line = node.value.lineno
+      elif donates is None:
+        callee = core.call_name(node.value)
+        if callee is not None:
+          leaf = callee.rsplit('.', 1)[-1]
+          line = factory_lines.get(callee, factory_lines.get(leaf))
+      if line is not None:
+        for target in node.targets:
+          text = core.expr_text(target)
+          if text is not None:
+            out[text] = line
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      # @jax.jit (bare) or @jax.jit(...)/@partial(jax.jit, ...) without
+      # a donate kwarg marks the function name itself.
+      for dec in node.decorator_list:
+        if isinstance(dec, ast.Call):
+          donates = _jit_call_donation(dec)
+          if donates is False:
+            out[node.name] = node.lineno
+          elif donates:
+            out.pop(node.name, None)
+        elif core.expr_text(dec) in _JIT_WRAPPERS:
+          out[node.name] = node.lineno
+  return out
+
+
+def _target_names(stmt: ast.Assign) -> Set[str]:
+  names: Set[str] = set()
+  for target in stmt.targets:
+    for node in ast.walk(target):
+      if isinstance(node, ast.Name):
+        names.add(node.id)
+  return names
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+  nondonating = _nondonating_names(module)
+  if not nondonating:
+    return findings
+
+  def scopes():
+    yield module.tree
+    yield from core.func_defs(module.tree)
+
+  for scope in scopes():
+    for stmt in core.walk_scope(scope):
+      if not (isinstance(stmt, ast.Assign)
+              and isinstance(stmt.value, ast.Call)):
+        continue
+      call = stmt.value
+      callee = core.call_name(call)
+      if callee not in nondonating:
+        continue
+      rebound = _target_names(stmt)
+      arg_names = [a.id for a in call.args if isinstance(a, ast.Name)]
+      overlap = sorted(rebound.intersection(arg_names))
+      if not overlap:
+        continue
+      symbol = core.qualname(module, scope) if isinstance(
+          scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else ''
+      positions = ', '.join(
+          str(i) for i, a in enumerate(call.args)
+          if isinstance(a, ast.Name) and a.id in overlap)
+      findings.append(core.Finding(
+          rule=RULE, check='undonated-rebind', path=module.rel_path,
+          line=stmt.lineno, symbol=symbol,
+          message=(f'{overlap[0]!r} is rebound over the result of '
+                   f'{callee}(...) — the input buffer is dead after the '
+                   'call, but the jit (line '
+                   f'{nondonating[callee]}) has no donate_argnums: both '
+                   'copies stay resident through every dispatch. Donate '
+                   f'argnums ({positions}) to let XLA reuse the buffer '
+                   'in place.')))
+  return findings

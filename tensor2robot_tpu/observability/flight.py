@@ -33,7 +33,7 @@ Event shape: ``(time.time(), kind, name, detail)`` where ``kind`` is a
 coarse subsystem tag (``'span' | 'dispatch' | 'checkpoint' | 'swap' |
 'nonfinite' | 'budget' | 'shutdown' | 'liveness' | 'request' |
 'router' | 'balancer' | 'slo' | 'anomaly' | 'collect' | 'actuator' |
-'chaos' | 'error'``), ``name`` a
+'chaos' | 'program' | 'error'``), ``name`` a
 slash-scoped identifier like metric names, and ``detail`` a short
 ``k=v``-style string (machine-greppable: the postmortem renderer parses
 ``dur_ms=`` / ``id=`` tokens out of it). ``'router'`` carries the
@@ -53,7 +53,11 @@ every closed-loop fleet action — applied, dry-run, budget-denied, or
 refused — with the signals that justified it
 (``observability/actuator.py``), and ``'chaos'`` the chaos harness's
 fault injections/clears (``utils/chaos.py``): a soak's verdict is read
-by joining the two on the same timeline.
+by joining the two on the same timeline. ``'program'`` carries the
+compiled-program ledger's steady-state recompile flags
+(``observability/programs.py``) — the runtime twin of the static
+``recompile-hazard`` rule, landed within the dispatch that paid the
+recompile.
 """
 
 from __future__ import annotations

@@ -73,6 +73,26 @@ def record_memory_gauges(device=None) -> Dict[str, int]:
   return out
 
 
+def sample_page_event(device=None) -> Dict[str, int]:
+  """Allocator sample from the serving router's page-in/page-out path.
+
+  The ``device/memory/*`` gauges used to refresh only at trainer log
+  crossings — a serving host that never trains kept stale (or no)
+  allocator truth while the router's own ``serving/router/
+  hbm_resident_bytes`` accounting moved. Sampling at every page
+  *transition* (not every routed submit) keeps the two cross-checkable
+  exactly when residency changed, at zero steady-state cost. Counted
+  (``device/memory/page_event_samples``) so the cross-check itself is
+  auditable; never raises (same contract as every entry point here).
+  """
+  try:
+    stats = record_memory_gauges(device)
+  except Exception:  # pylint: disable=broad-except
+    return {}
+  metrics_lib.counter('device/memory/page_event_samples').inc()
+  return stats
+
+
 def memory_scalars(device=None) -> Dict[str, float]:
   """Train-scalar view (MB) the trainer merges at log crossings.
 

@@ -26,6 +26,11 @@ Endpoints:
                              (``?trace_id=`` / ``?request_id=`` filter;
                              ``?probe=1`` returns only the clock/service
                              header — the assembler's offset probe)
+  ``/programz``              the compiled-program ledger
+                             (``observability/programs.py``): per-
+                             executable FLOPs/bytes/fingerprint/
+                             donation-map records, diffable offline
+                             with ``tools/program_report.py``
   ``/healthz``               ``{"status": "ok"}`` — liveness probe
 """
 
@@ -162,11 +167,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
           trace_id=query.get('trace_id', [None])[0] or None,
           request_id=query.get('request_id', [None])[0] or None,
           probe_only=query.get('probe', [''])[0] not in ('', '0')))
+    elif path == '/programz':
+      from tensor2robot_tpu.observability import programs
+
+      self._reply(200, programs.document())
     elif path == '/healthz':
       self._reply(200, {'status': 'ok'})
     else:
       self._reply(404, {'error': f'unknown path {path!r}',
-                        'endpoints': ['/metricsz', '/tracez', '/healthz']})
+                        'endpoints': ['/metricsz', '/tracez', '/healthz',
+                                      '/programz']})
 
 
 class MetricsServer:

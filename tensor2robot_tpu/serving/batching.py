@@ -82,6 +82,7 @@ import numpy as np
 
 from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import metrics as metrics_lib
+from tensor2robot_tpu.observability import programs as programs_lib
 from tensor2robot_tpu.observability import tracing
 
 
@@ -300,17 +301,33 @@ class JitBucketExecutor:
       import jax
 
       t0 = time.perf_counter()
-      exe = jax.jit(self._fn).lower(
-          self._param_shapes, self._feature_shapes(bucket)).compile()
+      lowered = jax.jit(self._fn).lower(
+          self._param_shapes, self._feature_shapes(bucket))
+      exe = lowered.compile()
+      compile_seconds = time.perf_counter() - t0
       self._compiled[bucket] = exe
       metrics_lib.counter('serving/bucket_compiles').inc()
       metrics_lib.histogram('serving/bucket_compile_ms').observe(
-          1e3 * (time.perf_counter() - t0))
+          1e3 * compile_seconds)
+      # Program ledger: every serving bucket lands with its FLOPs/
+      # bytes/fingerprint, so /programz (and program_report.py --diff)
+      # can say whether e.g. a quantized arm actually shrank the
+      # program, and the per-model MFU gauge has its numerator.
+      programs_lib.record_compiled(
+          f'{self._label}/bucket/{bucket}', exe, lowered=lowered,
+          compile_seconds=compile_seconds, source='serving')
     return exe
 
   def warm(self) -> None:
     for bucket in self._buckets:
       self.ensure_bucket(bucket)
+
+  def dispatch_utilization(self, bucket: int,
+                           device_seconds: float) -> Dict[str, float]:
+    """Ledger-derived roofline numbers for ONE dispatch of ``bucket``
+    ({} until the bucket compiled, or with the ledger disabled)."""
+    return programs_lib.utilization(
+        f'{self._label}/bucket/{bucket}', 1, device_seconds)
 
   # ------------------------------------------------------------- HBM paging
 
@@ -821,7 +838,18 @@ class DynamicBatcher:
         flight.events_many([
             ('request', f'{prefix}/dispatched',
              'id=' + r.request_id + dispatched) for r in traced])
+      t_exec0 = time.perf_counter()
       outputs = model.execute(features, bucket)
+      exec_seconds = time.perf_counter() - t_exec0
+      if isinstance(model, JitBucketExecutor) and exec_seconds > 0:
+        # Per-model roofline gauges (scoped 'serving/model/<name>/mfu'
+        # under the router): execute() blocks on the device→host output
+        # reads, so this wall is a lower bound on device utilization.
+        # Explicit key set keeps the gauge names config-bounded.
+        util = model.dispatch_utilization(bucket, exec_seconds)
+        for key in ('mfu', 'hbm_gbps', 'tflops', 'roofline_fraction'):
+          if key in util:
+            metrics_lib.gauge(f'{prefix}/{key}').set(util[key])
       offset = 0
       for request in batch:
         request.outputs = {

@@ -46,6 +46,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from tensor2robot_tpu.observability import flight
+from tensor2robot_tpu.observability import memory as memory_lib
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.serving import batching as batching_lib
 
@@ -161,8 +162,10 @@ class ModelRouter:
       with self._lock:
         entry.last_used = next(self._use_seq)
     with self._lock:
-      self._enforce_budget_locked(keep=None)
+      paged = self._enforce_budget_locked(keep=None)
       self._publish_residency_locked()
+    if paged:
+      memory_lib.sample_page_event()
     self._m_models.set(float(len(self._entries)))
     self._m_budget.set(float(self._hbm_budget or 0))
     if self._register_report:
@@ -279,17 +282,25 @@ class ModelRouter:
     (so adoption never stalls a dispatch), which can transiently push
     the resident set over budget — the next submit converges it.
     """
+    paged = 0
     with self._lock:
       entry.last_used = next(self._use_seq)
       executor = entry.batcher.current_executor()
       if executor is None or self._hbm_budget is None:
         return
       resident = getattr(executor, 'resident', True)
-      self._enforce_budget_locked(
+      paged = self._enforce_budget_locked(
           keep=entry, incoming=0 if resident else int(executor.param_bytes))
       if not resident:
         executor.page_in()
+        paged += 1
       self._publish_residency_locked()
+    if paged:
+      # Residency just changed: refresh the allocator-truth gauges
+      # (device/memory/*) outside the lock, so hbm_resident_bytes and
+      # the backend's own accounting stay cross-checkable at exactly
+      # the moments they move (observability/memory.py).
+      memory_lib.sample_page_event()
 
   def _residency_locked(self):  # HOLDS(self._lock)
     """(entry, executor, bytes) for every currently resident model."""
@@ -301,8 +312,9 @@ class ModelRouter:
     return out
 
   def _enforce_budget_locked(self, keep: Optional[_ModelEntry],
-                             incoming: int = 0) -> None:  # HOLDS(self._lock)
-    """Pages out LRU residents until ``incoming`` more bytes fit.
+                             incoming: int = 0) -> int:  # HOLDS(self._lock)
+    """Pages out LRU residents until ``incoming`` more bytes fit;
+    returns the number of page-outs taken.
 
     Victims are idle models (no queued work) in LRU order; ``keep`` (the
     model being paged in) is never a victim. If every candidate is busy
@@ -310,11 +322,11 @@ class ModelRouter:
     ``serving/router/budget_overruns``).
     """
     if self._hbm_budget is None:
-      return
+      return 0
     resident = self._residency_locked()
     used = sum(b for _, _, b in resident)
     if used + incoming <= self._hbm_budget:
-      return
+      return 0
     victims = sorted(
         (x for x in resident if x[0] is not keep and x[2] > 0),
         key=lambda x: x[0].last_used)
@@ -322,16 +334,19 @@ class ModelRouter:
     # only bounce straight back in via the dispatcher's auto page-in.
     victims.sort(key=lambda x: (x[0].batcher.queue_depth > 0,
                                 x[0].last_used))
+    paged_out = 0
     for entry, executor, nbytes in victims:
       if used + incoming <= self._hbm_budget:
         break
       executor.page_out()
+      paged_out += 1
       used -= nbytes
     if used + incoming > self._hbm_budget:
       self._m_budget_overruns.inc()
       logging.warning(
           'HBM budget overrun: %d resident + %d incoming > budget %d '
           '(all candidate victims busy).', used, incoming, self._hbm_budget)
+    return paged_out
 
   def _publish_residency_locked(self) -> None:  # HOLDS(self._lock)
     resident = self._residency_locked()
@@ -366,8 +381,10 @@ class ModelRouter:
       if nbytes == old:
         return
       self._hbm_budget = nbytes
-      self._enforce_budget_locked(keep=None)
+      paged = self._enforce_budget_locked(keep=None)
       self._publish_residency_locked()
+    if paged:
+      memory_lib.sample_page_event()
     self._m_budget.set(float(nbytes or 0))
     flight.event('router', f'{self._metrics_prefix}/router/budget_resplit',
                  f'old={old} new={nbytes}')

@@ -26,6 +26,7 @@ import logging
 import os
 import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -38,6 +39,7 @@ from tensor2robot_tpu.observability import flight
 from tensor2robot_tpu.observability import memory as memory_lib
 from tensor2robot_tpu.observability import metrics as metrics_lib
 from tensor2robot_tpu.observability import postmortem as postmortem_lib
+from tensor2robot_tpu.observability import programs as programs_lib
 from tensor2robot_tpu.observability import tracing
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import SpecStruct
@@ -338,6 +340,25 @@ class TrainerConfig:
   # already). Costs a handful of perf_counter reads + registry updates
   # per dispatch; False restores the uninstrumented loop exactly.
   step_breakdown: bool = True
+  # Compiled-program ledger (observability/programs.py): record the
+  # train step's executable — cost_analysis FLOPs/bytes, memory
+  # analysis, fingerprint, donation map — once at compile time, derive
+  # live train/mfu + train/hbm_gbps + train/roofline_fraction at log
+  # crossings from the breakdown's device time, and watch the jit cache
+  # for steady-state recompiles (flagged as 'program' flight events
+  # within the dispatch that paid them). Per-dispatch cost is one C++
+  # cache-size probe + an int compare; the one-off AOT harvest of the
+  # jitted step runs on a daemon thread (a disk read when the
+  # persistent compilation cache is enabled).
+  program_ledger: bool = True
+  # When the auto-layout build did not already record 'train/step', the
+  # AOT harvest of the jitted step is a REAL second backend compile
+  # whose tracing contends (GIL) with the dispatch loop. Deferring it
+  # keeps short runs and benchmarks unpolluted — the timer is cancelled
+  # if the loop ends first (a post-run harvest serves no live gauge),
+  # and on any run longer than the delay the MFU gauges appear from the
+  # next log window on. 0 harvests immediately after the first dispatch.
+  program_harvest_delay_seconds: float = 5.0
   # Live metrics endpoint (observability/metricsz.py): serve
   # ``registry.report()`` JSON at http://127.0.0.1:<port>/metricsz from a
   # stdlib http.server daemon thread, for fleet scraping without touching
@@ -810,12 +831,16 @@ class _DispatchBreakdown:
     self._win_steps += steps
     self._win_examples += examples
 
-  def window_scalars(self) -> MetricDict:
+  def window_scalars(self, utilization_fn=None) -> MetricDict:
     """Drains the current log window into publishable scalars.
 
     ``goodput_examples_per_sec`` discounts examples whose updates the
     non-finite guard skipped on device — throughput that moved bytes
-    but trained nothing.
+    but trained nothing. ``utilization_fn(n_dispatches,
+    device_seconds)`` (the program ledger's MFU/HBM derivation) is
+    handed the window's device time before the drain and its scalars
+    ride the same merge; it publishes its own gauges, so it runs after
+    the ``trainer/``-prefixed gauge loop.
     """
     if not self.enabled or self._win_dispatches == 0:
       return {}
@@ -840,6 +865,11 @@ class _DispatchBreakdown:
     }
     for key, value in out.items():
       metrics_lib.gauge(f'trainer/{key}').set(value)
+    if utilization_fn is not None:
+      try:
+        out.update(utilization_fn(n, self._win['device'] / 1e3) or {})
+      except Exception:  # pylint: disable=broad-except
+        pass  # telemetry derivation must never stall a log crossing
     self._windows.inc()
     # Postmortem retention: the last K closed windows ride every
     # incident bundle (bounded ring in observability/postmortem.py).
@@ -917,6 +947,12 @@ class Trainer:
     self._auto_batch_avals = None  # GUARDED_BY(self._auto_build_lock)
     self._auto_disabled = not config.resolved_auto_input_layouts()  # GUARDED_BY(self._auto_build_lock)
     self._auto_build_lock = threading.Lock()
+    # Whether 'train/step' landed in the program ledger (set by the
+    # auto-step build or the off-thread jitted-step harvest, whichever
+    # compiles the dispatched program). Plain bool, single-writer-ish:
+    # a racing reader at worst harvests a duplicate record of the SAME
+    # program, which the ledger de-duplicates by fingerprint.
+    self._program_recorded = False
     # Step the current dispatch started from; callbacks use crossed() so
     # their interval semantics survive steps_per_dispatch > 1.
     self._dispatch_start_step = 0
@@ -965,8 +1001,12 @@ class Trainer:
     # compiled by a previous incarnation load from disk instead of
     # recompiling (measured by restart_to_first_step_seconds below).
     from tensor2robot_tpu.utils.compilation_cache import (
-        maybe_enable_compilation_cache)
+        install_compile_counters, maybe_enable_compilation_cache)
 
+    # Cache-hit/miss + backend-compile-seconds counters ride jax's
+    # monitoring events whether or not the persistent cache is on: the
+    # restart-goodput gauge gets its cause line either way.
+    install_compile_counters()
     maybe_enable_compilation_cache(config.compilation_cache_dir)
 
   # ------------------------------------------------------------- properties
@@ -1169,6 +1209,62 @@ class Trainer:
         out_shardings=(state_sharding, None),
         donate_argnums=(0,))
 
+  def _capture_program_avals(self, cell, features, labels) -> None:
+    """Fills ``cell`` with (avals, donated_leaves) for the harvest.
+
+    Shape/dtype/sharding only — no batch buffers are retained. A
+    ~tree-size-microseconds cost paid once, at the first dispatch (the
+    expensive part of harvesting, the AOT compile, runs elsewhere).
+    """
+    try:
+      def to_aval(x):
+        return jax.ShapeDtypeStruct(
+            np.shape(x), getattr(x, 'dtype', None) or np.result_type(x),
+            sharding=getattr(x, 'sharding', None))
+
+      avals = jax.tree_util.tree_map(
+          to_aval, (self._state, features, labels))
+      cell.append((avals, len(jax.tree_util.tree_leaves(self._state))))
+    except Exception:  # pylint: disable=broad-except
+      pass
+
+  def _program_harvest_fn(self, cell, loop_live_fn=None):
+    """The deferred ledger record of the jitted step ('train/step').
+
+    jax's on-call executable cache is not readable from the outside, so
+    harvesting cost/memory analysis for the dispatched program means
+    one AOT ``lower().compile()`` of the same program — a real second
+    backend compile (a disk read when the persistent compilation cache
+    is on). Its tracing half holds the GIL and would contend with the
+    dispatch loop, so the loop runs this DEFERRED (a Timer created at
+    loop setup, outside any measured dispatch) by
+    ``program_harvest_delay_seconds``, or on an immediate daemon thread
+    at delay 0. Bails when the loop already ended (``loop_live_fn``),
+    when another path recorded the program (the auto-layout build), or
+    when the first dispatch never filled ``cell``.
+    """
+    step_fn = self._train_step_fn
+
+    def harvest():
+      if loop_live_fn is not None and not loop_live_fn():
+        return  # the run already ended: no live gauge to feed
+      if self._program_recorded or not cell:
+        return
+      avals, donated_params = cell[0]
+      if programs_lib.record_jitted(
+          'train/step', step_fn, avals, donate_argnums=(0,),
+          donated_params=donated_params, source='trainer/jit_step'):
+        self._program_recorded = True
+
+    return harvest
+
+  def _program_utilization(self, n_dispatches: int,
+                           device_seconds: float) -> MetricDict:
+    """train/mfu + train/hbm_gbps + train/roofline_fraction for one
+    closed log window (empty until 'train/step' is recorded)."""
+    return programs_lib.utilization_scalars(
+        'train/step', n_dispatches, device_seconds, scope='train')
+
   def _maybe_build_auto_step(self, features, labels) -> bool:
     """Compiles the train step with compiler-chosen (AUTO) batch layouts.
 
@@ -1201,7 +1297,12 @@ class Trainer:
             in_shardings=(state_sharding, auto, auto),
             out_shardings=(state_sharding, None),
             donate_argnums=(0,))
-        compiled = jitted.lower(self._state, features, labels).compile()
+        t_compile0 = time.perf_counter()
+        with warnings.catch_warnings(record=True) as caught:
+          warnings.simplefilter('always')
+          lowered = jitted.lower(self._state, features, labels)
+          compiled = lowered.compile()
+        compile_seconds = time.perf_counter() - t_compile0
         (state_fmt, feat_fmt, label_fmt), _ = input_formats_of(compiled)
         leaves, treedef = jax.tree_util.tree_flatten((features, labels))
         self._auto_batch_avals = (
@@ -1219,6 +1320,20 @@ class Trainer:
           raise ValueError('state layout mismatch vs compiled step')
         self._batch_formats = (feat_fmt, label_fmt)
         self._auto_step = compiled
+        if self._config.program_ledger:
+          # This executable IS the program driving steady-state
+          # dispatches, so it owns the 'train/step' ledger entry (the
+          # off-thread jitted-step harvest is skipped — see the
+          # _program_recorded check in _train_loop).
+          self._program_recorded = True
+          programs_lib.record_compiled(
+              'train/step', compiled, lowered=lowered,
+              compile_seconds=compile_seconds, donate_argnums=(0,),
+              donated_params=len(jax.tree_util.tree_leaves(self._state)),
+              captured_warnings=[
+                  str(w.message) for w in caught
+                  if 'donat' in str(w.message).lower()],
+              source='trainer/auto_step')
         return True
       except Exception as e:  # pylint: disable=broad-except
         logging.info(
@@ -1401,6 +1516,30 @@ class Trainer:
     step = self.step
     last_log_step = step
     breakdown = _DispatchBreakdown(config.step_breakdown)
+    # Compiled-program plane (observability/programs.py): one ledger
+    # harvest after the first dispatch, a cache-size probe per dispatch
+    # (the steady-state recompile sentinel), and MFU/HBM gauges derived
+    # at log crossings from the breakdown's device time.
+    programs_on = config.program_ledger and programs_lib.enabled()
+    program_harvest_pending = programs_on
+    program_harvest_timer = None
+    program_aval_cell: list = []  # filled at the first dispatch
+    program_loop_live = [True]  # flipped by teardown; read at timer fire
+    program_harvest_delay = max(
+        0.0, float(config.program_harvest_delay_seconds))
+    if programs_on and program_harvest_delay > 0:
+      # Created HERE, at loop setup: Timer/thread creation costs ~1 ms,
+      # which inside the loop would land in one measured dispatch wall
+      # (visible on the zero-overhead pin for short runs).
+      program_harvest_timer = threading.Timer(
+          program_harvest_delay,
+          self._program_harvest_fn(
+              program_aval_cell, loop_live_fn=lambda: program_loop_live[0]))
+      program_harvest_timer.daemon = True
+      program_harvest_timer.start()
+    recompile_probe = (
+        programs_lib.dispatch_probe(self._train_step_fn, 'train/step')
+        if programs_on else None)
     # Resilience counters are published as deltas against this run's
     # starting point (the registry is process-global).
     resilience_snap = metrics_lib.snapshot('resilience/')
@@ -1581,6 +1720,22 @@ class Trainer:
             examples=int(np.prod(batch_leaves[0].shape[:2]))
             if self._loop_k > 1 and batch_leaves
             else (batch_leaves[0].shape[0] if batch_leaves else 0))
+        if program_harvest_pending:
+          # First dispatch done: the program (and its avals) are final.
+          # If the auto-layout build already recorded 'train/step', the
+          # AOT harvest of the jitted twin would be a duplicate.
+          program_harvest_pending = False
+          if not self._program_recorded:
+            self._capture_program_avals(
+                program_aval_cell, features, labels)
+            if program_harvest_delay <= 0:
+              threading.Thread(
+                  target=self._program_harvest_fn(program_aval_cell),
+                  name='t2r-program-ledger', daemon=True).start()
+        if recompile_probe is not None:
+          # One C++ cache-size read + int compare per dispatch: growth
+          # after warmup means steady state just paid a trace+compile.
+          recompile_probe()
         if flight.enabled():
           # One flight event per dispatch boundary: the incident ring's
           # backbone timeline (~1 µs; the ring is bounded, so even
@@ -1611,7 +1766,9 @@ class Trainer:
           # Step-time breakdown + resilience counters ride the normal
           # scalars dict, so MetricsLogger/TensorBoard publish them with
           # zero call-site changes.
-          scalars.update(breakdown.window_scalars())
+          scalars.update(breakdown.window_scalars(
+              utilization_fn=(self._program_utilization
+                              if programs_on else None)))
           # HBM gauges (peak/live bytes) ride the same scalar merge, so
           # TensorBoard shows memory beside throughput; no-op (empty) on
           # backends without allocator stats (CPU).
@@ -1638,6 +1795,12 @@ class Trainer:
              step >= config.max_train_steps)):
           eval_metrics = self.evaluate(eval_iter_fn())
     finally:
+      # A still-pending deferred harvest serves no live gauge once the
+      # loop ends — cancel it (and tell an already-fired one to bail)
+      # so short runs and benchmarks never pay the AOT compile.
+      program_loop_live[0] = False
+      if program_harvest_timer is not None:
+        program_harvest_timer.cancel()
       if prefetcher is not None:
         prefetcher.close()
       if self._heartbeat is not None:

@@ -25,6 +25,63 @@ ENV_VAR = 'T2R_COMPILATION_CACHE_DIR'
 
 _lock = threading.Lock()
 _enabled_dir: Optional[str] = None  # GUARDED_BY(_lock)
+_counters_installed = False  # GUARDED_BY(_lock)
+
+
+def install_compile_counters() -> bool:
+  """Wires jax's monitoring events into compile/cache counters.
+
+  Registers process-wide listeners translating jax's internal
+  monitoring stream into the metrics registry:
+
+  * ``compile/cache_hits`` / ``compile/cache_misses`` — persistent
+    compilation-cache outcomes (``/jax/compilation_cache/*`` events),
+    the cause line next to ``trainer/restart_to_first_step_seconds``:
+    a slow restart with misses recompiled, one with hits paid disk.
+  * ``compile/backend_compiles`` / ``compile/compile_seconds`` — every
+    XLA backend compile and its total wall time (the denominator
+    restart goodput is trying to erase).
+
+  Idempotent, False (and silent) when jax or its monitoring module is
+  unavailable — same never-raises contract as the cache enabling.
+  """
+  global _counters_installed
+  with _lock:
+    if _counters_installed:
+      return True
+    try:
+      from jax import monitoring
+
+      from tensor2robot_tpu.observability import metrics as metrics_lib
+
+      hits = metrics_lib.counter('compile/cache_hits')
+      misses = metrics_lib.counter('compile/cache_misses')
+      compiles = metrics_lib.counter('compile/backend_compiles')
+      seconds = metrics_lib.counter('compile/compile_seconds')
+
+      # Suffix-matched (not equality) so minor jax event renames keep
+      # counting; the callbacks run inside jax's compile path and must
+      # stay allocation-light and exception-free.
+      def on_event(name: str, **kwargs) -> None:
+        del kwargs
+        if name.endswith('/cache_hits'):
+          hits.inc()
+        elif name.endswith('/cache_misses'):
+          misses.inc()
+
+      def on_duration(name: str, duration_secs: float, **kwargs) -> None:
+        del kwargs
+        if name.endswith('/backend_compile_duration'):
+          compiles.inc()
+          seconds.inc(duration_secs)
+
+      monitoring.register_event_listener(on_event)
+      monitoring.register_event_duration_secs_listener(on_duration)
+      _counters_installed = True
+      return True
+    except Exception as e:  # pylint: disable=broad-except
+      logging.info('Compile counters unavailable: %r', e)
+      return False
 
 
 def enabled_dir() -> Optional[str]:
@@ -80,4 +137,9 @@ def maybe_enable_compilation_cache(
     except Exception as e:  # pylint: disable=broad-except
       logging.warning('Could not enable compilation cache at %r: %r',
                       resolved, e)
+  # Hit/miss/compile-time counters are meaningful exactly when the
+  # cache is in play; installed outside the state lock (the installer
+  # takes it itself).
+  install_compile_counters()
+  with _lock:
     return _enabled_dir

@@ -819,6 +819,85 @@ def bad(state, batch):
     assert [f.check for f in findings] == ['use-after-donate']
 
 
+# ====================================================== donation discipline
+
+
+DONATION_DISC_BAD = '''
+import functools
+import jax
+
+step = jax.jit(update)                    # jitted, NO donate_argnums
+
+@jax.jit
+def decorated_step(state, batch):
+  return state, 0.0
+
+def make_step():
+  return jax.jit(update, static_argnums=(2,))
+
+run_step = make_step()
+
+
+def train(state, batch):
+  state = step(state, batch)              # BAD: rebind of undonated jit
+  state, aux = decorated_step(state, batch)   # BAD: decorator form
+  state = run_step(state, batch, 1)       # BAD: factory-bound form
+  return state, aux
+'''
+
+DONATION_DISC_GOOD = '''
+import functools
+import jax
+
+step = jax.jit(update, donate_argnums=(0,))   # donating: donated-reuse turf
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decorated_step(state, batch):
+  return state
+
+plain = jax.jit(update)
+
+
+def train(state, batch):
+  state = step(state, batch)              # donating rebind: the idiom
+  state = decorated_step(state, batch)    # ditto via partial decorator
+  out = plain(state, batch)               # no rebind over an argument
+  preds = plain(batch, batch)             # result bound elsewhere
+  return out, preds
+'''
+
+
+class TestDonationDiscipline:
+
+  def test_fires_on_undonated_rebind_idioms(self):
+    findings = _unwaived(_analyze(DONATION_DISC_BAD),
+                         'donation-discipline')
+    assert len(findings) == 3, findings
+    assert all(f.check == 'undonated-rebind' for f in findings)
+    assert all(f.symbol == 'train' for f in findings)
+    messages = ' '.join(f.message for f in findings)
+    assert "'state'" in messages and 'donate_argnums' in messages
+    # Each finding names the jit definition line it wants donated.
+    assert all('line' in f.message for f in findings)
+
+  def test_quiet_on_donating_and_non_rebind_calls(self):
+    assert _unwaived(_analyze(DONATION_DISC_GOOD),
+                     'donation-discipline') == []
+
+  def test_waiver_suppresses_with_reason(self):
+    source = DONATION_DISC_BAD.replace(
+        'state = step(state, batch)              '
+        '# BAD: rebind of undonated jit',
+        'state = step(state, batch)  '
+        '# ANALYSIS_OK(donation-discipline): rollback re-reads the input')
+    findings = _analyze(source)
+    waived = [f for f in findings if f.rule == 'donation-discipline'
+              and f.waived]
+    assert len(waived) == 1
+    assert waived[0].waiver_reason.startswith('rollback')
+    assert len(_unwaived(findings, 'donation-discipline')) == 2
+
+
 # ========================================================= metric cardinality
 
 

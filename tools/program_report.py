@@ -1,0 +1,167 @@
+"""Render / diff compiled-program ledger dumps.
+
+Consumes the ledger JSON the framework emits three ways — a
+``programs.dump(path)`` file, a ``curl /programz`` capture, or a
+``bench.py`` stdout log (the ``{"metric": "program_ledger", ...}``
+line is found automatically inside a JSONL stream):
+
+    python tools/program_report.py /tmp/run/programs.json
+    python tools/program_report.py --diff bench_arm_a.log bench_arm_b.log
+
+Default: one row per program (GFLOPs, MB accessed, peak MB, compile
+seconds, donation map ``aliased/requested``, fingerprint prefix,
+recompiles). ``--diff A B`` matches programs by name across two dumps
+and prints the bytes-accessed and FLOPs deltas — the table that settles
+a kernel_policy A/B argument: if arm B's headline is faster, its step
+program's bytes-accessed should be smaller, and this shows by how much.
+Fingerprints use the location-stripped StableHLO digest
+(``observability/programs.py``), so equal fingerprints across arms mean
+XLA compiled the *same* program and the delta is pure measurement noise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_ledger(path: str) -> dict:
+  """A ledger document from a dump file, /programz body, or bench log.
+
+  A plain JSON object with a ``programs`` key is used directly; a JSONL
+  stream (bench stdout) is scanned bottom-up for the last
+  ``program_ledger`` metric line, so re-running bench into the same log
+  reports the freshest ledger.
+  """
+  with open(path, encoding='utf-8') as f:
+    text = f.read()
+  try:
+    doc = json.loads(text)
+    if isinstance(doc, dict) and 'programs' in doc:
+      return doc
+  except ValueError:
+    pass
+  for line in reversed(text.splitlines()):
+    line = line.strip()
+    if not line:
+      continue
+    try:
+      doc = json.loads(line)
+    except ValueError:
+      continue
+    if isinstance(doc, dict) and doc.get('metric') == 'program_ledger':
+      return doc
+  raise ValueError(
+      f'{path!r} holds neither a ledger document nor a bench log with a '
+      'program_ledger line')
+
+
+def by_name(doc: dict) -> Dict[str, dict]:
+  return {p.get('name', '?'): p for p in doc.get('programs', [])}
+
+
+def _donated(rec: dict) -> str:
+  requested = rec.get('donated_params')
+  if requested is None:
+    return '-'
+  return f'{rec.get("aliased_params", "?")}/{requested}'
+
+
+def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
+  widths = [len(h) for h in headers]
+  for row in rows:
+    for i, cell in enumerate(row):
+      widths[i] = max(widths[i], len(cell))
+  def line(cells):
+    return '  '.join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+  return '\n'.join([line(headers), line(['-' * w for w in widths])]
+                   + [line(r) for r in rows])
+
+
+def render(doc: dict) -> str:
+  rows = []
+  for name in sorted(by_name(doc)):
+    rec = by_name(doc)[name]
+    rows.append([
+        name,
+        f'{rec.get("flops", 0) / 1e9:.3f}',
+        f'{rec.get("bytes_accessed", 0) / 1e6:.3f}',
+        f'{rec.get("peak_bytes", 0) / 1e6:.3f}',
+        f'{rec.get("compile_seconds", 0):.3f}',
+        _donated(rec),
+        str(rec.get('fingerprint', ''))[:12] or '-',
+        str(rec.get('recompiles', 0)),
+    ])
+  if not rows:
+    return '(empty ledger)'
+  table = _fmt_table(
+      ['program', 'gflops', 'mb_accessed', 'peak_mb', 'compile_s',
+       'donated', 'fingerprint', 'recompiles'], rows)
+  totals = (f'\n{len(rows)} program(s), '
+            f'steady_state_recompiles={doc.get("steady_state_recompiles", 0)}')
+  return table + totals
+
+
+def _pct(new: float, old: float) -> str:
+  if not old:
+    return '-'
+  return f'{(new - old) / old * 100:+.1f}%'
+
+
+def render_diff(doc_a: dict, doc_b: dict,
+                label_a: str = 'A', label_b: str = 'B') -> str:
+  """Per-program bytes-accessed / FLOPs delta table (B relative to A)."""
+  a, b = by_name(doc_a), by_name(doc_b)
+  rows = []
+  for name in sorted(set(a) | set(b)):
+    ra, rb = a.get(name), b.get(name)
+    if ra is None or rb is None:
+      rows.append([name, 'only in ' + (label_b if ra is None else label_a),
+                   '-', '-', '-', '-'])
+      continue
+    bytes_a = ra.get('bytes_accessed', 0)
+    bytes_b = rb.get('bytes_accessed', 0)
+    flops_a, flops_b = ra.get('flops', 0), rb.get('flops', 0)
+    same_fp = (ra.get('fingerprint') and
+               ra.get('fingerprint') == rb.get('fingerprint'))
+    rows.append([
+        name,
+        f'{(bytes_b - bytes_a) / 1e6:+.3f}',
+        _pct(bytes_b, bytes_a),
+        f'{(flops_b - flops_a) / 1e9:+.3f}',
+        _pct(flops_b, flops_a),
+        'same' if same_fp else 'differs',
+    ])
+  if not rows:
+    return '(no programs in either ledger)'
+  return _fmt_table(
+      ['program', f'mb_accessed {label_b}-{label_a}', 'Δbytes%',
+       f'gflops {label_b}-{label_a}', 'Δflops%', 'fingerprint'], rows)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+  parser.add_argument('paths', nargs='+',
+                      help='ledger dump(s): JSON file, /programz body, '
+                           'or bench JSONL log')
+  parser.add_argument('--diff', action='store_true',
+                      help='diff exactly two dumps (bytes/FLOPs deltas)')
+  args = parser.parse_args(argv)
+  if args.diff:
+    if len(args.paths) != 2:
+      parser.error('--diff takes exactly two paths')
+    print(render_diff(load_ledger(args.paths[0]), load_ledger(args.paths[1]),
+                      label_a=args.paths[0], label_b=args.paths[1]))
+    return 0
+  for path in args.paths:
+    if len(args.paths) > 1:
+      print(f'== {path}')
+    print(render(load_ledger(path)))
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
