@@ -285,6 +285,100 @@ def bench_kernel_fp8_ab():
                       'error': repr(e)[:200]}))
 
 
+def bench_device_feed_ab(steps_per_dispatch: int = 8):
+  """Device-feed + fused-update A/B through the REAL dispatch loop.
+
+  ``qtopt_device_feed_step_ms`` runs the batch-32 qtopt train LOOP
+  (``measure_baselines --qtopt-batch 32 --loop``) with
+  ``device_feed`` off vs on at the same ``steps_per_dispatch=K`` — the
+  delta is the per-step dispatch + H2D tax the single-burst path
+  removes (both arms pay identical compute, so this line moves only
+  when transport/dispatch overhead does). The on-arm's
+  ``h2d_dispatches_per_step`` counter line is ASSERTED at exactly 1/K:
+  a drift means a second placement or dispatch leaked into the loop and
+  the arm's ms/step is comparing different work. ``qtopt_fused_update_ms``
+  A/Bs ``TrainerConfig.fused_update`` (ops/fused_update.py) at K=1.
+  Each arm runs in its OWN subprocess, same isolation rationale as
+  bench_kernel_fp8_ab. BENCH_r06 gates both knobs' defaults on these
+  lines (slower-than-XLA arms get deleted, never shipped).
+  """
+  import os
+  import subprocess
+  import sys
+
+  tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tools',
+                      'measure_baselines.py')
+
+  def point(extra):
+    args = [sys.executable, tool, '--qtopt-batch', '32', '--loop'] + extra
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          timeout=1800)
+    for out_line in proc.stdout.splitlines():
+      if out_line.startswith('{'):
+        return json.loads(out_line)
+    raise RuntimeError(f'{extra}: no JSON line; '
+                       f'stderr: {proc.stderr[-300:]}')
+
+  k = steps_per_dispatch
+  base_ms = None
+  try:
+    off = point(['--steps-per-dispatch', str(k)])
+    base_ms = off.get('loop_ms_per_step')
+    print(json.dumps({
+        'metric': 'qtopt_device_feed_step_ms',
+        'device_feed': False,
+        'steps_per_dispatch': k,
+        'loop_ms_per_step': base_ms,
+    }))
+    on = point(['--steps-per-dispatch', str(k), '--device-feed'])
+    on_ms = on.get('loop_ms_per_step')
+    dps = on.get('dispatches_per_step')
+    puts = on.get('h2d_puts_per_step')
+    print(json.dumps({
+        'metric': 'qtopt_device_feed_step_ms',
+        'device_feed': True,
+        'steps_per_dispatch': k,
+        'loop_ms_per_step': on_ms,
+        'vs_off': (round(base_ms / on_ms, 3)
+                   if base_ms and on_ms else None),
+    }))
+    # The acceptance counter line: exactly ONE device_put and ONE
+    # dispatch per K steps on the device-feed arm.
+    ok = (dps is not None and puts is not None
+          and abs(dps - 1.0 / k) < 1e-9 and abs(puts - 1.0 / k) < 1e-9)
+    print(json.dumps({
+        'metric': 'h2d_dispatches_per_step',
+        'steps_per_dispatch': k,
+        'dispatches_per_step': dps,
+        'h2d_puts_per_step': puts,
+        'expected': round(1.0 / k, 6),
+        'ok': ok,
+    }))
+    if not ok:
+      raise AssertionError(
+          f'device-feed arm dispatched {dps}/step, placed {puts}/step; '
+          f'expected exactly {1.0 / k}/step')
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'qtopt_device_feed_step_ms',
+                      'error': repr(e)[:200]}))
+  try:
+    off = point([])
+    on = point(['--fused-update'])
+    off_ms = off.get('loop_ms_per_step')
+    on_ms = on.get('loop_ms_per_step')
+    print(json.dumps({
+        'metric': 'qtopt_fused_update_ms',
+        'loop_ms_per_step': on_ms,
+        'stock_ms_per_step': off_ms,
+        'vs_stock': (round(off_ms / on_ms, 3)
+                     if off_ms and on_ms else None),
+        'note': 'parity band vs optax gated in tier-1 (-m feed)',
+    }))
+  except Exception as e:  # pylint: disable=broad-except
+    print(json.dumps({'metric': 'qtopt_fused_update_ms',
+                      'error': repr(e)[:200]}))
+
+
 def bench_h2d_transport(host_batch):
   """Transport context for the record-fed metrics.
 
@@ -1390,6 +1484,11 @@ def main():
       bench_kernel_fp8_ab()
     except Exception as e:
       print(json.dumps({'metric': 'qtopt_kernel_step_ms',
+                        'error': repr(e)[:200]}))
+    try:
+      bench_device_feed_ab()
+    except Exception as e:
+      print(json.dumps({'metric': 'qtopt_device_feed_step_ms',
                         'error': repr(e)[:200]}))
     try:
       bench_h2d_transport(batches[0][0])

@@ -44,7 +44,7 @@ __all__ = [
 ALL_RULES = ('lock-discipline', 'jit-hazard', 'recompile-hazard',
              'dead-code', 'blocking-under-lock', 'donated-reuse',
              'donation-discipline', 'metric-cardinality',
-             'waiver-discipline')
+             'h2d-in-loop', 'waiver-discipline')
 
 _GUARDED_BY_RE = re.compile(r'GUARDED_BY\(\s*([^)]+?)\s*\)')
 _HOLDS_RE = re.compile(r'HOLDS\(\s*([^)]+?)\s*\)')
@@ -248,6 +248,7 @@ def run_checkers(program: Program, checkers=None) -> List[Finding]:
   from tensor2robot_tpu.analysis import dead_code
   from tensor2robot_tpu.analysis import donated_reuse
   from tensor2robot_tpu.analysis import donation_discipline
+  from tensor2robot_tpu.analysis import h2d_in_loop
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
   from tensor2robot_tpu.analysis import metric_cardinality
@@ -257,7 +258,8 @@ def run_checkers(program: Program, checkers=None) -> List[Finding]:
     checkers = (lock_discipline.check, jit_hazards.check,
                 recompile_hazards.check, dead_code.check,
                 blocking_under_lock.check, donated_reuse.check,
-                donation_discipline.check, metric_cardinality.check)
+                donation_discipline.check, metric_cardinality.check,
+                h2d_in_loop.check)
   findings: List[Finding] = []
   for module in program.modules:
     for checker in checkers:

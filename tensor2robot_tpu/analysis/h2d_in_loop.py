@@ -1,0 +1,144 @@
+"""H2D-in-loop checker: host→device transfers inside dispatch loops.
+
+The device-feed work (``TrainerConfig.device_feed``) exists because the
+``h2d_transport_gbps`` bench line showed per-step transfer overhead —
+not math — taxing the step floor. The whole point of funneling every
+placement through the trainer's placement stage (``place()`` /
+``mesh.shard_batch``) is that the loop body itself never moves bytes:
+one burst per dispatch, overlapped with compute by the prefetcher. A
+``jax.device_put`` typed directly into a per-dispatch ``for``/``while``
+body silently reintroduces a synchronous H2D copy on the critical path
+on every iteration — it works, benchmarks never see it attributed, and
+the MFU gauge just quietly sags. This rule makes that a gate failure.
+
+Two finding shapes:
+
+* ``device-put-in-loop`` — an explicit transfer call (``device_put``,
+  ``device_put_sharded``, ``device_put_replicated``,
+  ``make_array_from_process_local_data``) lexically inside a ``for`` /
+  ``while`` body. Functions whose name contains ``place`` or ``shard``
+  ARE the placement stage and are exempt — looping over batches is
+  their job (e.g. ``Trainer.evaluate`` placing eval batches via
+  ``shard_batch``, ``_place_releasing``).
+* ``implicit-transfer-in-loop`` — ``jnp.asarray``/``jnp.array`` applied
+  to a freshly built ``np.*`` array inside a loop body: a definite new
+  host buffer crossing to device per iteration (the
+  ``jnp.asarray(np.stack(batch))`` anti-idiom the superbatch assembler
+  deletes). ``jnp.asarray(x)`` on an unknown name stays quiet — it is
+  usually a trace-time dtype coercion of an already-placed array.
+
+Transfers through the sanctioned placement helpers (``shard_batch``,
+``place``) never fire: they are named calls, not raw ``device_put``.
+Waive a deliberate in-loop transfer inline with
+``# ANALYSIS_OK(h2d-in-loop): <why this copy is off the dispatch
+critical path>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tensor2robot_tpu.analysis import core
+
+RULE = 'h2d-in-loop'
+
+# Leaf names of the explicit-transfer family (matched on the last dotted
+# component so `jax.device_put`, aliased `device_put`, and
+# `jax.experimental.multihost_utils.*` spellings all resolve).
+_TRANSFER_LEAVES = frozenset({
+    'device_put', 'device_put_sharded', 'device_put_replicated',
+    'make_array_from_process_local_data',
+})
+_IMPLICIT_LEAVES = frozenset({'asarray', 'array'})
+_JAX_ROOTS = frozenset({'jax', 'jnp'})
+_NUMPY_ROOTS = frozenset({'np', 'numpy', 'onp'})
+# Substrings marking a function AS the placement stage.
+_PLACEMENT_MARKERS = ('place', 'shard')
+
+
+def _leaf(name: Optional[str]) -> Optional[str]:
+  return None if name is None else name.rsplit('.', 1)[-1]
+
+
+def _root(name: str) -> str:
+  return name.split('.', 1)[0]
+
+
+def _is_placement_fn(name: str) -> bool:
+  lowered = name.lower()
+  return any(marker in lowered for marker in _PLACEMENT_MARKERS)
+
+
+def _numpy_sourced(node: ast.AST) -> bool:
+  """True when the expression is a direct ``np.*``/``numpy.*`` call —
+  a fresh host array by construction."""
+  if not isinstance(node, ast.Call):
+    return False
+  name = core.call_name(node)
+  return name is not None and '.' in name and _root(name) in _NUMPY_ROOTS
+
+
+def _loop_bodies(scope: ast.AST):
+  """Yields (loop_node, statement) for every statement lexically inside
+  a for/while body within ``scope`` (orelse included: it still runs per
+  loop construct, and a transfer there is the same smell). Nested defs
+  and lambdas are separate scopes EXCEPT lambdas: a lambda inside a
+  loop body (the ``tree_map(lambda x: device_put(x), ...)`` idiom) runs
+  per iteration, so we descend into those."""
+  for node in core.walk_scope(scope):
+    if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+      continue
+    stack = list(node.body) + list(node.orelse)
+    while stack:
+      stmt = stack.pop()
+      if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        continue  # its own scope; analyzed when we visit that def
+      yield node, stmt
+      stack.extend(ast.iter_child_nodes(stmt))
+
+
+def check(module: core.ModuleInfo, program: core.Program
+          ) -> List[core.Finding]:
+  del program
+  findings: List[core.Finding] = []
+
+  def scopes():
+    yield '', module.tree
+    for fn in core.func_defs(module.tree):
+      yield core.qualname(module, fn), fn
+
+  for symbol, scope in scopes():
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)
+                  ) and _is_placement_fn(scope.name):
+      continue
+    seen = set()
+    for _loop, node in _loop_bodies(scope):
+      if not isinstance(node, ast.Call) or id(node) in seen:
+        continue
+      seen.add(id(node))
+      name = core.call_name(node)
+      if name is None:
+        continue
+      leaf = _leaf(name)
+      if leaf in _TRANSFER_LEAVES:
+        findings.append(core.Finding(
+            rule=RULE, check='device-put-in-loop',
+            path=module.rel_path, line=node.lineno, symbol=symbol,
+            message=(f'{name}(...) inside a loop body: a synchronous '
+                     'H2D transfer on every iteration of the dispatch '
+                     'loop. Move placement into the placement stage '
+                     '(place()/shard_batch via the prefetcher) so the '
+                     'burst overlaps compute — one device_put per '
+                     'dispatch, not per step.')))
+      elif (leaf in _IMPLICIT_LEAVES and _root(name) in _JAX_ROOTS
+            and node.args and _numpy_sourced(node.args[0])):
+        findings.append(core.Finding(
+            rule=RULE, check='implicit-transfer-in-loop',
+            path=module.rel_path, line=node.lineno, symbol=symbol,
+            message=(f'{name}(<fresh numpy array>) inside a loop body '
+                     'builds a host array and implicitly transfers it '
+                     'to device every iteration. Assemble on host once '
+                     '(superbatch buffers) and place through the '
+                     'placement stage instead.')))
+  return findings

@@ -141,6 +141,12 @@ class ProgramRecord:
   source: str = ''  # which compile point recorded it
   recorded_unix: float = 0.0
   recompiles: int = 0  # re-records under this name with a NEW fingerprint
+  # Train steps folded into ONE execution of this program (the trainer's
+  # steps_per_dispatch scan). cost_analysis counts the WHOLE K-step
+  # executable; utilization() divides by this so train/mfu and
+  # train/hbm_gbps stay per-step quantities a device-feed run can't
+  # inflate by K.
+  steps_per_execution: int = 1
 
   def to_dict(self) -> Dict[str, Any]:
     out = dataclasses.asdict(self)
@@ -264,6 +270,7 @@ class ProgramLedger:
       device_kind: Optional[str] = None,
       source: str = '',
       flag_steady_state: bool = True,
+      steps_per_execution: int = 1,
   ) -> Optional[ProgramRecord]:
     """Extracts and stores one executable's record; returns it.
 
@@ -281,6 +288,7 @@ class ProgramLedger:
       record = self._extract(
           name, compiled, lowered, compile_seconds, donate_argnums,
           donated_params, captured_warnings, device_kind, source)
+      record.steps_per_execution = max(1, int(steps_per_execution))
     except Exception:  # pylint: disable=broad-except
       return None
     recompiled = False
@@ -445,7 +453,8 @@ def record_compiled(name: str, compiled, **kwargs) -> Optional[ProgramRecord]:
 def record_jitted(name: str, jit_fn, args: Sequence[Any],
                   donate_argnums: Sequence[int] = (),
                   donated_params: Optional[int] = None,
-                  source: str = '') -> Optional[ProgramRecord]:
+                  source: str = '',
+                  steps_per_execution: int = 1) -> Optional[ProgramRecord]:
   """AOT-lowers and compiles ``jit_fn`` at ``args``' shapes and records it.
 
   The executable cache jax builds on *call* is not shared with the AOT
@@ -473,7 +482,8 @@ def record_jitted(name: str, jit_fn, args: Sequence[Any],
   return _LEDGER.record_compiled(
       name, compiled, lowered=lowered, compile_seconds=dt,
       donate_argnums=donate_argnums, donated_params=donated_params,
-      captured_warnings=donation_warnings, source=source)
+      captured_warnings=donation_warnings, source=source,
+      steps_per_execution=steps_per_execution)
 
 
 def get(name: str) -> Optional[ProgramRecord]:
@@ -541,9 +551,18 @@ def _resolve_peaks(device_kind: str
   return flops, hbm
 
 
-def utilization(name: str, n_dispatches: int,
+def utilization(name: str, n_steps: int,
                 device_seconds: float) -> Dict[str, float]:
-  """Derived roofline gauges for ``n_dispatches`` of program ``name``.
+  """Derived roofline gauges for ``n_steps`` train steps of ``name``.
+
+  ``n_steps`` counts STEPS, not dispatches: a K-step scanned executable
+  (``steps_per_dispatch`` with or without device feed) records
+  ``steps_per_execution=K`` and its cost_analysis covers the whole
+  K-step program, so per-step FLOPs/bytes are ``record / K`` — the
+  normalization that keeps train/mfu honest when one dispatch trains K
+  steps (and exact for ragged tail groups shorter than K, which a
+  per-dispatch multiply would overcount). For K == 1 this is the
+  historical dispatch-count math bit for bit.
 
   ``hbm_gbps`` (measured bytes-accessed over measured device seconds)
   needs no peak and is always present; ``mfu`` and ``roofline_fraction``
@@ -551,13 +570,14 @@ def utilization(name: str, n_dispatches: int,
   :func:`set_device_peaks`). {} when the program is unrecorded, the
   ledger is disabled, or no device time was measured.
   """
-  if not _enabled or n_dispatches <= 0 or device_seconds <= 0:
+  if not _enabled or n_steps <= 0 or device_seconds <= 0:
     return {}
   record = _LEDGER.get(name)
   if record is None:
     return {}
-  flops = record.flops * n_dispatches
-  bytes_accessed = record.bytes_accessed * n_dispatches
+  per_exec = max(1, int(record.steps_per_execution))
+  flops = record.flops / per_exec * n_steps
+  bytes_accessed = record.bytes_accessed / per_exec * n_steps
   out = {
       'hbm_gbps': bytes_accessed / device_seconds / 1e9,
       'tflops': flops / device_seconds / 1e12,
@@ -576,7 +596,7 @@ def utilization(name: str, n_dispatches: int,
   return out
 
 
-def utilization_scalars(name: str, n_dispatches: int, device_seconds: float,
+def utilization_scalars(name: str, n_steps: int, device_seconds: float,
                         scope: str = 'train') -> Dict[str, float]:
   """:func:`utilization` published as ``<scope>/*`` gauges.
 
@@ -585,7 +605,7 @@ def utilization_scalars(name: str, n_dispatches: int, device_seconds: float,
   and the time-series ring for free, and the returned dict is merged
   into the trainer's scalar stream at log crossings.
   """
-  util = utilization(name, n_dispatches, device_seconds)
+  util = utilization(name, n_steps, device_seconds)
   if not util:
     return {}
   scoped = metrics_lib.scope(scope)

@@ -295,6 +295,33 @@ class TrainerConfig:
   # first boundary ON OR AFTER each multiple, exactly like
   # iterations_per_loop; callbacks see only boundary steps.
   steps_per_dispatch: int = 1
+  # Device-resident multi-step feeding: with steps_per_dispatch=K, the
+  # K-batch step-group moves to device as ONE ``jax.device_put`` of the
+  # whole (features, labels) pytree — one H2D burst per dispatch instead
+  # of one per leaf — into a double-buffered input ring (prefetch depth
+  # >= 2, so the burst for superbatch N+1 overlaps the scanned compute
+  # of N), and on accelerator backends the batch arguments are DONATED
+  # to the K-step executable, letting XLA reuse the superbatch's device
+  # buffers as scratch. The grouping path assembles batches in place
+  # into preallocated contiguous superbatch buffers (no np.stack copy;
+  # see _SuperbatchAssembler). Training math is bitwise identical to
+  # the default feed (same executable on CPU; pinned by
+  # tests/test_device_feed.py). Ignored (off) when the mesh spans
+  # processes — multi-host feeding assembles per-process shards, which
+  # has no single-put form. Default OFF until BENCH_r06 measures it,
+  # per the round-2 honesty rule.
+  device_feed: bool = False
+  # Fused optimizer/EMA/guard update (ops/fused_update.py): run the
+  # entire Adam/SGD + EMA + nonfinite-select chain as ONE elementwise
+  # Pallas pass over flattened parameter blocks — each param leaf read
+  # once, written once, instead of XLA's multi-pass op soup. Takes
+  # effect only when the kernel-dispatch gate is on (TPU, or the test
+  # force) AND the model's optimizer is a tagged factory from
+  # models/optimizers.py with a recognized opt-state structure; in
+  # every other case the stock optax path runs, bit for bit. The fused
+  # pass itself is accepted by a documented parity band vs optax
+  # (tests/test_device_feed.py). Default OFF until BENCH_r06.
+  fused_update: bool = False
   # Microbatch gradient accumulation (GPipe-style): the jitted step runs
   # a lax.scan over M slices of the host batch — [B, ...] reshaped to
   # [M, B/M, ...] — accumulating gradients in donated float32 carries,
@@ -660,57 +687,152 @@ class _DevicePrefetcher:
         pass
 
 
+class _SuperbatchAssembler:
+  """Assembles K host batches into contiguous ``[K, batch, ...]`` groups.
+
+  Replaces the PR-4 ``np.stack`` grouping copy: each source batch is
+  copied exactly once, directly into its slice of a preallocated
+  contiguous superbatch buffer, and its source ring lease
+  (``data/engine.py`` ``release()``) is returned the moment its bytes
+  are copied in — per batch, instead of per group.
+
+  Two buffer modes:
+
+  * ``reuse=False`` (default, and the CPU path): every group gets fresh
+    buffers and :meth:`release` is a no-op. Required wherever a
+    zero-copy ``device_put`` may alias the host buffer for the
+    dispatch's lifetime (XLA-CPU — see ``_place_releasing``).
+  * ``reuse=True`` (device feed on accelerators): ``slots``
+    preallocated buffer sets are recycled as a ring, mirroring the
+    input engine's lease contract — the consumer calls
+    :meth:`release` once per delivered superbatch when its H2D
+    transfer completes, freeing the OLDEST outstanding slot (FIFO,
+    exactly like engine ``release()``). Two slots double-buffer: the
+    assembly of group N+1 proceeds while group N's burst is in flight,
+    and assembly blocks only when both slots are outstanding.
+
+  Grouping semantics are unchanged from the old ``_grouped_batches``:
+  groups clip so the train loop never overshoots ``max_steps``; a batch
+  whose leaf shapes differ from the open group's closes that group
+  early (the odd batch starts its own group); short/ragged groups get
+  fresh buffers (never ring slots — their shapes differ) and just
+  retrace the scan executable. Emitted steps are tracked here so
+  grouping stays correct when a prefetcher pulls groups ahead.
+  """
+
+  def __init__(self, it: Iterator[Batch], k: int, start_step: int,
+               max_steps: int,
+               release: Optional[Callable[[], None]] = None,
+               reuse: bool = False, slots: int = 2):
+    import collections
+    import queue
+
+    self._it = iter(it)
+    self._k = max(1, int(k))
+    self._max_steps = max_steps
+    self._emitted = start_step
+    self._release_source = release
+    self._reuse = bool(reuse)
+    self._slots = max(1, int(slots))
+    self._free: Optional['queue.Queue'] = queue.Queue() if reuse else None
+    self._ring: List[Batch] = []
+    self._ring_sig = None
+    # FIFO of outstanding superbatch leases: ring slot index, or None
+    # for fresh buffers (whose release is a no-op entry).
+    self._leases = collections.deque()
+    self._lease_lock = threading.Lock()
+    self._gen = self._generate()
+
+  def release(self) -> None:
+    """Frees the OLDEST outstanding superbatch lease (engine contract).
+
+    Called by the placement stage once a superbatch's H2D transfer has
+    completed; returns its ring slot (if any) for reuse.
+    """
+    with self._lease_lock:
+      if not self._leases:
+        raise RuntimeError('release() without an outstanding superbatch')
+      slot = self._leases.popleft()
+    if slot is not None:
+      self._free.put(slot)
+
+  @staticmethod
+  def _leaf_shapes(batch):
+    return [np.shape(x) for x in jax.tree_util.tree_leaves(batch)]
+
+  @staticmethod
+  def _alloc(batch: Batch, k: int) -> Batch:
+    return jax.tree_util.tree_map(
+        lambda x: np.empty((k,) + np.shape(x),
+                           dtype=np.asarray(x).dtype), batch)
+
+  def _assemble(self, group) -> Batch:
+    k = len(group)
+    slot = None
+    if self._reuse and k == self._k:
+      sig = (k, self._leaf_shapes(group[0]),
+             [np.asarray(x).dtype
+              for x in jax.tree_util.tree_leaves(group[0])])
+      if self._ring_sig is None:
+        self._ring_sig = sig
+        for i in range(self._slots):
+          self._ring.append(self._alloc(group[0], k))
+          self._free.put(i)
+      if sig == self._ring_sig:
+        # Blocks until the consumer releases a slot: bounds assembly
+        # run-ahead to the ring depth (the double buffer).
+        slot = self._free.get()
+    buffers = self._ring[slot] if slot is not None else self._alloc(
+        group[0], k)
+    dst_leaves = jax.tree_util.tree_leaves(buffers)
+    for i, batch in enumerate(group):
+      for dst, src in zip(dst_leaves, jax.tree_util.tree_leaves(batch)):
+        np.copyto(dst[i], src)
+      if self._release_source is not None:
+        # This batch's bytes now live in the superbatch buffer; its
+        # source ring slot can be recycled immediately.
+        self._release_source()
+    with self._lease_lock:
+      self._leases.append(slot)
+    return buffers
+
+  def _generate(self):
+    group: List[Batch] = []
+    for batch in self._it:
+      if group and self._leaf_shapes(batch) != self._leaf_shapes(group[0]):
+        yield self._assemble(group)
+        self._emitted += len(group)
+        group = []
+        if self._emitted >= self._max_steps:
+          return
+      group.append(batch)
+      if len(group) >= min(self._k, self._max_steps - self._emitted):
+        yield self._assemble(group)
+        self._emitted += len(group)
+        group = []
+        if self._emitted >= self._max_steps:
+          return
+    if group:
+      yield self._assemble(group)
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> Batch:
+    return next(self._gen)
+
+
 def _grouped_batches(it: Iterator[Batch], k: int, start_step: int,
                      max_steps: int,
                      release: Optional[Callable[[], None]] = None
                      ) -> Iterator[Batch]:
-  """Stacks K host batches into one ``[K, batch, ...]`` step-group.
+  """K-batch ``[K, batch, ...]`` step-groups (fresh-buffer assembly).
 
-  Groups are clipped so the train loop never overshoots ``max_steps``,
-  and close early when the next batch's shapes differ (a ragged tail
-  from an external iterator) — the odd batch starts its own group, so
-  ``np.stack`` always sees uniform shapes. Short groups just retrace the
-  scan executable. Tracks emitted steps itself so grouping stays correct
-  when a prefetcher pulls groups ahead of consumption.
-
-  ``release``: ring-buffer lease release of the source iterator
-  (``data/engine.py`` ``reuse_buffers``). ``np.stack`` copies every
-  source batch out of its ring slot, so the K leases are returned right
-  after each group is stacked — before placement, which only ever sees
-  the copies.
+  Compatibility wrapper over :class:`_SuperbatchAssembler` in its
+  fresh-allocation mode — the historical grouping semantics, minus the
+  intermediate ``np.stack`` list-of-views copy.
   """
-  emitted = start_step
-
-  def leaf_shapes(batch):
-    return [np.shape(x) for x in jax.tree_util.tree_leaves(batch)]
-
-  def stacked(group):
-    features = jax.tree_util.tree_map(
-        lambda *xs: np.stack(xs), *[b[0] for b in group])
-    labels = jax.tree_util.tree_map(
-        lambda *xs: np.stack(xs), *[b[1] for b in group])
-    if release is not None:
-      for _ in group:
-        release()
-    return features, labels
-
-  group: List[Batch] = []
-  for batch in it:
-    if group and leaf_shapes(batch) != leaf_shapes(group[0]):
-      yield stacked(group)
-      emitted += len(group)
-      group = []
-      if emitted >= max_steps:
-        return
-    group.append(batch)
-    if len(group) >= min(k, max_steps - emitted):
-      yield stacked(group)
-      emitted += len(group)
-      group = []
-      if emitted >= max_steps:
-        return
-  if group:
-    yield stacked(group)
+  return _SuperbatchAssembler(it, k, start_step, max_steps, release=release)
 
 
 def _layout_api():
@@ -836,11 +958,12 @@ class _DispatchBreakdown:
 
     ``goodput_examples_per_sec`` discounts examples whose updates the
     non-finite guard skipped on device — throughput that moved bytes
-    but trained nothing. ``utilization_fn(n_dispatches,
-    device_seconds)`` (the program ledger's MFU/HBM derivation) is
-    handed the window's device time before the drain and its scalars
-    ride the same merge; it publishes its own gauges, so it runs after
-    the ``trainer/``-prefixed gauge loop.
+    but trained nothing. ``utilization_fn(n_steps, device_seconds)``
+    (the program ledger's MFU/HBM derivation) is handed the window's
+    STEP count — not dispatches; the ledger normalizes the K-step
+    executable per step — and device time before the drain, and its
+    scalars ride the same merge; it publishes its own gauges, so it
+    runs after the ``trainer/``-prefixed gauge loop.
     """
     if not self.enabled or self._win_dispatches == 0:
       return {}
@@ -867,7 +990,8 @@ class _DispatchBreakdown:
       metrics_lib.gauge(f'trainer/{key}').set(value)
     if utilization_fn is not None:
       try:
-        out.update(utilization_fn(n, self._win['device'] / 1e3) or {})
+        out.update(
+            utilization_fn(self._win_steps, self._win['device'] / 1e3) or {})
       except Exception:  # pylint: disable=broad-except
         pass  # telemetry derivation must never stall a log crossing
     self._windows.inc()
@@ -937,6 +1061,18 @@ class Trainer:
     self._optimizer = model.create_optimizer()
     self._loop_k = max(1, int(config.steps_per_dispatch))
     self._accum_m = max(1, int(config.grad_accum_microbatches))
+    # Device-resident feeding (one device_put + one dispatch per K
+    # steps). Off when the mesh spans processes: multi-host placement
+    # assembles per-process shards leaf by leaf, which has no
+    # single-put form. Batch-argument donation rides only accelerator
+    # backends — on XLA-CPU device_put may zero-copy alias host numpy,
+    # and donating an aliased buffer would let XLA scribble on the host
+    # batch (it also keeps the CPU executable identical to the
+    # default-feed one, the bitwise on/off equivalence tests pin).
+    self._feed_enabled = (bool(config.device_feed) and
+                          not mesh_lib.mesh_spans_processes(self._mesh))
+    self._feed_donate_batch = (self._feed_enabled and
+                               jax.default_backend() != 'cpu')
     self._state: Optional[TrainState] = None
     self._train_step_fn = None
     self._eval_step_fn = None
@@ -1072,6 +1208,32 @@ class Trainer:
     decay = model.avg_model_params_decay
     guard_nonfinite = self._config.nonfinite_mode != 'off'
     accum_m = self._accum_m
+    # Fused optimizer/EMA/guard update (ops/fused_update.py): decided
+    # at BUILD time — the kernel gate and the optimizer tag are python
+    # facts, so the branch bakes into the traced program. None keeps
+    # the stock optax path bit for bit.
+    fused_plan = None
+    fused_lib = None
+    if self._config.fused_update:
+      from tensor2robot_tpu.ops import fused_update as fused_lib
+
+      # plan_for logs the fallback reason itself when it returns None
+      # (kernel gate off, untagged optimizer, unrecognized opt state).
+      fused_plan = fused_lib.plan_for(
+          optimizer, ema_decay=decay,
+          opt_state=None if self._state is None else self._state.opt_state)
+
+    def all_finite(loss, grads):
+      # Device-side guard flag: ok == all_finite(loss, grads). With
+      # grad_accum_microbatches > 1, `grads` is the ACCUMULATED
+      # (microbatch-mean) tree — one bad microbatch poisons the whole
+      # effective batch's update, which is the correct granularity: the
+      # optimizer only ever sees the accumulated gradient.
+      checks = [jnp.all(jnp.isfinite(loss))]
+      for g in jax.tree_util.tree_leaves(grads):
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
+          checks.append(jnp.all(jnp.isfinite(g)))
+      return jnp.stack(checks).all()
 
     def train_step(state: TrainState, features, labels):
       step_rng = jax.random.fold_in(state.rng, state.step)
@@ -1130,6 +1292,32 @@ class Trainer:
         scalars = jax.tree_util.tree_map(
             lambda s: jnp.mean(jnp.asarray(s).astype(jnp.float32), axis=0),
             scalars_m)
+      if fused_plan is not None:
+        # One elementwise Pallas pass over every parameter leaf runs
+        # moments + update + apply + EMA + the guard's old-vs-new
+        # select; opt-state counts select outside (scalars). The
+        # remaining replaced leaves (step, model_state) select below;
+        # rng is kept by reference, exactly like the stock path.
+        ok = all_finite(loss, grads) if guard_nonfinite else None
+        new_params, new_opt_state, new_ema = fused_lib.apply_update(
+            fused_plan, state.params, grads, state.opt_state,
+            state.ema_params, ok=ok)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+            ema_params=new_ema)
+        scalars = dict(scalars)
+        scalars['loss'] = loss
+        if guard_nonfinite:
+          new_state = new_state.replace(
+              step=jnp.where(ok, new_state.step, state.step),
+              model_state=jax.tree_util.tree_map(
+                  lambda n, o: jnp.where(ok, n, o),
+                  new_model_state, state.model_state))
+          scalars['nonfinite_count'] = jnp.where(ok, 0, 1).astype(jnp.int32)
+        return new_state, scalars
       updates, new_opt_state = optimizer.update(
           grads, state.opt_state, state.params)
       new_params = optax.apply_updates(state.params, updates)
@@ -1142,22 +1330,13 @@ class Trainer:
       scalars = dict(scalars)
       scalars['loss'] = loss
       if guard_nonfinite:
-        # Device-side guard: ok == all_finite(loss, grads). With
-        # grad_accum_microbatches > 1, `grads` here is the ACCUMULATED
-        # (microbatch-mean) tree — one bad microbatch poisons the whole
-        # effective batch's update, which is the correct granularity:
-        # the optimizer only ever sees the accumulated gradient.
         # The ENTIRE
         # state transition is selected through where(ok, new, old), so a
         # non-finite batch leaves params/opt-state/EMA/step untouched —
         # no host sync, no extra dispatch; the host policy reads the
         # count from the scalars one dispatch behind. Leaves the replace
         # kept by reference (rng) skip the select via identity.
-        checks = [jnp.all(jnp.isfinite(loss))]
-        for g in jax.tree_util.tree_leaves(grads):
-          if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
-            checks.append(jnp.all(jnp.isfinite(g)))
-        ok = jnp.stack(checks).all()
+        ok = all_finite(loss, grads)
         new_state = jax.tree_util.tree_map(
             lambda n, o: n if n is o else jnp.where(ok, n, o),
             new_state, state)
@@ -1200,6 +1379,12 @@ class Trainer:
     return (mesh_lib.stacked_batch_sharding(self._mesh)
             if self._loop_k > 1 else mesh_lib.batch_sharding(self._mesh))
 
+  def _donate_argnums(self) -> Tuple[int, ...]:
+    """(state,) — plus the batch args under accelerator device feed,
+    where the superbatch's device buffers become the step's scratch (the
+    donated input ring; the host copy already lives in the assembler)."""
+    return (0, 1, 2) if self._feed_donate_batch else (0,)
+
   def _build_train_step(self):
     state_sharding = self._state_sharding()
     batch_sharding = self._loop_batch_sharding()
@@ -1207,7 +1392,7 @@ class Trainer:
         self._loop_step_body(),
         in_shardings=(state_sharding, batch_sharding, batch_sharding),
         out_shardings=(state_sharding, None),
-        donate_argnums=(0,))
+        donate_argnums=self._donate_argnums())
 
   def _capture_program_avals(self, cell, features, labels) -> None:
     """Fills ``cell`` with (avals, donated_leaves) for the harvest.
@@ -1252,18 +1437,27 @@ class Trainer:
         return
       avals, donated_params = cell[0]
       if programs_lib.record_jitted(
-          'train/step', step_fn, avals, donate_argnums=(0,),
-          donated_params=donated_params, source='trainer/jit_step'):
+          'train/step', step_fn, avals,
+          donate_argnums=self._donate_argnums(),
+          donated_params=donated_params, source='trainer/jit_step',
+          steps_per_execution=self._loop_k):
         self._program_recorded = True
 
     return harvest
 
-  def _program_utilization(self, n_dispatches: int,
+  def _program_utilization(self, n_steps: int,
                            device_seconds: float) -> MetricDict:
     """train/mfu + train/hbm_gbps + train/roofline_fraction for one
-    closed log window (empty until 'train/step' is recorded)."""
+    closed log window (empty until 'train/step' is recorded).
+
+    ``n_steps`` counts STEPS, not dispatches: the ledger records the
+    K-step executable with ``steps_per_execution=K`` and normalizes its
+    FLOPs/bytes per step, so MFU stays honest (and ragged-tail exact)
+    when one dispatch trains K steps. Identical to the historical
+    dispatch math for K == 1.
+    """
     return programs_lib.utilization_scalars(
-        'train/step', n_dispatches, device_seconds, scope='train')
+        'train/step', n_steps, device_seconds, scope='train')
 
   def _maybe_build_auto_step(self, features, labels) -> bool:
     """Compiles the train step with compiler-chosen (AUTO) batch layouts.
@@ -1296,7 +1490,7 @@ class Trainer:
             self._loop_step_body(),
             in_shardings=(state_sharding, auto, auto),
             out_shardings=(state_sharding, None),
-            donate_argnums=(0,))
+            donate_argnums=self._donate_argnums())
         t_compile0 = time.perf_counter()
         with warnings.catch_warnings(record=True) as caught:
           warnings.simplefilter('always')
@@ -1328,12 +1522,14 @@ class Trainer:
           self._program_recorded = True
           programs_lib.record_compiled(
               'train/step', compiled, lowered=lowered,
-              compile_seconds=compile_seconds, donate_argnums=(0,),
+              compile_seconds=compile_seconds,
+              donate_argnums=self._donate_argnums(),
               donated_params=len(jax.tree_util.tree_leaves(self._state)),
               captured_warnings=[
                   str(w.message) for w in caught
                   if 'donat' in str(w.message).lower()],
-              source='trainer/auto_step')
+              source='trainer/auto_step',
+              steps_per_execution=self._loop_k)
         return True
       except Exception as e:  # pylint: disable=broad-except
         logging.info(
@@ -1546,6 +1742,13 @@ class Trainer:
     loop_ident = threading.get_ident()
     overlap_place_hist = metrics_lib.histogram(
         'trainer/placement_overlapped_ms')
+    device_feed = self._feed_enabled
+    feed_sharding = self._loop_batch_sharding() if device_feed else None
+    # One increment per device-feed placement call: with the dispatch
+    # counter, the registry pins "exactly ONE device_put and ONE
+    # dispatch per K steps" (tests/test_device_feed.py; bench.py's
+    # h2d_dispatches_per_step line).
+    h2d_puts = metrics_lib.counter('trainer/h2d/device_puts')
 
     def place(batch: Batch):
       # First placement builds the auto-layout executable from this
@@ -1559,12 +1762,23 @@ class Trainer:
       t0 = time.perf_counter()
       use_auto = (self._maybe_build_auto_step(batch[0], batch[1]) and
                   self._batch_matches_auto(batch))
-      placed = mesh_lib.shard_batch(
-          # ANALYSIS_OK(lock-discipline): use_auto=True implies the build
-          # lock published _batch_formats before _maybe_build_auto_step
-          # returned (happens-before via the lock release).
-          batch, self._mesh, self._batch_formats if use_auto else None,
-          stacked=self._loop_k > 1)
+      # ANALYSIS_OK(lock-discipline): use_auto=True implies the build
+      # lock published _batch_formats before _maybe_build_auto_step
+      # returned (happens-before via the lock release).
+      formats = self._batch_formats if use_auto else None
+      if device_feed:
+        # Device feed: the whole (features, labels) group moves in ONE
+        # device_put call — one H2D burst per dispatch — instead of
+        # shard_batch's per-leaf puts. The target is the executable's
+        # preferred format tree when the auto build landed, else the
+        # loop sharding replicated over the batch's structure.
+        target = (formats if formats is not None else
+                  jax.tree_util.tree_map(lambda _: feed_sharding, batch))
+        placed = jax.device_put(batch, target)
+        h2d_puts.inc()
+      else:
+        placed = mesh_lib.shard_batch(
+            batch, self._mesh, formats, stacked=self._loop_k > 1)
       place_ms = (time.perf_counter() - t0) * 1e3
       if threading.get_ident() == loop_ident:
         # Critical-path placement: carved out of host_wait in the
@@ -1582,15 +1796,31 @@ class Trainer:
     host_iter: Iterator[Batch] = train_iter
     place_release = release_fn
     if self._loop_k > 1:
-      # The grouping stack copies batches out of their ring slots, so
-      # leases are released there; downstream stages see only copies.
-      host_iter = _grouped_batches(
+      # Group assembly copies batches out of their SOURCE ring slots
+      # into the superbatch buffers, so source leases are released
+      # there; downstream stages see only the assembled buffers. Under
+      # accelerator device feed the superbatch buffers are themselves a
+      # two-slot ring: the assembler leases a slot per group and the
+      # placement stage frees it once the H2D burst completes
+      # (``_place_releasing`` blocks on the placed arrays, then calls
+      # ``assembler.release``) — the host half of the double-buffered
+      # donated input ring. On CPU ``device_put`` aliases host memory
+      # (zero copy), so reusing buffers would corrupt in-flight
+      # batches: keep fresh allocations there.
+      feed_reuse = device_feed and jax.default_backend() != 'cpu'
+      assembler = _SuperbatchAssembler(
           train_iter, self._loop_k, step, config.max_train_steps,
-          release=release_fn)
-      place_release = None
+          release=release_fn, reuse=feed_reuse)
+      host_iter = assembler
+      place_release = assembler.release if feed_reuse else None
 
     prefetcher: Optional[_DevicePrefetcher] = None
     prefetch_depth = config.resolved_prefetch_batches()
+    if device_feed and prefetch_depth > 0:
+      # Double-buffered device input ring: keep at least two placed
+      # superbatches in flight so the H2D burst for group N+1 overlaps
+      # the scanned compute of group N.
+      prefetch_depth = max(2, prefetch_depth)
     if prefetch_depth > 0:
       prefetcher = _DevicePrefetcher(host_iter, place, prefetch_depth,
                                      release=place_release)

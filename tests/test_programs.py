@@ -228,6 +228,33 @@ class TestTrainerIntegration:
       assert row['train/hbm_gbps'] == pytest.approx(expected_hbm, rel=0.05)
     assert metrics.gauge('train/mfu').value > 0
 
+  def test_k_step_program_mfu_normalizes_per_step(self, tmp_path):
+    """device_feed at K=3: the ledger stores the WHOLE scanned
+    executable's cost with steps_per_execution=K, utilization() divides
+    by K and multiplies by the window's step count — so published MFU
+    is per-STEP and matches the same hand formula as K=1 (the ÷K on the
+    record and the ×K steps-per-dispatch in the window cancel against
+    per-dispatch device time)."""
+    peak_flops = 1e12
+    programs.set_device_peaks(flops=peak_flops, hbm_gbps=100.0)
+    records = [r for r in train_records(
+        tmp_path, auto_input_layouts=True, steps_per_dispatch=3,
+        device_feed=True)
+               if r['kind'] == 'train']
+    assert records
+    rec = programs.get('train/step')
+    assert rec is not None and rec.flops > 0
+    assert rec.steps_per_execution == 3
+    for row in records:
+      assert 'train/mfu' in row, sorted(row)
+      # breakdown/device_step_ms is per-DISPATCH device time; the
+      # recorded flops are also per-dispatch (whole scan), so the
+      # per-step normalizations cancel and the K=1 formula holds.
+      per_dispatch_s = row['breakdown/device_step_ms'] * 1e-3
+      assert per_dispatch_s > 0
+      expected_mfu = rec.flops / (per_dispatch_s * peak_flops)
+      assert row['train/mfu'] == pytest.approx(expected_mfu, rel=0.05)
+
   def test_default_path_harvests_off_thread(self, tmp_path):
     """auto off (the CPU default): the jitted step is AOT-harvested on
     the daemon thread after the first dispatch (delay 0 = immediate;

@@ -981,6 +981,123 @@ def counter(name):
     assert _unwaived(_analyze(source), 'metric-cardinality') == []
 
 
+# ========================================================== h2d in loop
+
+
+H2D_BAD = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def train_loop(batches, step_fn, state):
+  for batch in batches:
+    placed = jax.device_put(batch)          # BAD: one H2D per step
+    state = step_fn(state, placed)
+  return state
+
+
+def eval_all(batches, sharding):
+  out = []
+  for batch in batches:
+    out.append(jax.device_put_sharded(batch, sharding))  # BAD
+  return out
+
+
+def stack_and_feed(groups, step_fn, state):
+  for group in groups:
+    superbatch = jnp.asarray(np.stack(group))  # BAD: implicit transfer
+    state = step_fn(state, superbatch)
+  return state
+
+
+def lambda_in_loop(batches, tree_map):
+  for batch in batches:
+    # BAD: the lambda runs per iteration — still one put per step.
+    yield tree_map(lambda x: jax.device_put(x), batch)
+'''
+
+H2D_GOOD = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def place_batches(batches, sharding):
+  # Placement-stage function (name contains 'place'): looping over
+  # batches to put them IS its job.
+  for batch in batches:
+    yield jax.device_put(batch, sharding)
+
+
+def shard_eval_batch(batches, mesh):
+  for batch in batches:
+    yield jax.device_put_sharded(batch, mesh)
+
+
+def train_loop(placed_batches, step_fn, state):
+  for batch in placed_batches:
+    coerced = jnp.asarray(batch)  # dtype coercion of a placed array
+    state = step_fn(state, coerced)
+  return state
+
+
+def build_once(group, step_fn, state):
+  superbatch = jnp.asarray(np.stack(group))  # not in a loop body
+  return step_fn(state, superbatch)
+
+
+def deferred(batches):
+  for batch in batches:
+    def later():
+      return jax.device_put(batch)  # nested def: its own scope
+    yield later
+
+
+def warm_start(batches, step_fn, state):
+  for batch in batches:
+    # ANALYSIS_OK(h2d-in-loop): one-time warmup outside the measured
+    # dispatch loop; overlap does not matter here.
+    state = step_fn(state, jax.device_put(batch))
+  return state
+'''
+
+
+class TestH2DInLoop:
+
+  def test_fires_on_in_loop_transfers(self):
+    findings = _unwaived(_analyze(H2D_BAD), 'h2d-in-loop')
+    by_check = {}
+    for f in findings:
+      by_check.setdefault(f.check, []).append(f.symbol)
+    assert sorted(by_check['device-put-in-loop']) == [
+        'eval_all', 'lambda_in_loop', 'train_loop']
+    assert by_check['implicit-transfer-in-loop'] == ['stack_and_feed']
+    messages = ' '.join(f.message for f in findings)
+    assert 'placement stage' in messages and 'superbatch' in messages
+
+  def test_quiet_on_placement_stage_and_waivers(self):
+    assert _unwaived(_analyze(H2D_GOOD), 'h2d-in-loop') == []
+
+  def test_nested_def_transfer_found_in_its_own_scope(self):
+    # The loop exemption for nested defs does NOT lose findings: a def
+    # whose OWN body loops a device_put is analyzed as its own scope.
+    source = '''
+import jax
+
+
+def outer(batches):
+  def pump(state, step_fn):
+    for batch in batches:
+      state = step_fn(state, jax.device_put(batch))
+    return state
+  return pump
+'''
+    findings = _unwaived(_analyze(source), 'h2d-in-loop')
+    assert [f.check for f in findings] == ['device-put-in-loop']
+    assert findings[0].symbol == 'outer.pump'
+
+
 # ================================================================ gate
 
 

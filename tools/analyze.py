@@ -61,6 +61,7 @@ def _checkers_for(rules):
   from tensor2robot_tpu.analysis import blocking_under_lock
   from tensor2robot_tpu.analysis import dead_code
   from tensor2robot_tpu.analysis import donated_reuse
+  from tensor2robot_tpu.analysis import h2d_in_loop
   from tensor2robot_tpu.analysis import jit_hazards
   from tensor2robot_tpu.analysis import lock_discipline
   from tensor2robot_tpu.analysis import metric_cardinality
@@ -74,6 +75,7 @@ def _checkers_for(rules):
       'blocking-under-lock': blocking_under_lock.check,
       'donated-reuse': donated_reuse.check,
       'metric-cardinality': metric_cardinality.check,
+      'h2d-in-loop': h2d_in_loop.check,
   }
   if not rules:
     return None  # all

@@ -301,6 +301,14 @@ def main(argv=None):
                       choices=('none', 'pool', 'pool_conv'),
                       help='Pallas kernel routing on the towers: roofline '
                            'the hand-kernel program (qtopt/grasp2vec)')
+  parser.add_argument('--device-feed', action='store_true',
+                      help='roofline the device-feed program: the K-step '
+                           'lax.scan over a stacked superbatch '
+                           '(TrainerConfig.device_feed; per-step numbers '
+                           'are the per-dispatch totals ÷ K)')
+  parser.add_argument('--steps-per-dispatch', type=int, default=1,
+                      help='K for the scanned program (with --device-feed '
+                           'and K=1 the bench default K=8 is used)')
   args = parser.parse_args(sys.argv[1:] if argv is None else argv)
 
   workload = args.workload
@@ -308,9 +316,14 @@ def main(argv=None):
                                       kernel_policy=args.kernel_policy)
   if args.batch is not None:
     batch_size = args.batch
+  loop_k = args.steps_per_dispatch
+  if args.device_feed and loop_k == 1:
+    loop_k = 8
   config = TrainerConfig(model_dir='', max_train_steps=1,
                          eval_interval_steps=0, log_interval_steps=0,
-                         grad_accum_microbatches=args.accum)
+                         grad_accum_microbatches=args.accum,
+                         steps_per_dispatch=loop_k,
+                         device_feed=args.device_feed)
   trainer = Trainer(model, config)
   preprocessor = model.preprocessor
   feature_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
@@ -322,9 +335,25 @@ def main(argv=None):
 
   state = trainer.state
   step_fn = trainer._train_step_fn  # pylint: disable=protected-access
-  f = mesh_lib.shard_batch(features, trainer.mesh)
-  l = (mesh_lib.shard_batch(labels, trainer.mesh)
-       if labels is not None else None)
+  if loop_k > 1:
+    # The K-step scanned program consumes a stacked (K, batch, ...)
+    # superbatch; replicating one batch K× rooflines the same program
+    # geometry the device-feed loop dispatches.
+    import numpy as np
+
+    def stack_k(tree):
+      return jax.tree_util.tree_map(
+          lambda x: np.stack([np.asarray(x)] * loop_k), tree)
+
+    features = stack_k(features)
+    labels = stack_k(labels) if labels is not None else None
+    f = mesh_lib.shard_batch(features, trainer.mesh, stacked=True)
+    l = (mesh_lib.shard_batch(labels, trainer.mesh, stacked=True)
+         if labels is not None else None)
+  else:
+    f = mesh_lib.shard_batch(features, trainer.mesh)
+    l = (mesh_lib.shard_batch(labels, trainer.mesh)
+         if labels is not None else None)
   hlo = step_fn.lower(state, f, l).compile().as_text()
 
   n = 20
@@ -343,7 +372,13 @@ def main(argv=None):
   # trace_profile.is_region_event), so each scan-body kernel is counted
   # once per microbatch — the per-step totals already include all M
   # iterations. Label the table with both granularities.
-  label = f'device ms/step: {total_ms / n:.3f}'
+  per_dispatch_ms = total_ms / n
+  if loop_k > 1:
+    label = (f'device ms/step: {per_dispatch_ms / loop_k:.3f}  '
+             f'(K={loop_k} scanned steps per dispatch; '
+             f'{per_dispatch_ms:.3f} ms/dispatch)')
+  else:
+    label = f'device ms/step: {per_dispatch_ms:.3f}'
   if args.accum > 1:
     label += (f'  (effective batch {batch_size} = '
               f'{args.accum}×{batch_size // args.accum} microbatches; '

@@ -11,6 +11,7 @@
 #   tools/run_tier1.sh -m loop              # closed actor-learner loop drills
 #   tools/run_tier1.sh -m kernels           # Pallas pool/conv + fp8 parity
 #   tools/run_tier1.sh -m chaos             # chaos drill: faults -> actuators
+#   tools/run_tier1.sh -m feed              # device-feed multi-step + fused update
 #   tools/run_tier1.sh tests/test_input_engine.py
 #
 # Pre-commit fast path for the static-analysis gate alone (only files
